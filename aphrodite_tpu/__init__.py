@@ -1,0 +1,36 @@
+"""aphrodite_tpu: a TPU-native LLM inference serving framework.
+
+A brand-new JAX/XLA/Pallas implementation of the capabilities of
+aphrodite-engine (vLLM lineage): continuous batching over a block-paged KV
+cache, a rich creative-writing sampler suite, quantization, multi-LoRA, MoE,
+and OpenAI/KoboldAI/Ooba-compatible HTTP frontends — designed SPMD-first for
+TPU meshes (pjit/shard_map over ICI) rather than ported from CUDA.
+
+Reference layer map: see SURVEY.md (citations into /root/reference).
+"""
+
+__version__ = "0.1.0"
+
+from aphrodite_tpu.common.sampling_params import SamplingParams
+from aphrodite_tpu.common.outputs import CompletionOutput, RequestOutput
+
+__all__ = [
+    "SamplingParams",
+    "CompletionOutput",
+    "RequestOutput",
+    "__version__",
+]
+
+
+def __getattr__(name):
+    # Lazy imports so `import aphrodite_tpu` stays cheap (no jax/model deps).
+    if name == "LLM":
+        from aphrodite_tpu.endpoints.llm import LLM
+        return LLM
+    if name == "EngineArgs":
+        from aphrodite_tpu.engine.args_tools import EngineArgs
+        return EngineArgs
+    if name == "AphroditeEngine":
+        from aphrodite_tpu.engine.engine import AphroditeEngine
+        return AphroditeEngine
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

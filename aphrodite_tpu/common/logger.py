@@ -1,0 +1,31 @@
+"""Logging setup (reference: aphrodite/common/logger.py).
+
+Plain stdlib logging with a compact format; no colorlog dependency.
+"""
+import logging
+import os
+import sys
+
+_FORMAT = "%(levelname)s %(asctime)s [%(name)s] %(message)s"
+_DATEFMT = "%H:%M:%S"
+
+_root_configured = False
+
+
+def _configure_root() -> None:
+    global _root_configured
+    if _root_configured:
+        return
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter(_FORMAT, datefmt=_DATEFMT))
+    root = logging.getLogger("aphrodite_tpu")
+    root.addHandler(handler)
+    root.setLevel(os.environ.get("APHRODITE_TPU_LOG_LEVEL", "INFO").upper())
+    root.propagate = False
+    _root_configured = True
+
+
+def init_logger(name: str) -> logging.Logger:
+    _configure_root()
+    return logging.getLogger(name if name.startswith("aphrodite_tpu")
+                             else f"aphrodite_tpu.{name}")

@@ -1,0 +1,419 @@
+"""Iteration-level (continuous-batching) scheduler.
+
+Semantics match the reference `aphrodite/processing/scheduler.py:73,160,365`:
+each engine step is either one **prompt batch** (admit waiting groups under
+token/seq/padding budgets) or one **decode batch** (reserve a slot per
+running sequence, preempting by recompute or swap when HBM pages run out,
+then swap groups back in when room frees up).
+
+TPU notes: the prompt-token budget uses the padded cost
+(num_seqs * max_len), which is exactly what the fixed-shape prefill program
+executes, so the budget is the real device cost, not an approximation. The
+emitted swap/copy plans are applied as single batched device ops by the
+executor.
+"""
+from __future__ import annotations
+
+import enum
+import time
+from collections import deque
+from typing import Deque, Dict, Iterable, List, Optional, Tuple, Union
+
+from aphrodite_tpu.common.config import (CacheConfig, LoRAConfig,
+                                         SchedulerConfig)
+from aphrodite_tpu.common.logger import init_logger
+from aphrodite_tpu.common.prefix import PrefixPool
+from aphrodite_tpu.common.sequence import (Sequence, SequenceData,
+                                           SequenceGroup,
+                                           SequenceGroupMetadata,
+                                           SequenceStatus)
+from aphrodite_tpu.processing.block_manager import (AllocStatus,
+                                                    BlockSpaceManager)
+from aphrodite_tpu.processing.policy import PolicyFactory
+
+logger = init_logger(__name__)
+
+
+class PreemptionMode(enum.Enum):
+    """RECOMPUTE drops pages and requeues as a fresh prompt (cheap, only
+    valid for single-sequence groups); SWAP stages pages to host memory."""
+    SWAP = enum.auto()
+    RECOMPUTE = enum.auto()
+
+
+class SchedulerOutputs:
+
+    def __init__(
+        self,
+        scheduled_seq_groups: Iterable[SequenceGroup],
+        prompt_run: bool,
+        num_batched_tokens: int,
+        blocks_to_swap_in: Dict[int, int],
+        blocks_to_swap_out: Dict[int, int],
+        blocks_to_copy: Dict[int, List[int]],
+        ignored_seq_groups: List[SequenceGroup],
+    ) -> None:
+        self.scheduled_seq_groups = scheduled_seq_groups
+        self.prompt_run = prompt_run
+        self.num_batched_tokens = num_batched_tokens
+        self.blocks_to_swap_in = blocks_to_swap_in
+        self.blocks_to_swap_out = blocks_to_swap_out
+        self.blocks_to_copy = blocks_to_copy
+        # Structural invariant: a step never swaps both directions.
+        assert not (blocks_to_swap_in and blocks_to_swap_out)
+        self.ignored_seq_groups = ignored_seq_groups
+
+    def is_empty(self) -> bool:
+        # Ignored groups still produce outputs but schedule no device work.
+        return (not self.scheduled_seq_groups and not self.blocks_to_swap_in
+                and not self.blocks_to_swap_out and not self.blocks_to_copy)
+
+
+class Scheduler:
+
+    def __init__(
+        self,
+        scheduler_config: SchedulerConfig,
+        cache_config: CacheConfig,
+        lora_config: Optional[LoRAConfig] = None,
+    ) -> None:
+        self.scheduler_config = scheduler_config
+        self.cache_config = cache_config
+        self.lora_config = lora_config
+
+        self.prompt_limit = min(scheduler_config.max_model_len,
+                                scheduler_config.max_num_batched_tokens)
+
+        self.policy = PolicyFactory.get_policy(policy_name="fcfs")
+        self.block_manager = BlockSpaceManager(
+            block_size=cache_config.block_size,
+            num_gpu_blocks=cache_config.num_gpu_blocks,
+            num_cpu_blocks=cache_config.num_cpu_blocks,
+            sliding_window=cache_config.sliding_window)
+        self.prefix_pool = PrefixPool(cache_config.block_size)
+
+        self.waiting: Deque[SequenceGroup] = deque()
+        self.running: Deque[SequenceGroup] = deque()
+        self.swapped: Deque[SequenceGroup] = deque()
+
+    @property
+    def lora_enabled(self) -> bool:
+        return bool(self.lora_config)
+
+    def add_seq_group(self, seq_group: SequenceGroup) -> None:
+        self.waiting.append(seq_group)
+
+    def abort_seq_group(self, request_id: Union[str, Iterable[str]]) -> None:
+        if isinstance(request_id, str):
+            request_id = (request_id, )
+        request_ids = set(request_id)
+        for state_queue in (self.waiting, self.running, self.swapped):
+            aborted: List[SequenceGroup] = []
+            for seq_group in state_queue:
+                if not request_ids:
+                    break
+                if seq_group.request_id in request_ids:
+                    aborted.append(seq_group)
+                    request_ids.remove(seq_group.request_id)
+            for seq_group in aborted:
+                state_queue.remove(seq_group)
+                for seq in seq_group.get_seqs():
+                    if seq.is_finished():
+                        continue
+                    seq.status = SequenceStatus.FINISHED_ABORTED
+                    self.free_seq(seq)
+
+    def has_unfinished_seqs(self) -> bool:
+        return bool(self.waiting or self.running or self.swapped)
+
+    def get_num_unfinished_seq_groups(self) -> int:
+        return len(self.waiting) + len(self.running) + len(self.swapped)
+
+    # ------------------------------------------------------------------
+
+    def _schedule_prompts(
+            self, blocks_to_swap_in: Dict[int, int],
+            blocks_to_swap_out: Dict[int, int],
+            blocks_to_copy: Dict[int, List[int]]
+    ) -> Optional[SchedulerOutputs]:
+        """Try to admit waiting prompts; None if nothing was admitted."""
+        ignored_seq_groups: List[SequenceGroup] = []
+        scheduled: List[SequenceGroup] = []
+        num_curr_seqs = sum(g.get_max_num_running_seqs()
+                            for g in self.running)
+        curr_loras = (set(g.lora_int_id
+                          for g in self.running) if self.lora_enabled else
+                      None)
+        seq_lens: List[int] = []
+        leftover_waiting: Deque[SequenceGroup] = deque()
+
+        # Waiting queue stays unsorted: preempted groups re-enter at the
+        # front, new arrivals at the back, preserving FCFS.
+        while self.waiting:
+            seq_group = self.waiting[0]
+            waiting_seqs = seq_group.get_seqs(status=SequenceStatus.WAITING)
+            assert len(waiting_seqs) == 1, (
+                "Waiting sequence group should have only one prompt "
+                "sequence.")
+            num_prompt_tokens = waiting_seqs[0].get_len()
+
+            if num_prompt_tokens > self.prompt_limit:
+                logger.warning(
+                    "Input prompt (%d tokens) is too long and exceeds limit "
+                    "of %d", num_prompt_tokens, self.prompt_limit)
+                for seq in waiting_seqs:
+                    seq.status = SequenceStatus.FINISHED_IGNORED
+                ignored_seq_groups.append(seq_group)
+                self.waiting.popleft()
+                continue
+
+            can_allocate = self.block_manager.can_allocate(seq_group)
+            if can_allocate == AllocStatus.LATER:
+                break
+            if can_allocate == AllocStatus.NEVER:
+                logger.warning(
+                    "Input prompt (%d tokens) is too long and exceeds the "
+                    "capacity of the block manager", num_prompt_tokens)
+                for seq in waiting_seqs:
+                    seq.status = SequenceStatus.FINISHED_IGNORED
+                ignored_seq_groups.append(seq_group)
+                self.waiting.popleft()
+                continue
+
+            lora_int_id = 0
+            if self.lora_enabled:
+                lora_int_id = seq_group.lora_int_id
+                if (lora_int_id > 0 and lora_int_id not in curr_loras
+                        and len(curr_loras) >= self.lora_config.max_loras):
+                    # No free adapter slot: defer without blocking others.
+                    leftover_waiting.appendleft(seq_group)
+                    self.waiting.popleft()
+                    continue
+
+            # Padded-batch token budget: the prefill program runs
+            # num_seqs x max_len, so that is the cost we meter.
+            new_seq_lens = seq_lens + [num_prompt_tokens]
+            num_batched_tokens = len(new_seq_lens) * max(new_seq_lens)
+            if (num_batched_tokens >
+                    self.scheduler_config.max_num_batched_tokens):
+                break
+
+            num_new_seqs = seq_group.get_max_num_running_seqs()
+            if (num_curr_seqs + num_new_seqs >
+                    self.scheduler_config.max_num_seqs):
+                break
+
+            num_paddings = num_batched_tokens - sum(new_seq_lens)
+            if num_paddings > self.scheduler_config.max_paddings:
+                break
+            seq_lens = new_seq_lens
+
+            if lora_int_id > 0:
+                curr_loras.add(lora_int_id)
+            self.waiting.popleft()
+            self._allocate(seq_group)
+            self.running.append(seq_group)
+            num_curr_seqs += num_new_seqs
+            scheduled.append(seq_group)
+
+        self.waiting.extendleft(leftover_waiting)
+
+        if scheduled or ignored_seq_groups:
+            return SchedulerOutputs(
+                scheduled_seq_groups=scheduled,
+                prompt_run=True,
+                num_batched_tokens=(len(seq_lens) *
+                                    max(seq_lens) if seq_lens else 0),
+                blocks_to_swap_in=blocks_to_swap_in,
+                blocks_to_swap_out=blocks_to_swap_out,
+                blocks_to_copy=blocks_to_copy,
+                ignored_seq_groups=ignored_seq_groups,
+            )
+        return None
+
+    def _schedule(self) -> SchedulerOutputs:
+        blocks_to_swap_in: Dict[int, int] = {}
+        blocks_to_swap_out: Dict[int, int] = {}
+        blocks_to_copy: Dict[int, List[int]] = {}
+        now = time.monotonic()
+
+        # Swapped groups have priority over new prompts (they already hold
+        # host pages); only admit prompts when nothing is swapped out.
+        if not self.swapped:
+            outputs = self._schedule_prompts(blocks_to_swap_in,
+                                             blocks_to_swap_out,
+                                             blocks_to_copy)
+            if outputs is not None:
+                return outputs
+
+        # Decode batch: reserve one slot per running sequence, preempting
+        # from the back of the priority order when pages run out.
+        self.running = self.policy.sort_by_priority(now, self.running)
+        running: Deque[SequenceGroup] = deque()
+        preempted: List[SequenceGroup] = []
+        while self.running:
+            seq_group = self.running.popleft()
+            while not self.block_manager.can_append_slot(seq_group):
+                if self.running:
+                    victim = self.running.pop()
+                    self._preempt(victim, blocks_to_swap_out)
+                    preempted.append(victim)
+                else:
+                    self._preempt(seq_group, blocks_to_swap_out)
+                    preempted.append(seq_group)
+                    break
+            else:
+                self._append_slot(seq_group, blocks_to_copy)
+                running.append(seq_group)
+        self.running = running
+
+        # Bring swapped groups back while there is room (unless this very
+        # step preempted — swapping both directions is forbidden).
+        self.swapped = self.policy.sort_by_priority(now, self.swapped)
+        if not preempted:
+            num_curr_seqs = sum(g.get_max_num_running_seqs()
+                                for g in self.running)
+            curr_loras = (set(g.lora_int_id for g in self.running)
+                          if self.lora_enabled else None)
+            leftover_swapped: Deque[SequenceGroup] = deque()
+            while self.swapped:
+                seq_group = self.swapped[0]
+                lora_int_id = 0
+                if self.lora_enabled:
+                    lora_int_id = seq_group.lora_int_id
+                    if (lora_int_id > 0 and lora_int_id not in curr_loras
+                            and len(curr_loras) >=
+                            self.lora_config.max_loras):
+                        leftover_swapped.appendleft(seq_group)
+                        self.swapped.popleft()
+                        continue
+                if not self.block_manager.can_swap_in(seq_group):
+                    break
+                num_new_seqs = seq_group.get_max_num_running_seqs()
+                if (num_curr_seqs + num_new_seqs >
+                        self.scheduler_config.max_num_seqs):
+                    break
+                if lora_int_id > 0:
+                    curr_loras.add(lora_int_id)
+                self.swapped.popleft()
+                self._swap_in(seq_group, blocks_to_swap_in)
+                self._append_slot(seq_group, blocks_to_copy)
+                num_curr_seqs += num_new_seqs
+                self.running.append(seq_group)
+            self.swapped.extendleft(leftover_swapped)
+
+        num_batched_tokens = sum(
+            g.num_seqs(status=SequenceStatus.RUNNING) for g in self.running)
+
+        return SchedulerOutputs(
+            scheduled_seq_groups=self.running,
+            prompt_run=False,
+            num_batched_tokens=num_batched_tokens,
+            blocks_to_swap_in=blocks_to_swap_in,
+            blocks_to_swap_out=blocks_to_swap_out,
+            blocks_to_copy=blocks_to_copy,
+            ignored_seq_groups=[],
+        )
+
+    def schedule(
+            self) -> Tuple[List[SequenceGroupMetadata], SchedulerOutputs]:
+        scheduler_outputs = self._schedule()
+
+        seq_group_metadata_list: List[SequenceGroupMetadata] = []
+        for seq_group in scheduler_outputs.scheduled_seq_groups:
+            seq_data: Dict[int, SequenceData] = {}
+            block_tables: Dict[int, List[int]] = {}
+            persistent_data: Dict[int, dict] = {}
+            for seq in seq_group.get_seqs(status=SequenceStatus.RUNNING):
+                seq_data[seq.seq_id] = seq.data
+                block_tables[seq.seq_id] = (
+                    self.block_manager.get_block_table(seq))
+                persistent_data[seq.seq_id] = seq.persistent_data
+            seq_group_metadata_list.append(
+                SequenceGroupMetadata(
+                    request_id=seq_group.request_id,
+                    is_prompt=scheduler_outputs.prompt_run,
+                    seq_data=seq_data,
+                    sampling_params=seq_group.sampling_params,
+                    block_tables=block_tables,
+                    persistent_data=persistent_data,
+                    prefix=seq_group.prefix,
+                ))
+        return seq_group_metadata_list, scheduler_outputs
+
+    def fork_seq(self, parent_seq: Sequence, child_seq: Sequence) -> None:
+        self.block_manager.fork(parent_seq, child_seq)
+
+    def free_seq(self, seq: Sequence) -> None:
+        self.block_manager.free(seq)
+
+    def free_finished_seq_groups(self) -> None:
+        self.running = deque(g for g in self.running if not g.is_finished())
+
+    # ------------------------------------------------------------------
+
+    def _allocate(self, seq_group: SequenceGroup) -> None:
+        self.block_manager.allocate(seq_group)
+        for seq in seq_group.get_seqs(status=SequenceStatus.WAITING):
+            seq.status = SequenceStatus.RUNNING
+
+    def _append_slot(self, seq_group: SequenceGroup,
+                     blocks_to_copy: Dict[int, List[int]]) -> None:
+        for seq in seq_group.get_seqs(status=SequenceStatus.RUNNING):
+            cow = self.block_manager.append_slot(seq)
+            if cow is not None:
+                src_block, dst_block = cow
+                blocks_to_copy.setdefault(src_block, []).append(dst_block)
+
+    def _preempt(
+        self,
+        seq_group: SequenceGroup,
+        blocks_to_swap_out: Dict[int, int],
+        preemption_mode: Optional[PreemptionMode] = None,
+    ) -> None:
+        # Single-sequence groups recompute (cheaper than staging pages to
+        # host over PCIe); multi-sequence groups (beam/parallel) must swap
+        # because recompute cannot reproduce forked KV state.
+        if preemption_mode is None:
+            if seq_group.get_max_num_running_seqs() == 1:
+                preemption_mode = PreemptionMode.RECOMPUTE
+            else:
+                preemption_mode = PreemptionMode.SWAP
+        if preemption_mode == PreemptionMode.RECOMPUTE:
+            self._preempt_by_recompute(seq_group)
+        elif preemption_mode == PreemptionMode.SWAP:
+            self._preempt_by_swap(seq_group, blocks_to_swap_out)
+        else:
+            raise AssertionError("Invalid preemption mode.")
+
+    def _preempt_by_recompute(self, seq_group: SequenceGroup) -> None:
+        seqs = seq_group.get_seqs(status=SequenceStatus.RUNNING)
+        assert len(seqs) == 1
+        for seq in seqs:
+            seq.status = SequenceStatus.WAITING
+            self.block_manager.free(seq)
+        # FCFS: preempted groups go to the front of the waiting queue.
+        self.waiting.appendleft(seq_group)
+
+    def _preempt_by_swap(self, seq_group: SequenceGroup,
+                         blocks_to_swap_out: Dict[int, int]) -> None:
+        self._swap_out(seq_group, blocks_to_swap_out)
+        self.swapped.append(seq_group)
+
+    def _swap_in(self, seq_group: SequenceGroup,
+                 blocks_to_swap_in: Dict[int, int]) -> None:
+        mapping = self.block_manager.swap_in(seq_group)
+        blocks_to_swap_in.update(mapping)
+        for seq in seq_group.get_seqs(status=SequenceStatus.SWAPPED):
+            seq.status = SequenceStatus.RUNNING
+
+    def _swap_out(self, seq_group: SequenceGroup,
+                  blocks_to_swap_out: Dict[int, int]) -> None:
+        if not self.block_manager.can_swap_out(seq_group):
+            raise RuntimeError(
+                "Aborted due to the lack of CPU swap space. Please increase "
+                "the swap space to avoid this error.")
+        mapping = self.block_manager.swap_out(seq_group)
+        blocks_to_swap_out.update(mapping)
+        for seq in seq_group.get_seqs(status=SequenceStatus.RUNNING):
+            seq.status = SequenceStatus.SWAPPED

@@ -1,0 +1,25 @@
+"""Test configuration: run on a virtual 8-device CPU mesh.
+
+The reference has no CPU-only multi-device story (its distributed tests need
+real GPUs + Ray, SURVEY.md §4); here every sharding test runs on
+`--xla_force_host_platform_device_count=8` CPU devices, so the full TP/PP
+code path is exercised in CI without TPU hardware.
+"""
+import os
+
+# Must be set before jax is imported anywhere.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    import jax
+    devices = jax.devices()
+    assert len(devices) >= 8, devices
+    return devices

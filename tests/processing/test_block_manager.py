@@ -1,0 +1,148 @@
+"""Block manager tests (reference behavior: processing/block_manager.py)."""
+import pytest
+
+from aphrodite_tpu.common.block import Device
+from aphrodite_tpu.common.sampling_params import SamplingParams
+from aphrodite_tpu.common.sequence import (Sequence, SequenceGroup,
+                                           SequenceStatus)
+from aphrodite_tpu.processing.block_manager import (AllocStatus, BlockPool,
+                                                    BlockSpaceManager)
+
+BLOCK_SIZE = 4
+
+_seq_counter = iter(range(10_000))
+
+
+def make_group(prompt_len, num_seqs=1, request_id="0", best_of=None):
+    seqs = [
+        Sequence(next(_seq_counter), "x", list(range(prompt_len)), BLOCK_SIZE)
+        for _ in range(num_seqs)
+    ]
+    params = SamplingParams(n=num_seqs,
+                            best_of=best_of or num_seqs,
+                            temperature=1.0)
+    return SequenceGroup(request_id, seqs, params, arrival_time=0.0)
+
+
+def test_pool_alloc_free():
+    pool = BlockPool(Device.TPU, BLOCK_SIZE, 4)
+    blocks = [pool.allocate() for _ in range(4)]
+    assert pool.get_num_free_blocks() == 0
+    with pytest.raises(ValueError):
+        pool.allocate()
+    for b in blocks:
+        pool.free(b)
+    assert pool.get_num_free_blocks() == 4
+    with pytest.raises(ValueError):
+        pool.free(blocks[0])  # double free
+
+
+def test_can_allocate_watermark():
+    mgr = BlockSpaceManager(BLOCK_SIZE,
+                            num_gpu_blocks=100,
+                            num_cpu_blocks=10,
+                            watermark=0.1)
+    assert mgr.can_allocate(make_group(4 * 50)) == AllocStatus.OK
+    # Larger than total minus watermark: never schedulable.
+    assert mgr.can_allocate(make_group(4 * 95)) == AllocStatus.NEVER
+    # Fill up the pool, then a small request must wait.
+    big = make_group(4 * 85, request_id="big")
+    mgr.allocate(big)
+    assert mgr.can_allocate(make_group(4 * 10)) == AllocStatus.LATER
+
+
+def test_allocate_and_append_slot():
+    mgr = BlockSpaceManager(BLOCK_SIZE, 10, 10, watermark=0)
+    group = make_group(prompt_len=6)
+    mgr.allocate(group)
+    seq = group.get_seqs()[0]
+    seq.status = SequenceStatus.RUNNING
+    assert mgr.get_block_table(seq) is not None
+    assert len(mgr.get_block_table(seq)) == 2
+    assert mgr.get_num_free_gpu_blocks() == 8
+
+    # Append within last block: no new allocation.
+    seq.append_token_id(100, {100: 0.0})  # len 7, fits block 2
+    assert mgr.append_slot(seq) is None
+    assert mgr.get_num_free_gpu_blocks() == 8
+    # Cross the block boundary: new block allocated.
+    seq.append_token_id(101, {101: 0.0})  # len 8 -> still 2 blocks
+    assert mgr.append_slot(seq) is None
+    seq.append_token_id(102, {102: 0.0})  # len 9 -> 3 blocks
+    assert mgr.append_slot(seq) is None
+    assert mgr.get_num_free_gpu_blocks() == 7
+
+
+def test_copy_on_write_fork():
+    mgr = BlockSpaceManager(BLOCK_SIZE, 10, 10, watermark=0)
+    group = make_group(prompt_len=6, num_seqs=1, best_of=2)
+    mgr.allocate(group)
+    parent = group.get_seqs()[0]
+    parent.status = SequenceStatus.RUNNING
+    child = parent.fork(new_seq_id=100)
+    group.add(child)
+    mgr.fork(parent, child)
+    # Both tables share blocks; last block is shared => CoW on append.
+    parent.append_token_id(7, {7: 0.0})
+    cow = mgr.append_slot(parent)
+    assert cow is not None
+    src, dst = cow
+    assert src != dst
+    # Child keeps the old block; appending to child now hits ref_count 1.
+    child.append_token_id(8, {8: 0.0})
+    assert mgr.append_slot(child) is None
+
+
+def test_sliding_window_reuse():
+    mgr = BlockSpaceManager(BLOCK_SIZE,
+                            10,
+                            10,
+                            watermark=0,
+                            sliding_window=8)  # 2 blocks
+    group = make_group(prompt_len=16)  # 4 logical blocks
+    assert mgr.can_allocate(group) == AllocStatus.OK
+    mgr.allocate(group)
+    seq = group.get_seqs()[0]
+    seq.status = SequenceStatus.RUNNING
+    # Only window-worth of physical blocks were consumed.
+    assert mgr.get_num_free_gpu_blocks() == 8
+    # Appending past the window reuses blocks, never allocating.
+    for tok in range(16, 32):
+        seq.append_token_id(tok, {tok: 0.0})
+        mgr.append_slot(seq)
+    assert mgr.get_num_free_gpu_blocks() == 8
+
+
+def test_swap_roundtrip():
+    mgr = BlockSpaceManager(BLOCK_SIZE, 10, 10, watermark=0)
+    group = make_group(prompt_len=8)
+    mgr.allocate(group)
+    seq = group.get_seqs()[0]
+    seq.status = SequenceStatus.RUNNING
+    assert mgr.can_swap_out(group)
+    mapping_out = mgr.swap_out(group)
+    seq.status = SequenceStatus.SWAPPED
+    assert len(mapping_out) == 2
+    assert mgr.get_num_free_gpu_blocks() == 10
+    assert mgr.get_num_free_cpu_blocks() == 8
+    assert mgr.can_swap_in(group)
+    mapping_in = mgr.swap_in(group)
+    seq.status = SequenceStatus.RUNNING
+    assert len(mapping_in) == 2
+    assert mgr.get_num_free_cpu_blocks() == 10
+    mgr.free(seq)
+    assert mgr.get_num_free_gpu_blocks() == 10
+
+
+def test_free_and_reset():
+    mgr = BlockSpaceManager(BLOCK_SIZE, 10, 10, watermark=0)
+    g1, g2 = make_group(8, request_id="1"), make_group(8, request_id="2")
+    mgr.allocate(g1)
+    mgr.allocate(g2)
+    assert mgr.get_num_free_gpu_blocks() == 6
+    mgr.free(g1.get_seqs()[0])
+    assert mgr.get_num_free_gpu_blocks() == 8
+    # Freeing twice is a no-op.
+    mgr.free(g1.get_seqs()[0])
+    mgr.reset()
+    assert mgr.get_num_free_gpu_blocks() == 10

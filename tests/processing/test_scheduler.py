@@ -1,0 +1,141 @@
+"""Scheduler tests (reference behavior: processing/scheduler.py)."""
+from aphrodite_tpu.common.config import CacheConfig, SchedulerConfig
+from aphrodite_tpu.common.sampling_params import SamplingParams
+from aphrodite_tpu.common.sequence import (Sequence, SequenceGroup,
+                                           SequenceStatus)
+from aphrodite_tpu.processing.scheduler import Scheduler
+
+BLOCK_SIZE = 4
+
+
+def make_scheduler(num_gpu_blocks=16,
+                   num_cpu_blocks=16,
+                   max_num_seqs=8,
+                   max_num_batched_tokens=256,
+                   max_model_len=256,
+                   max_paddings=256):
+    cache_config = CacheConfig(block_size=BLOCK_SIZE)
+    cache_config.num_gpu_blocks = num_gpu_blocks
+    cache_config.num_cpu_blocks = num_cpu_blocks
+    scheduler_config = SchedulerConfig(
+        max_num_batched_tokens=max_num_batched_tokens,
+        max_num_seqs=max_num_seqs,
+        max_model_len=max_model_len,
+        max_paddings=max_paddings)
+    return Scheduler(scheduler_config, cache_config, None)
+
+
+_seq_counter = iter(range(10_000))
+
+
+def make_group(request_id, prompt_len=8, **params):
+    seq = Sequence(next(_seq_counter), "x", list(range(prompt_len)),
+                   BLOCK_SIZE)
+    return SequenceGroup(request_id, [seq], SamplingParams(**params),
+                         arrival_time=0.0)
+
+
+def append_tokens(group, n=1):
+    for seq in group.get_seqs(status=SequenceStatus.RUNNING):
+        for _ in range(n):
+            tok = seq.get_len()
+            seq.append_token_id(tok, {tok: 0.0})
+
+
+def test_prompt_batch_then_decode():
+    sched = make_scheduler()
+    g1 = make_group("r1")
+    g2 = make_group("r2")
+    sched.add_seq_group(g1)
+    sched.add_seq_group(g2)
+
+    metadata, out = sched.schedule()
+    assert out.prompt_run
+    assert [m.request_id for m in metadata] == ["r1", "r2"]
+    assert out.num_batched_tokens == 16  # 2 seqs x max_len 8 (padded cost)
+    for m in metadata:
+        assert m.is_prompt
+        assert list(m.block_tables.values())[0] is not None
+
+    append_tokens(g1)
+    append_tokens(g2)
+    metadata, out = sched.schedule()
+    assert not out.prompt_run
+    assert out.num_batched_tokens == 2
+
+
+def test_prompt_over_limit_ignored():
+    sched = make_scheduler(max_model_len=16, max_num_batched_tokens=16)
+    g = make_group("big", prompt_len=32)
+    sched.add_seq_group(g)
+    metadata, out = sched.schedule()
+    assert not metadata
+    assert out.ignored_seq_groups == [g]
+    assert g.get_seqs()[0].status == SequenceStatus.FINISHED_IGNORED
+
+
+def test_token_budget_splits_prompt_batches():
+    sched = make_scheduler(max_num_batched_tokens=256, max_model_len=256,
+                           num_gpu_blocks=1024)
+    for i in range(3):
+        sched.add_seq_group(make_group(f"r{i}", prompt_len=100))
+    _, out = sched.schedule()
+    # 3 * 100 padded = 300 > 256, so only 2 admitted.
+    assert len(list(out.scheduled_seq_groups)) == 2
+    for g in out.scheduled_seq_groups:
+        append_tokens(g)
+    # Next schedule: swapped/queued prompt r2 admitted alone.
+    _, out2 = sched.schedule()
+    assert out2.prompt_run
+    assert [g.request_id for g in out2.scheduled_seq_groups] == ["r2"]
+
+
+def test_max_num_seqs_budget():
+    sched = make_scheduler(max_num_seqs=2, num_gpu_blocks=1024)
+    for i in range(4):
+        sched.add_seq_group(make_group(f"r{i}"))
+    _, out = sched.schedule()
+    assert len(list(out.scheduled_seq_groups)) == 2
+
+
+def test_preemption_by_recompute():
+    # 4 blocks: each of 2 seqs uses 2 blocks for its 8-token prompt.
+    sched = make_scheduler(num_gpu_blocks=4, max_paddings=1024)
+    g1 = make_group("r1", prompt_len=7)
+    g2 = make_group("r2", prompt_len=7)
+    sched.add_seq_group(g1)
+    sched.add_seq_group(g2)
+    _, out = sched.schedule()
+    assert len(list(out.scheduled_seq_groups)) == 2
+    # Fill both seqs to the block boundary so next append needs a block.
+    append_tokens(g1, 2)
+    append_tokens(g2, 2)
+    _, out = sched.schedule()   # 7+2=9 tokens -> 3 blocks each; only 4 total
+    # One group got preempted by recompute back to waiting.
+    running = list(out.scheduled_seq_groups)
+    assert len(running) == 1
+    assert len(sched.waiting) == 1
+    preempted = sched.waiting[0]
+    assert preempted.get_seqs()[0].status == SequenceStatus.WAITING
+
+
+def test_abort():
+    sched = make_scheduler()
+    g = make_group("r1")
+    sched.add_seq_group(g)
+    sched.abort_seq_group("r1")
+    assert not sched.has_unfinished_seqs()
+    assert g.get_seqs()[0].status == SequenceStatus.FINISHED_ABORTED
+
+
+def test_fcfs_order_preserved_after_preempt():
+    sched = make_scheduler(num_gpu_blocks=4, max_paddings=1024)
+    g1 = make_group("r1", prompt_len=7)
+    g2 = make_group("r2", prompt_len=7)
+    sched.add_seq_group(g1)
+    sched.add_seq_group(g2)
+    sched.schedule()
+    append_tokens(g1, 2)
+    append_tokens(g2, 2)
+    sched.schedule()  # preempts g2 (newer)
+    assert sched.waiting[0].request_id == "r2"

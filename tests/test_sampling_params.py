@@ -1,0 +1,73 @@
+"""SamplingParams validation tests (reference behavior:
+aphrodite/common/sampling_params.py:160-315)."""
+import pytest
+
+from aphrodite_tpu.common.sampling_params import SamplingParams, SamplingType
+
+
+def test_defaults():
+    sp = SamplingParams()
+    assert sp.n == 1
+    assert sp.best_of == 1
+    assert sp.max_tokens == 16
+    assert sp.sampling_type == SamplingType.RANDOM
+    assert sp.stop == []
+    assert sp.stop_token_ids == []
+
+
+def test_greedy_collapses_top_pk():
+    sp = SamplingParams(temperature=0.0, top_p=0.5, top_k=10)
+    assert sp.sampling_type == SamplingType.GREEDY
+    assert sp.top_p == 1.0
+    assert sp.top_k == -1
+
+
+def test_greedy_rejects_best_of():
+    with pytest.raises(ValueError):
+        SamplingParams(temperature=0.0, best_of=4)
+
+
+def test_beam_search():
+    sp = SamplingParams(use_beam_search=True, best_of=4, temperature=0.0)
+    assert sp.sampling_type == SamplingType.BEAM
+    with pytest.raises(ValueError):
+        SamplingParams(use_beam_search=True, best_of=1, temperature=0.0)
+    with pytest.raises(ValueError):
+        SamplingParams(use_beam_search=True, best_of=4, temperature=0.7)
+
+
+def test_stop_normalization():
+    sp = SamplingParams(stop="foo")
+    assert sp.stop == ["foo"]
+    sp = SamplingParams(stop=["a", "b"])
+    assert sp.stop == ["a", "b"]
+
+
+@pytest.mark.parametrize("kwargs", [
+    dict(n=0),
+    dict(best_of=0),
+    dict(presence_penalty=3.0),
+    dict(frequency_penalty=-2.5),
+    dict(repetition_penalty=0.5),
+    dict(temperature=-0.1),
+    dict(top_p=0.0),
+    dict(top_k=0),
+    dict(top_a=-1.0),
+    dict(min_p=1.5),
+    dict(tfs=0.0),
+    dict(eta_cutoff=-1.0),
+    dict(epsilon_cutoff=2000.0),
+    dict(typical_p=0.0),
+    dict(mirostat_mode=1),
+    dict(max_tokens=0),
+    dict(logprobs=-1),
+    dict(length_penalty=2.0),
+])
+def test_invalid_args(kwargs):
+    with pytest.raises(ValueError):
+        SamplingParams(**kwargs)
+
+
+def test_mirostat_v2_allowed():
+    sp = SamplingParams(mirostat_mode=2, mirostat_tau=5.0, mirostat_eta=0.1)
+    assert sp.mirostat_mode == 2

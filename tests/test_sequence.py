@@ -1,0 +1,56 @@
+"""Sequence data model tests (reference: aphrodite/common/sequence.py)."""
+from aphrodite_tpu.common.sampling_params import SamplingParams
+from aphrodite_tpu.common.sequence import (Sequence, SequenceGroup,
+                                           SequenceStatus)
+
+
+def make_seq(seq_id=0, prompt_len=5, block_size=4):
+    return Sequence(seq_id, "x" * prompt_len, list(range(prompt_len)),
+                    block_size)
+
+
+def test_logical_blocks():
+    seq = make_seq(prompt_len=10, block_size=4)
+    assert len(seq.logical_token_blocks) == 3
+    assert seq.logical_token_blocks[-1].num_tokens == 2
+    seq.append_token_id(100, {100: -0.5})
+    seq.append_token_id(101, {101: -0.5})
+    assert len(seq.logical_token_blocks) == 3
+    seq.append_token_id(102, {102: -0.5})
+    assert len(seq.logical_token_blocks) == 4
+    assert seq.get_len() == 13
+    assert seq.get_output_len() == 3
+    assert seq.get_last_token_id() == 102
+    assert seq.get_cumulative_logprob() == -1.5
+
+
+def test_fork():
+    seq = make_seq()
+    seq.append_token_id(42, {42: -1.0})
+    child = seq.fork(7)
+    assert child.seq_id == 7
+    child.append_token_id(43, {43: -1.0})
+    assert seq.get_output_len() == 1
+    assert child.get_output_len() == 2
+
+
+def test_seq_group():
+    seqs = [make_seq(seq_id=i) for i in range(2)]
+    group = SequenceGroup("req-0", seqs, SamplingParams(n=2, best_of=2),
+                          arrival_time=0.0)
+    assert group.num_seqs() == 2
+    assert not group.is_finished()
+    seqs[0].status = SequenceStatus.FINISHED_STOPPED
+    assert group.num_unfinished_seqs() == 1
+    seqs[1].status = SequenceStatus.FINISHED_LENGTH_CAPPED
+    assert group.is_finished()
+    assert SequenceStatus.get_finished_reason(seqs[0].status) == "stop"
+    assert SequenceStatus.get_finished_reason(seqs[1].status) == "length"
+
+
+def test_max_num_running_seqs_prompt_stage():
+    seq = make_seq()
+    group = SequenceGroup("req-1", [seq], SamplingParams(n=2, best_of=4),
+                          arrival_time=0.0)
+    # Prompt stage: best_of children will fork.
+    assert group.get_max_num_running_seqs() == 4
