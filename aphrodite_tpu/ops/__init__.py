@@ -1,0 +1,18 @@
+"""Device ops: KV-cache page updates, paged attention, sampling kernels.
+
+TPU-native replacements for the reference's CUDA `kernels/` tree
+(SURVEY.md §2.2): jnp/XLA implementations everywhere (they fuse well and
+run on CPU for tests) with Pallas fast paths for the bandwidth-bound hot
+ops (paged decode attention) under `ops/pallas/`.
+"""
+
+from aphrodite_tpu.ops.kv_cache import (copy_blocks, write_to_kv_cache)
+from aphrodite_tpu.ops.attention import (paged_decode_attention_ref,
+                                         prefill_attention)
+
+__all__ = [
+    "write_to_kv_cache",
+    "copy_blocks",
+    "prefill_attention",
+    "paged_decode_attention_ref",
+]

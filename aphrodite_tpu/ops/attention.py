@@ -1,0 +1,141 @@
+"""Attention ops: prefill (dense causal) and paged decode.
+
+jnp/XLA implementations — correctness baselines that run on CPU and
+compile on TPU. The bandwidth-optimal Pallas decode kernel lives in
+`ops/pallas/paged_attention.py`; `aphrodite_tpu.modeling.layers.attention`
+dispatches between them.
+
+Reference equivalents: xformers prompt path + ALiBi/sliding-window masks
+(`modeling/layers/attention.py:104-161`), paged_attention_v1/v2 decode
+kernels (`kernels/attention/attention_kernels.cu:717,907`), prefix-prefill
+context attention (`triton_kernel/prefix_prefill.py:609`).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = float("-inf")
+
+
+def make_causal_mask(
+    seq_len: int,
+    context_len: jax.Array,      # [batch] tokens already cached (prefix)
+    kv_len: int,
+    sliding_window: Optional[int] = None,
+) -> jax.Array:
+    """Boolean [batch, seq_len, kv_len] mask: True = attend.
+
+    Query position i (0-based within the new chunk) has absolute position
+    context_len + i; it may attend to kv positions <= its absolute
+    position, within the sliding window if set.
+    """
+    q_pos = jax.lax.broadcasted_iota(jnp.int32, (seq_len, kv_len), 0)
+    kv_pos = jax.lax.broadcasted_iota(jnp.int32, (seq_len, kv_len), 1)
+    # [batch, seq, kv]
+    abs_q = q_pos[None] + context_len[:, None, None]
+    mask = kv_pos[None] <= abs_q
+    if sliding_window is not None:
+        mask &= kv_pos[None] > (abs_q - sliding_window)
+    return mask
+
+
+def make_alibi_bias(alibi_slopes: jax.Array, kv_len: int) -> jax.Array:
+    """[num_heads, 1, kv_len] additive bias (reference
+    `layers/attention.py:196`): bias depends on kv absolute position."""
+    positions = jnp.arange(kv_len, dtype=jnp.float32)
+    return alibi_slopes[:, None, None] * positions[None, None, :]
+
+
+def _grouped_scores(q: jax.Array, k: jax.Array, scale: float) -> jax.Array:
+    """q [b, s, Hq, d] x k [b, kv, Hkv, d] -> scores [b, Hq, s, kv]
+    with GQA head grouping (Hq = Hkv * group)."""
+    b, s, num_q_heads, d = q.shape
+    num_kv_heads = k.shape[2]
+    group = num_q_heads // num_kv_heads
+    qg = q.reshape(b, s, num_kv_heads, group, d)
+    scores = jnp.einsum("bskgd,btkd->bkgst", qg.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    return scores.reshape(b, num_q_heads, s, k.shape[1])
+
+
+def prefill_attention(
+    q: jax.Array,                 # [batch, seq, num_q_heads, head_dim]
+    k: jax.Array,                 # [batch, kv_len, num_kv_heads, head_dim]
+    v: jax.Array,                 # [batch, kv_len, num_kv_heads, head_dim]
+    context_lens: jax.Array,      # [batch] prefix lengths (0 for plain)
+    kv_valid_lens: jax.Array,     # [batch] valid kv entries (rest padded)
+    scale: float,
+    sliding_window: Optional[int] = None,
+    alibi_slopes: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Dense causal attention for prompt chunks, GQA-aware.
+
+    Handles both plain prefill (context_lens=0, kv = this chunk's K/V) and
+    prefix-cached prefill (kv = [prefix ; chunk], context_lens = prefix
+    lengths). Padded kv entries (>= kv_valid_lens) are masked out.
+    Softmax accumulates in float32 regardless of input dtype.
+    """
+    b, s, num_q_heads, d = q.shape
+    kv_len = k.shape[1]
+    scores = _grouped_scores(q, k, scale)  # [b, H, s, kv] f32
+
+    mask = make_causal_mask(s, context_lens, kv_len, sliding_window)
+    kv_pos = jnp.arange(kv_len)[None, None, :]
+    mask &= kv_pos < kv_valid_lens[:, None, None]
+
+    if alibi_slopes is not None:
+        scores += make_alibi_bias(alibi_slopes, kv_len)[None]
+
+    scores = jnp.where(mask[:, None], scores, _NEG_INF)
+    # Fully-masked rows (padding queries) are all -inf -> NaN; zero them.
+    weights = jnp.nan_to_num(jax.nn.softmax(scores, axis=-1))
+
+    num_kv_heads = k.shape[2]
+    group = num_q_heads // num_kv_heads
+    wg = weights.reshape(b, num_kv_heads, group, s, kv_len)
+    out = jnp.einsum("bkgst,btkd->bskgd", wg, v.astype(jnp.float32))
+    return out.reshape(b, s, num_q_heads, d).astype(q.dtype)
+
+
+def paged_decode_attention_ref(
+    q: jax.Array,              # [batch, num_q_heads, head_dim]
+    k_pages: jax.Array,        # [num_kv_heads, num_pages, page_size, dim]
+    v_pages: jax.Array,
+    block_tables: jax.Array,   # [batch, pages_per_seq] int32 (OOB padded)
+    context_lens: jax.Array,   # [batch]
+    scale: float,
+    alibi_slopes: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Decode attention over the paged cache — jnp reference path.
+
+    Gathers each sequence's pages then runs masked attention. Correct
+    everywhere; materializes the gathered KV (extra HBM traffic) which the
+    Pallas kernel avoids.
+    """
+    from aphrodite_tpu.ops.kv_cache import gather_pages
+    b, num_q_heads, d = q.shape
+    num_kv_heads = k_pages.shape[0]
+    group = num_q_heads // num_kv_heads
+
+    k = gather_pages(k_pages, block_tables)  # [b, Hkv, ctx, d]
+    v = gather_pages(v_pages, block_tables)
+    ctx = k.shape[2]
+
+    qg = q.reshape(b, num_kv_heads, group, d)
+    scores = jnp.einsum("bkgd,bktd->bkgt", qg.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale  # [b,Hkv,g,ctx]
+
+    if alibi_slopes is not None:
+        # [Hq, 1, ctx] -> [1, Hkv, group, ctx] (q head h = kv*group + g)
+        bias = make_alibi_bias(alibi_slopes, ctx)
+        scores += bias.reshape(1, num_kv_heads, group, ctx)
+
+    positions = jnp.arange(ctx)[None, None, None, :]
+    mask = positions < context_lens[:, None, None, None]
+    scores = jnp.where(mask, scores, _NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgt,bktd->bkgd", weights, v.astype(jnp.float32))
+    return out.reshape(b, num_q_heads, d).astype(q.dtype)

@@ -1,0 +1,95 @@
+"""Paged KV-cache device ops.
+
+TPU-native equivalents of the reference CUDA cache kernels
+(`kernels/cache_kernels.cu:14,88,221` — swap_blocks/copy_blocks/
+reshape_and_cache). Layout choice: per layer the cache is a pair of page
+arrays
+
+    k_pages, v_pages: [num_kv_heads, num_pages, page_size, head_dim]
+
+so that (page_size, head_dim) tiles DMA contiguously into VMEM, the
+kv-head axis shards cleanly over the TP mesh axis, and one page is one
+natural unit for the Pallas decode kernel's scalar-prefetched gather.
+(The reference's [blocks, heads, head/x, block, x] layout is a CUDA
+coalescing trick with no TPU analog.)
+
+All ops are functional (return new arrays); under jit the engine donates
+the page buffers so XLA performs the scatter in place — no copies of the
+multi-GB cache per step (SURVEY.md §7 "in-place KV updates under jit").
+
+Padding convention: invalid slots/indices are encoded as OUT-OF-RANGE
+values (>= num_slots); every scatter/gather uses mode='drop' (scatter) or
+'fill' (gather) so padded lanes are no-ops. Negative sentinels are NOT
+used — JAX wraps negative indices.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def write_to_kv_cache(
+    key: jax.Array,        # [num_tokens, num_kv_heads, head_dim]
+    value: jax.Array,      # [num_tokens, num_kv_heads, head_dim]
+    k_pages: jax.Array,    # [num_kv_heads, num_pages, page_size, head_dim]
+    v_pages: jax.Array,    # [num_kv_heads, num_pages, page_size, head_dim]
+    slot_mapping: jax.Array,  # [num_tokens] int32; pad with num_slots (OOB)
+) -> Tuple[jax.Array, jax.Array]:
+    """Scatter freshly computed K/V for each token into its cache slot.
+
+    Equivalent of `reshape_and_cache` (`kernels/cache_kernels.cu:221`).
+    slot = page_index * page_size + page_offset; padded entries must be
+    >= num_pages*page_size so mode='drop' discards them.
+    """
+    num_kv_heads, num_pages, page_size, head_dim = k_pages.shape
+    k_flat = k_pages.reshape(num_kv_heads, num_pages * page_size, head_dim)
+    v_flat = v_pages.reshape(num_kv_heads, num_pages * page_size, head_dim)
+
+    # [num_tokens, heads, dim] -> [heads, num_tokens, dim]
+    key_ht = key.astype(k_pages.dtype).swapaxes(0, 1)
+    value_ht = value.astype(v_pages.dtype).swapaxes(0, 1)
+
+    k_flat = k_flat.at[:, slot_mapping, :].set(key_ht, mode="drop")
+    v_flat = v_flat.at[:, slot_mapping, :].set(value_ht, mode="drop")
+    return (k_flat.reshape(k_pages.shape), v_flat.reshape(v_pages.shape))
+
+
+def copy_blocks(
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    src_indices: jax.Array,   # [num_copies] int32; pad with num_pages (OOB)
+    dst_indices: jax.Array,   # [num_copies] int32; pad with num_pages (OOB)
+) -> Tuple[jax.Array, jax.Array]:
+    """Batched on-device page copies for copy-on-write forks.
+
+    Equivalent of `copy_blocks` (`kernels/cache_kernels.cu:88`), executed
+    as one gather + one scatter per cache side instead of a kernel launch
+    per pair.
+    """
+    src_k = jnp.take(k_pages, src_indices, axis=1, mode="fill",
+                     fill_value=0)
+    src_v = jnp.take(v_pages, src_indices, axis=1, mode="fill",
+                     fill_value=0)
+    k_pages = k_pages.at[:, dst_indices].set(src_k, mode="drop")
+    v_pages = v_pages.at[:, dst_indices].set(src_v, mode="drop")
+    return k_pages, v_pages
+
+
+def gather_pages(
+    pages: jax.Array,         # [num_kv_heads, num_pages, page_size, head_dim]
+    page_indices: jax.Array,  # [num_seqs, pages_per_seq]; pad with OOB
+) -> jax.Array:
+    """Gather each sequence's pages: -> [num_seqs, num_kv_heads,
+    pages_per_seq * page_size, head_dim]. Used by the jnp reference
+    attention path and by host-side swap staging."""
+    num_kv_heads, _, page_size, head_dim = pages.shape
+    num_seqs, pages_per_seq = page_indices.shape
+    # [heads, seqs, pages_per_seq, page_size, dim]
+    gathered = jnp.take(pages, page_indices.reshape(-1), axis=1, mode="fill",
+                        fill_value=0)
+    gathered = gathered.reshape(num_kv_heads, num_seqs, pages_per_seq,
+                                page_size, head_dim)
+    return gathered.transpose(1, 0, 2, 3, 4).reshape(
+        num_seqs, num_kv_heads, pages_per_seq * page_size, head_dim)

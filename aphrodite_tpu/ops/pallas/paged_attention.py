@@ -1,0 +1,212 @@
+"""Pallas TPU flash-decoding kernel over the paged KV cache.
+
+TPU-native replacement for the reference's PagedAttention V1/V2 CUDA
+kernels (`kernels/attention/attention_kernels.cu:717,907`, 951 lines of
+FasterTransformer-derived CUDA). Design differences, not a translation:
+
+- One grid cell per (sequence, kv_head); GQA query groups ride along as
+  the sublane dimension so every MXU matmul is [group, d] x [d, chunk].
+- The block table is a **scalar-prefetch** argument: page indices are
+  known before the kernel body runs, so pages DMA directly from HBM into
+  a double-buffered VMEM scratch (chunk c+1 streams in while chunk c is
+  computed) — the analog of V2's 512-token sequence partitioning is the
+  chunked online softmax, but without the separate reduce kernel: the
+  running (m, l, acc) state never leaves VMEM.
+- Sequences shorter than the padded page count cost only their true
+  length: the chunk loop bound is ceil(context_len / chunk_tokens),
+  computed per sequence from the prefetched scalars.
+
+Padded block-table entries must point at any valid page (use 0); padded
+positions are masked to -inf before the online-softmax update, and the
+cache is zero-initialized, so garbage pages never produce NaNs.
+
+ALiBi models use the jnp reference path for now
+(`ops/attention.py:paged_decode_attention_ref`).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -2.0**30  # large-but-finite: avoids inf-inf NaNs in corrections
+
+
+def _decode_kernel(
+    # scalar prefetch
+    block_tables_ref,   # [batch, pages_per_seq] int32 (SMEM)
+    context_lens_ref,   # [batch] int32 (SMEM)
+    # inputs
+    q_ref,              # [1, 1, group, head_dim] VMEM
+    k_hbm,              # [num_kv_heads, num_pages, page_size, d] ANY/HBM
+    v_hbm,
+    # outputs
+    out_ref,            # [1, group, head_dim] VMEM
+    # scratch
+    k_buf,              # [2, chunk_tokens, d] VMEM (kv dtype)
+    v_buf,
+    sems,               # DMA sems [2, 2]
+    acc_scr,            # [group, d] f32
+    m_scr,              # [group, 128] f32
+    l_scr,              # [group, 128] f32
+    *,
+    pages_per_chunk: int,
+    page_size: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    chunk_tokens = pages_per_chunk * page_size
+    ctx = context_lens_ref[b]
+    num_chunks = (ctx + chunk_tokens - 1) // chunk_tokens
+
+    def chunk_dmas(c, slot):
+        copies = []
+        for p in range(pages_per_chunk):  # static unroll
+            page_idx = block_tables_ref[b, c * pages_per_chunk + p]
+            dst = pl.ds(p * page_size, page_size)
+            copies.append(
+                pltpu.make_async_copy(k_hbm.at[h, page_idx],
+                                      k_buf.at[slot, dst, :],
+                                      sems.at[slot, 0]))
+            copies.append(
+                pltpu.make_async_copy(v_hbm.at[h, page_idx],
+                                      v_buf.at[slot, dst, :],
+                                      sems.at[slot, 1]))
+        return copies
+
+    def start_chunk(c, slot):
+        for dma in chunk_dmas(c, slot):
+            dma.start()
+
+    def wait_chunk(c, slot):
+        for dma in chunk_dmas(c, slot):
+            dma.wait()
+
+    acc_scr[...] = jnp.zeros_like(acc_scr)
+    m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+    l_scr[...] = jnp.zeros_like(l_scr)
+
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # [group, d]
+
+    # Padded batch rows may have ctx == 0: no DMA may start, because the
+    # matching wait never runs and scratch semaphores persist across grid
+    # cells on hardware.
+    @pl.when(num_chunks > 0)
+    def _():
+        start_chunk(0, 0)
+
+    def body(c, _):
+        slot = jax.lax.rem(c, 2)
+
+        @pl.when(c + 1 < num_chunks)
+        def _():
+            start_chunk(c + 1, jax.lax.rem(c + 1, 2))
+
+        wait_chunk(c, slot)
+
+        k = k_buf[slot].astype(jnp.float32)  # [chunk, d]
+        v = v_buf[slot].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1, ), (1, )), ((), ())),
+            preferred_element_type=jnp.float32)  # [group, chunk]
+
+        pos = c * chunk_tokens + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(pos < ctx, s, _NEG_INF)
+
+        m_prev = m_scr[:, :1]                        # [group, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)    # [group, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        corr = jnp.exp(m_prev - m_new)               # [group, 1]
+        p_exp = jnp.exp(s - m_new)                   # [group, chunk]
+        # Re-mask: padded lanes got exp(NEG_INF - m) which underflows to 0
+        # already, but keep it explicit for the all-padded-chunk case.
+        p_exp = jnp.where(pos < ctx, p_exp, 0.0)
+
+        l_prev = l_scr[:, :1]
+        l_new = l_prev * corr + jnp.sum(p_exp, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p_exp, v, (((1, ), (0, )), ((), ())),
+            preferred_element_type=jnp.float32)      # [group, d]
+        acc_scr[...] = acc_scr[...] * corr + pv
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    jax.lax.fori_loop(0, num_chunks, body, None)
+
+    l_final = l_scr[:, :1]
+    l_safe = jnp.where(l_final == 0.0, 1.0, l_final)
+    out_ref[0, 0] = (acc_scr[...] / l_safe).astype(out_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "pages_per_chunk", "interpret"))
+def paged_decode_attention(
+    q: jax.Array,             # [batch, num_q_heads, head_dim]
+    k_pages: jax.Array,       # [num_kv_heads, num_pages, page_size, d]
+    v_pages: jax.Array,
+    block_tables: jax.Array,  # [batch, pages_per_seq] int32, 0-padded
+    context_lens: jax.Array,  # [batch] int32
+    *,
+    scale: float,
+    pages_per_chunk: int = 8,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash-decoding attention over HBM KV pages. See module docstring."""
+    batch, num_q_heads, head_dim = q.shape
+    num_kv_heads, num_pages, page_size, _ = k_pages.shape
+    pages_per_seq = block_tables.shape[1]
+    if num_q_heads % num_kv_heads != 0:
+        raise ValueError(f"{num_q_heads=} not divisible by {num_kv_heads=}")
+    group = num_q_heads // num_kv_heads
+    if pages_per_seq % pages_per_chunk != 0:
+        raise ValueError(
+            f"{pages_per_seq=} must be a multiple of {pages_per_chunk=} "
+            "(pad the block table).")
+    chunk_tokens = pages_per_chunk * page_size
+
+    grid = (batch, num_kv_heads)
+    # q viewed as [batch, num_kv_heads, group, head_dim]
+    q_grouped = q.reshape(batch, num_kv_heads, group, head_dim)
+
+    kernel = functools.partial(
+        _decode_kernel,
+        pages_per_chunk=pages_per_chunk,
+        page_size=page_size,
+        scale=scale,
+    )
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, group, head_dim),
+                         lambda b, h, *_: (b, h, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, 1, group, head_dim),
+                               lambda b, h, *_: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, chunk_tokens, head_dim), k_pages.dtype),
+            pltpu.VMEM((2, chunk_tokens, head_dim), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.VMEM((group, head_dim), jnp.float32),
+            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((group, 128), jnp.float32),
+        ],
+    )
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (batch, num_kv_heads, group, head_dim), q.dtype),
+        interpret=interpret,
+    )(block_tables, context_lens, q_grouped, k_pages, v_pages)
+    return out.reshape(batch, num_q_heads, head_dim)

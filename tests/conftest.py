@@ -14,12 +14,17 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
+import jax  # noqa: E402
+
+# The environment's sitecustomize registers the TPU tunnel backend and
+# overrides JAX_PLATFORMS; force CPU at the config level too.
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
 def cpu_devices():
-    import jax
     devices = jax.devices()
     assert len(devices) >= 8, devices
     return devices

@@ -1,0 +1,186 @@
+"""Attention op tests: jnp implementations vs a numpy oracle that walks
+block tables in Python (mirrors the reference's
+ref_single_query_cached_kv_attention, tests/kernels/test_attention.py:45-99),
+plus the Pallas kernel in interpret mode vs the jnp reference."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aphrodite_tpu.ops.attention import (paged_decode_attention_ref,
+                                         prefill_attention)
+from aphrodite_tpu.ops.pallas.paged_attention import paged_decode_attention
+
+
+def numpy_paged_attention(q, k_pages, v_pages, block_tables, context_lens,
+                          scale, alibi_slopes=None):
+    """Oracle: per-sequence python loop over the block table."""
+    batch, num_q_heads, dim = q.shape
+    num_kv_heads, _, page_size, _ = k_pages.shape
+    group = num_q_heads // num_kv_heads
+    out = np.zeros_like(q, dtype=np.float32)
+    for b in range(batch):
+        ctx = int(context_lens[b])
+        keys, values = [], []
+        for pos in range(ctx):
+            page = block_tables[b][pos // page_size]
+            off = pos % page_size
+            keys.append(k_pages[:, page, off])    # [Hkv, dim]
+            values.append(v_pages[:, page, off])
+        keys = np.stack(keys, axis=1)     # [Hkv, ctx, dim]
+        values = np.stack(values, axis=1)
+        for h in range(num_q_heads):
+            kv_h = h // group
+            scores = keys[kv_h] @ q[b, h] * scale  # [ctx]
+            if alibi_slopes is not None:
+                scores = scores + alibi_slopes[h] * np.arange(ctx)
+            scores = scores - scores.max()
+            probs = np.exp(scores) / np.exp(scores).sum()
+            out[b, h] = probs @ values[kv_h]
+    return out
+
+
+def make_problem(batch=3, num_q_heads=4, num_kv_heads=2, dim=32,
+                 pages=16, page_size=4, pages_per_seq=8, seed=0):
+    rng = np.random.default_rng(seed)
+    q = rng.normal(size=(batch, num_q_heads, dim)).astype(np.float32)
+    k_pages = rng.normal(size=(num_kv_heads, pages, page_size,
+                               dim)).astype(np.float32)
+    v_pages = rng.normal(size=(num_kv_heads, pages, page_size,
+                               dim)).astype(np.float32)
+    context_lens = rng.integers(1, pages_per_seq * page_size,
+                                size=(batch, )).astype(np.int32)
+    block_tables = np.zeros((batch, pages_per_seq), dtype=np.int32)
+    for b in range(batch):
+        n_pages = -(-int(context_lens[b]) // page_size)
+        # Distinct pages per sequence, as the block manager guarantees.
+        block_tables[b, :n_pages] = rng.choice(pages, n_pages,
+                                               replace=False)
+    return q, k_pages, v_pages, block_tables, context_lens
+
+
+@pytest.mark.parametrize("num_q_heads,num_kv_heads", [(4, 4), (4, 2), (8, 1)])
+def test_paged_decode_ref_matches_oracle(num_q_heads, num_kv_heads):
+    q, k_pages, v_pages, bt, ctx = make_problem(num_q_heads=num_q_heads,
+                                                num_kv_heads=num_kv_heads)
+    scale = 0.3
+    expected = numpy_paged_attention(q, k_pages, v_pages, bt, ctx, scale)
+    got = paged_decode_attention_ref(jnp.array(q), jnp.array(k_pages),
+                                     jnp.array(v_pages), jnp.array(bt),
+                                     jnp.array(ctx), scale)
+    np.testing.assert_allclose(np.array(got), expected, rtol=2e-5, atol=2e-5)
+
+
+def test_paged_decode_ref_alibi():
+    q, k_pages, v_pages, bt, ctx = make_problem(num_q_heads=4,
+                                                num_kv_heads=2)
+    slopes = np.array([0.5, 0.25, 0.125, 0.0625], dtype=np.float32)
+    expected = numpy_paged_attention(q, k_pages, v_pages, bt, ctx, 0.5,
+                                     alibi_slopes=slopes)
+    got = paged_decode_attention_ref(jnp.array(q), jnp.array(k_pages),
+                                     jnp.array(v_pages), jnp.array(bt),
+                                     jnp.array(ctx), 0.5,
+                                     alibi_slopes=jnp.array(slopes))
+    np.testing.assert_allclose(np.array(got), expected, rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("num_q_heads,num_kv_heads,pages_per_chunk",
+                         [(4, 4, 2), (4, 2, 4), (8, 1, 8), (8, 2, 1)])
+def test_pallas_decode_matches_ref(num_q_heads, num_kv_heads,
+                                   pages_per_chunk):
+    q, k_pages, v_pages, bt, ctx = make_problem(num_q_heads=num_q_heads,
+                                                num_kv_heads=num_kv_heads,
+                                                dim=128, page_size=8,
+                                                pages_per_seq=8, pages=32)
+    scale = 1.0 / np.sqrt(128)
+    expected = numpy_paged_attention(q, k_pages, v_pages, bt, ctx, scale)
+    got = paged_decode_attention(jnp.array(q), jnp.array(k_pages),
+                                 jnp.array(v_pages), jnp.array(bt),
+                                 jnp.array(ctx),
+                                 scale=scale,
+                                 pages_per_chunk=pages_per_chunk,
+                                 interpret=True)
+    np.testing.assert_allclose(np.array(got), expected, rtol=2e-3, atol=2e-3)
+
+
+def test_pallas_decode_short_context():
+    """ctx=1 (single token) exercises the single-chunk path."""
+    q, k_pages, v_pages, bt, ctx = make_problem(dim=128, page_size=8,
+                                                pages_per_seq=8, pages=32)
+    ctx = np.ones_like(ctx)
+    expected = numpy_paged_attention(q, k_pages, v_pages, bt, ctx, 0.1)
+    got = paged_decode_attention(jnp.array(q), jnp.array(k_pages),
+                                 jnp.array(v_pages), jnp.array(bt),
+                                 jnp.array(ctx), scale=0.1,
+                                 pages_per_chunk=2, interpret=True)
+    np.testing.assert_allclose(np.array(got), expected, rtol=2e-3, atol=2e-3)
+
+
+def numpy_prefill(q, k, v, context_lens, kv_valid, scale, window=None,
+                  slopes=None):
+    b, s, Hq, d = q.shape
+    Hkv = k.shape[2]
+    group = Hq // Hkv
+    out = np.zeros_like(q, dtype=np.float32)
+    for bi in range(b):
+        for h in range(Hq):
+            kh = h // group
+            for i in range(s):
+                abs_q = context_lens[bi] + i
+                scores = []
+                idxs = []
+                for t in range(int(kv_valid[bi])):
+                    if t > abs_q:
+                        continue
+                    if window is not None and t <= abs_q - window:
+                        continue
+                    sc = q[bi, i, h] @ k[bi, t, kh] * scale
+                    if slopes is not None:
+                        sc += slopes[h] * t
+                    scores.append(sc)
+                    idxs.append(t)
+                scores = np.array(scores)
+                probs = np.exp(scores - scores.max())
+                probs /= probs.sum()
+                out[bi, i, h] = sum(p * v[bi, t, kh]
+                                    for p, t in zip(probs, idxs))
+    return out
+
+
+@pytest.mark.parametrize("window", [None, 6])
+def test_prefill_attention(window):
+    rng = np.random.default_rng(3)
+    b, s, Hq, Hkv, d = 2, 8, 4, 2, 16
+    q = rng.normal(size=(b, s, Hq, d)).astype(np.float32)
+    k = rng.normal(size=(b, s, Hkv, d)).astype(np.float32)
+    v = rng.normal(size=(b, s, Hkv, d)).astype(np.float32)
+    ctx = np.zeros(b, dtype=np.int32)
+    kv_valid = np.array([s, s - 3], dtype=np.int32)
+    scale = 1 / np.sqrt(d)
+    expected = numpy_prefill(q, k, v, ctx, kv_valid, scale, window=window)
+    got = prefill_attention(jnp.array(q), jnp.array(k), jnp.array(v),
+                            jnp.array(ctx), jnp.array(kv_valid), scale,
+                            sliding_window=window)
+    # Padded query rows (i >= kv_valid) are unspecified; compare valid only.
+    for bi in range(b):
+        np.testing.assert_allclose(np.array(got)[bi, :kv_valid[bi]],
+                                   expected[bi, :kv_valid[bi]],
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_prefill_with_prefix_context():
+    """Prefix-cached prefill: kv = [prefix ; chunk], context_lens > 0
+    (the reference's triton context_attention_fwd case)."""
+    rng = np.random.default_rng(4)
+    b, s_new, prefix, Hq, Hkv, d = 2, 4, 6, 4, 2, 16
+    kv_len = prefix + s_new
+    q = rng.normal(size=(b, s_new, Hq, d)).astype(np.float32)
+    k = rng.normal(size=(b, kv_len, Hkv, d)).astype(np.float32)
+    v = rng.normal(size=(b, kv_len, Hkv, d)).astype(np.float32)
+    ctx = np.full(b, prefix, dtype=np.int32)
+    kv_valid = np.full(b, kv_len, dtype=np.int32)
+    scale = 1 / np.sqrt(d)
+    expected = numpy_prefill(q, k, v, ctx, kv_valid, scale)
+    got = prefill_attention(jnp.array(q), jnp.array(k), jnp.array(v),
+                            jnp.array(ctx), jnp.array(kv_valid), scale)
+    np.testing.assert_allclose(np.array(got), expected, rtol=2e-5,
+                               atol=2e-5)

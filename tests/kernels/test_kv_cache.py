@@ -1,0 +1,78 @@
+"""KV-cache op tests vs numpy oracles (reference test model:
+tests/kernels/test_cache.py walks block tables in Python)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aphrodite_tpu.ops.kv_cache import (copy_blocks, gather_pages,
+                                        write_to_kv_cache)
+
+HEADS, PAGES, PAGE_SIZE, DIM = 2, 8, 4, 8
+
+
+def make_pages(seed=0):
+    rng = np.random.default_rng(seed)
+    k = rng.normal(size=(HEADS, PAGES, PAGE_SIZE, DIM)).astype(np.float32)
+    v = rng.normal(size=(HEADS, PAGES, PAGE_SIZE, DIM)).astype(np.float32)
+    return jnp.array(k), jnp.array(v)
+
+
+def test_write_to_kv_cache():
+    k_pages, v_pages = make_pages()
+    rng = np.random.default_rng(1)
+    num_tokens = 5
+    key = rng.normal(size=(num_tokens, HEADS, DIM)).astype(np.float32)
+    value = rng.normal(size=(num_tokens, HEADS, DIM)).astype(np.float32)
+    slots = np.array([0, 5, 13, 31, PAGES * PAGE_SIZE], dtype=np.int32)
+
+    new_k, new_v = write_to_kv_cache(jnp.array(key), jnp.array(value),
+                                     k_pages, v_pages, jnp.array(slots))
+
+    expected_k = np.array(k_pages).reshape(HEADS, -1, DIM)
+    expected_v = np.array(v_pages).reshape(HEADS, -1, DIM)
+    for i, slot in enumerate(slots[:-1]):  # last is OOB padding -> dropped
+        expected_k[:, slot] = key[i]
+        expected_v[:, slot] = value[i]
+    np.testing.assert_allclose(
+        np.array(new_k), expected_k.reshape(HEADS, PAGES, PAGE_SIZE, DIM))
+    np.testing.assert_allclose(
+        np.array(new_v), expected_v.reshape(HEADS, PAGES, PAGE_SIZE, DIM))
+
+
+def test_write_oob_dropped():
+    k_pages, v_pages = make_pages()
+    key = jnp.ones((2, HEADS, DIM))
+    slots = jnp.array([PAGES * PAGE_SIZE, PAGES * PAGE_SIZE + 7],
+                      dtype=jnp.int32)
+    new_k, new_v = write_to_kv_cache(key, key, k_pages, v_pages, slots)
+    np.testing.assert_allclose(np.array(new_k), np.array(k_pages))
+    np.testing.assert_allclose(np.array(new_v), np.array(v_pages))
+
+
+def test_copy_blocks():
+    k_pages, v_pages = make_pages()
+    src = jnp.array([1, 3, PAGES], dtype=jnp.int32)  # last pair padded
+    dst = jnp.array([6, 7, PAGES], dtype=jnp.int32)
+    new_k, new_v = copy_blocks(k_pages, v_pages, src, dst)
+    expected_k = np.array(k_pages)
+    expected_v = np.array(v_pages)
+    expected_k[:, 6] = expected_k[:, 1]
+    expected_k[:, 7] = expected_k[:, 3]
+    expected_v[:, 6] = expected_v[:, 1]
+    expected_v[:, 7] = expected_v[:, 3]
+    np.testing.assert_allclose(np.array(new_k), expected_k)
+    np.testing.assert_allclose(np.array(new_v), expected_v)
+
+
+def test_gather_pages():
+    k_pages, _ = make_pages()
+    tables = jnp.array([[2, 0, PAGES, PAGES], [5, 6, 7, PAGES]],
+                       dtype=jnp.int32)
+    out = gather_pages(k_pages, tables)
+    assert out.shape == (2, HEADS, 4 * PAGE_SIZE, DIM)
+    np.testing.assert_allclose(np.array(out[0, :, :PAGE_SIZE]),
+                               np.array(k_pages[:, 2]))
+    np.testing.assert_allclose(np.array(out[1, :, PAGE_SIZE:2 * PAGE_SIZE]),
+                               np.array(k_pages[:, 6]))
+    # OOB-padded pages fill with zeros.
+    np.testing.assert_allclose(np.array(out[0, :, 2 * PAGE_SIZE:]), 0.0)
