@@ -1,0 +1,1 @@
+"""Model layer: functional JAX modules, model zoo, sampler, weight loading."""

@@ -1,0 +1,167 @@
+"""Checkpoint weight iteration + device placement.
+
+Reference: `aphrodite/modeling/hf_downloader.py` (hf_model_weights_iterator
+`:285`, dummy weights `:377`) and the npcache/safetensors streaming logic.
+
+TPU-first: weights stream tensor-by-tensor from disk (never materializing
+the whole checkpoint), are assembled host-side into the model's merged
+layout, then `jax.device_put` with NamedShardings places each parameter
+directly into its shard — each device only receives its slice, which is
+what lets 13B+ load onto small-HBM chips (SURVEY.md §7 "weight-streaming
+into shards").
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Dict, Iterator, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from aphrodite_tpu.common.logger import init_logger
+
+logger = init_logger(__name__)
+
+_TORCH_NP_DTYPES = {
+    "torch.float16": np.float16,
+    "torch.float32": np.float32,
+    "torch.int8": np.int8,
+    "torch.int32": np.int32,
+    "torch.int64": np.int64,
+}
+
+
+def _bf16_to_f32(raw: np.ndarray) -> np.ndarray:
+    """View uint16 bfloat16 payload as float32 (numpy lacks bfloat16)."""
+    u32 = raw.astype(np.uint32) << 16
+    return u32.view(np.float32)
+
+
+def safetensors_weights_iterator(
+        path: str) -> Iterator[Tuple[str, np.ndarray]]:
+    """Stream tensors from *.safetensors without torch.
+
+    Parses the safetensors header directly (8-byte length + JSON) and
+    memory-maps tensor data, so peak host memory is one tensor.
+    """
+    files = sorted(glob.glob(os.path.join(path, "*.safetensors")))
+    for fname in files:
+        with open(fname, "rb") as f:
+            header_len = int.from_bytes(f.read(8), "little")
+            header = json.loads(f.read(header_len))
+        data_offset = 8 + header_len
+        mm = np.memmap(fname, dtype=np.uint8, mode="r")
+        for name, info in header.items():
+            if name == "__metadata__":
+                continue
+            start, end = info["data_offsets"]
+            buf = mm[data_offset + start:data_offset + end]
+            dtype = info["dtype"]
+            shape = info["shape"]
+            if dtype == "BF16":
+                arr = _bf16_to_f32(
+                    np.frombuffer(buf, dtype=np.uint16).reshape(shape))
+            elif dtype == "F16":
+                arr = np.frombuffer(buf, dtype=np.float16).reshape(shape)
+            elif dtype == "F32":
+                arr = np.frombuffer(buf, dtype=np.float32).reshape(shape)
+            elif dtype == "I64":
+                arr = np.frombuffer(buf, dtype=np.int64).reshape(shape)
+            elif dtype == "I32":
+                arr = np.frombuffer(buf, dtype=np.int32).reshape(shape)
+            elif dtype == "I8":
+                arr = np.frombuffer(buf, dtype=np.int8).reshape(shape)
+            elif dtype == "U8":
+                arr = np.frombuffer(buf, dtype=np.uint8).reshape(shape)
+            else:
+                raise ValueError(f"Unsupported safetensors dtype {dtype}")
+            yield name, arr
+
+
+def torch_bin_weights_iterator(
+        path: str) -> Iterator[Tuple[str, np.ndarray]]:
+    """Stream tensors from pytorch_model*.bin via torch (CPU)."""
+    import torch
+    files = sorted(glob.glob(os.path.join(path, "*.bin")))
+    for fname in files:
+        state = torch.load(fname, map_location="cpu", weights_only=True)
+        for name, tensor in state.items():
+            if tensor.dtype == torch.bfloat16:
+                yield name, tensor.float().numpy()
+            else:
+                yield name, tensor.numpy()
+        del state
+
+
+def hf_model_weights_iterator(
+    model_path: str,
+    load_format: str = "auto",
+) -> Iterator[Tuple[str, np.ndarray]]:
+    """Yield (name, numpy array) for every checkpoint tensor
+    (reference `hf_downloader.py:285-352`, minus hub download — the model
+    path must be local or already cached)."""
+    if not os.path.isdir(model_path):
+        # Resolve via HF cache/download (requires network for new repos).
+        from huggingface_hub import snapshot_download
+        model_path = snapshot_download(
+            model_path,
+            allow_patterns=["*.safetensors", "*.bin", "*.json"])
+
+    has_safetensors = bool(glob.glob(os.path.join(model_path,
+                                                  "*.safetensors")))
+    if load_format == "safetensors" or (load_format == "auto" and
+                                        has_safetensors):
+        yield from safetensors_weights_iterator(model_path)
+    elif load_format in ("auto", "pt"):
+        yield from torch_bin_weights_iterator(model_path)
+    else:
+        raise ValueError(f"Unsupported load format {load_format} for "
+                         f"{model_path}")
+
+
+def initialize_dummy_params(model, seed: int = 0,
+                            scale: float = 1e-3) -> Dict:
+    """Small random weights for profiling/benchmarks without a checkpoint
+    (reference `--load-format dummy`, `hf_downloader.py:377-391`)."""
+    params = model.init_params()
+    flat, treedef = jax.tree_util.tree_flatten(params)
+    key = jax.random.PRNGKey(seed)
+    keys = jax.random.split(key, len(flat))
+    out = []
+    for k, leaf in zip(keys, flat):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            out.append(jax.random.uniform(k, leaf.shape, leaf.dtype,
+                                          minval=-scale, maxval=scale))
+        else:
+            out.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def shard_params(
+    params_np: Dict[str, Dict[str, np.ndarray]],
+    specs: Dict[str, Dict[str, P]],
+    mesh: Optional[Mesh],
+    dtype: jnp.dtype,
+) -> Dict[str, Dict[str, jax.Array]]:
+    """device_put each host tensor with its NamedSharding (or to the
+    default device when mesh is None). Floating weights cast to the
+    compute dtype; integer (quantized) payloads keep their dtype."""
+    out: Dict[str, Dict[str, jax.Array]] = {}
+    for key, bucket in params_np.items():
+        out[key] = {}
+        for pname, arr in bucket.items():
+            target = dtype if np.issubdtype(arr.dtype, np.floating) \
+                else arr.dtype
+            if mesh is None:
+                out[key][pname] = jnp.asarray(arr, dtype=target)
+            else:
+                spec = specs.get(key, {}).get(pname, P())
+                sharding = NamedSharding(mesh, spec)
+                out[key][pname] = jax.device_put(
+                    jnp.asarray(arr, dtype=target), sharding)
+    return out

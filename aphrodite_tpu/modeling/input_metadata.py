@@ -1,0 +1,39 @@
+"""The fixed-shape batch descriptor handed to the jitted step functions.
+
+This is the TPU-native replacement for the reference's `InputMetadata`
+(`aphrodite/modeling/metadata.py`) + the padded tensor building in
+`task_handler/model_runner.py:102-371`: a pytree of device arrays with
+static shapes per (phase, bucket), so each bucket compiles exactly once
+(SURVEY.md §7 "fixed-shape discipline" / "batch-descriptor ABI").
+
+`is_prompt` and `use_prefix` are static (meta) fields — they select which
+jitted program runs, exactly like the reference's prompt/decode split
+(`processing/scheduler.py:260-271`).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from flax import struct
+
+
+@struct.dataclass
+class InputMetadata:
+    # [num_tokens] flat slot index per new token; padded entries hold an
+    # out-of-range slot (>= num_pages*page_size) so the cache scatter drops
+    # them (see ops/kv_cache.py padding convention).
+    slot_mapping: jax.Array
+    # [batch, pages_per_seq] physical page ids per sequence; padded entries
+    # hold an out-of-range page id.
+    block_tables: jax.Array
+    # [batch] number of valid tokens in cache AFTER this step's writes
+    # (decode) or before this chunk (prefill prefix length).
+    context_lens: jax.Array
+    # [batch] number of valid (non-pad) new tokens per sequence.
+    prompt_lens: Optional[jax.Array] = None
+
+    is_prompt: bool = struct.field(pytree_node=False, default=False)
+    # Prefill against a non-empty cached prefix (prefix caching / chunked
+    # prefill); selects the gather-from-pages prefill path.
+    use_prefix: bool = struct.field(pytree_node=False, default=False)

@@ -1,0 +1,4 @@
+"""Layer library: norms, activations, rotary embeddings, linear algebra,
+attention dispatch, sampler. All functions are pure (params passed in) so
+they jit/shard cleanly; TP sharding is expressed as PartitionSpec trees
+built next to the parameter pytrees, never as explicit collectives."""

@@ -1,0 +1,50 @@
+"""Activations (reference: `aphrodite/modeling/layers/activation.py:17-63`,
+CUDA `kernels/activation_kernels.cu`). Plain jnp — XLA fuses these into the
+surrounding matmuls, which is exactly what the hand-written CUDA kernels
+were buying on GPU.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def silu_and_mul(x: jax.Array) -> jax.Array:
+    """SwiGLU combine: in [..., 2d] -> silu(x[..., :d]) * x[..., d:]."""
+    gate, up = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(gate) * up
+
+
+def gelu_and_mul(x: jax.Array) -> jax.Array:
+    gate, up = jnp.split(x, 2, axis=-1)
+    return jax.nn.gelu(gate, approximate=False) * up
+
+
+def gelu_new(x: jax.Array) -> jax.Array:
+    """HF 'new' gelu (tanh approximation over x^3 term)."""
+    c = math.sqrt(2.0 / math.pi)
+    return 0.5 * x * (1.0 + jnp.tanh(c * (x + 0.044715 * x * x * x)))
+
+
+def gelu_fast(x: jax.Array) -> jax.Array:
+    return 0.5 * x * (1.0 + jnp.tanh(0.7978845608028654 * x *
+                                     (1.0 + 0.044715 * x * x)))
+
+
+_ACTIVATIONS = {
+    "silu": jax.nn.silu,
+    "gelu": lambda x: jax.nn.gelu(x, approximate=False),
+    "gelu_new": gelu_new,
+    "gelu_fast": gelu_fast,
+    "gelu_pytorch_tanh": lambda x: jax.nn.gelu(x, approximate=True),
+    "relu": jax.nn.relu,
+}
+
+
+def get_act_fn(name: str):
+    """Activation lookup by HF config `hidden_act` name."""
+    if name not in _ACTIVATIONS:
+        raise ValueError(f"Activation function {name!r} is not supported.")
+    return _ACTIVATIONS[name]

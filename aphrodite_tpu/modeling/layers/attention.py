@@ -1,0 +1,134 @@
+"""PagedAttention layer: KV-cache write + prefill/decode dispatch.
+
+Reference: `aphrodite/modeling/layers/attention.py` (cache write `:95`,
+xformers prompt path `:104-161`, prefix path `:163-178`, decode dispatch
+`:230-302`). TPU-native mapping:
+
+- cache write  -> functional scatter `ops.kv_cache.write_to_kv_cache`
+  (buffers donated by the engine, so XLA updates in place);
+- prompt path  -> dense causal attention in jnp (`ops.attention.
+  prefill_attention`) — XLA's fused attention is MXU-efficient for the
+  rectangular prefill shapes;
+- prefix path  -> same prefill math over [gathered prefix ; chunk];
+- decode path  -> Pallas flash-decoding kernel over HBM pages
+  (`ops/pallas/paged_attention.py`), with the jnp gather path as the
+  interpret/CPU fallback.
+
+GQA/MQA, ALiBi, and sliding window are handled in all paths. Head sizes
+are unrestricted (the reference's {64..256} list, `attention.py:17`, is a
+CUDA register-tiling constraint with no TPU analog).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from aphrodite_tpu.modeling.input_metadata import InputMetadata
+from aphrodite_tpu.ops.attention import (paged_decode_attention_ref,
+                                         prefill_attention)
+from aphrodite_tpu.ops.kv_cache import gather_pages, write_to_kv_cache
+
+
+class PagedAttention:
+    """Stateless attention dispatcher (all state is in the KV pages)."""
+
+    def __init__(
+        self,
+        num_heads: int,
+        head_size: int,
+        scale: float,
+        num_kv_heads: Optional[int] = None,
+        alibi_slopes: Optional[np.ndarray] = None,
+        sliding_window: Optional[int] = None,
+        use_pallas: bool = True,
+    ) -> None:
+        self.num_heads = num_heads
+        self.head_size = head_size
+        self.scale = float(scale)
+        self.num_kv_heads = num_kv_heads if num_kv_heads is not None \
+            else num_heads
+        self.alibi_slopes = None if alibi_slopes is None else \
+            jnp.asarray(alibi_slopes, dtype=jnp.float32)
+        self.sliding_window = sliding_window
+        self.use_pallas = use_pallas
+
+    def __call__(
+        self,
+        q: jax.Array,              # [batch, seq, num_heads * head_size]
+        k: jax.Array,              # [batch, seq, num_kv_heads * head_size]
+        v: jax.Array,
+        k_pages: Optional[jax.Array],
+        v_pages: Optional[jax.Array],
+        metadata: InputMetadata,
+    ) -> Tuple[jax.Array, Optional[jax.Array], Optional[jax.Array]]:
+        """Returns (attn_out [batch, seq, num_heads*head_size], new
+        k_pages, new v_pages). k_pages=None runs cache-less prefill (memory
+        profiling, reference `model_runner.profile_run:571`)."""
+        batch, seq_len, _ = q.shape
+        q = q.reshape(batch, seq_len, self.num_heads, self.head_size)
+        k = k.reshape(batch, seq_len, self.num_kv_heads, self.head_size)
+        v = v.reshape(batch, seq_len, self.num_kv_heads, self.head_size)
+
+        if k_pages is not None:
+            flat_k = k.reshape(-1, self.num_kv_heads, self.head_size)
+            flat_v = v.reshape(-1, self.num_kv_heads, self.head_size)
+            k_pages, v_pages = write_to_kv_cache(
+                flat_k, flat_v, k_pages, v_pages, metadata.slot_mapping)
+
+        if metadata.is_prompt:
+            out = self._prefill(q, k, v, k_pages, v_pages, metadata)
+        else:
+            out = self._decode(q, k_pages, v_pages, metadata)
+        return (out.reshape(batch, seq_len,
+                            self.num_heads * self.head_size),
+                k_pages, v_pages)
+
+    def _prefill(self, q, k, v, k_pages, v_pages,
+                 metadata: InputMetadata) -> jax.Array:
+        batch, seq_len = q.shape[:2]
+        prompt_lens = metadata.prompt_lens
+        if prompt_lens is None:
+            prompt_lens = jnp.full((batch,), seq_len, dtype=jnp.int32)
+
+        if metadata.use_prefix:
+            # Attend over [cached prefix ; this chunk] gathered from pages
+            # (reference prefix path, triton context_attention_fwd).
+            kv_k = gather_pages(k_pages, metadata.block_tables)
+            kv_v = gather_pages(v_pages, metadata.block_tables)
+            # [b, Hkv, ctx, d] -> [b, ctx, Hkv, d]
+            kv_k = kv_k.swapaxes(1, 2)
+            kv_v = kv_v.swapaxes(1, 2)
+            context_lens = metadata.context_lens
+            kv_valid = context_lens + prompt_lens
+        else:
+            kv_k, kv_v = k, v
+            context_lens = jnp.zeros((batch,), dtype=jnp.int32)
+            kv_valid = prompt_lens
+
+        return prefill_attention(
+            q, kv_k, kv_v, context_lens, kv_valid, self.scale,
+            sliding_window=self.sliding_window,
+            alibi_slopes=self.alibi_slopes)
+
+    def _decode(self, q, k_pages, v_pages,
+                metadata: InputMetadata) -> jax.Array:
+        q3 = q.reshape(q.shape[0], self.num_heads, self.head_size)
+        # Sliding window: context_lens are already clamped host-side to the
+        # window and block tables wrap (reference model_runner.py:278-293),
+        # so the kernels need no window logic in decode.
+        if self.use_pallas and jax.default_backend() == "tpu" and \
+                self.alibi_slopes is None:
+            from aphrodite_tpu.ops.pallas.paged_attention import (
+                paged_decode_attention)
+            out = paged_decode_attention(
+                q3, k_pages, v_pages, metadata.block_tables,
+                metadata.context_lens, scale=self.scale)
+        else:
+            out = paged_decode_attention_ref(
+                q3, k_pages, v_pages, metadata.block_tables,
+                metadata.context_lens, self.scale,
+                alibi_slopes=self.alibi_slopes)
+        return out[:, None]  # [batch, 1, H, d]

@@ -1,0 +1,41 @@
+"""RMSNorm (reference: `aphrodite/modeling/layers/layernorm.py:46-66`,
+backed by `kernels/layernorm_kernels.cu`).
+
+On TPU these are plain jnp: XLA fuses the normalization into neighboring
+ops, so no Pallas kernel is needed (SURVEY.md §2.2 "trivially XLA-fusable").
+Accumulation is float32 regardless of activation dtype, matching the CUDA
+kernel's fp32 accumulators.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, weight: jax.Array,
+             eps: float = 1e-6) -> jax.Array:
+    """y = x / rms(x) * weight, computed in float32."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    y = xf * jax.lax.rsqrt(var + eps)
+    return (y * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def fused_add_rms_norm(
+    x: jax.Array,
+    residual: Optional[jax.Array],
+    weight: jax.Array,
+    eps: float = 1e-6,
+) -> Tuple[jax.Array, jax.Array]:
+    """Residual-add + RMSNorm (reference `layernorm.py:52`,
+    `ops.fused_add_rms_norm`): returns (normed, new_residual).
+
+    When residual is None this is plain rms_norm with the input as the new
+    residual stream — mirrors the reference decoder-layer calling pattern
+    (`models/llama.py:258-270`).
+    """
+    if residual is not None:
+        x = x + residual
+    return rms_norm(x, weight, eps), x

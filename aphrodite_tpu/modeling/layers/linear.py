@@ -1,0 +1,186 @@
+"""TP-shardable linear layers.
+
+Reference semantics: `aphrodite/modeling/layers/linear.py` (ReplicatedLinear
+`:79`, ColumnParallelLinear `:132`, MergedColumnParallelLinear `:230`,
+QKVParallelLinear `:324`, RowParallelLinear `:452`).
+
+TPU-first difference: there is NO explicit collective code here. Layers are
+written with single-device semantics (full shapes, plain matmuls); tensor
+parallelism is expressed purely as `PartitionSpec` annotations on the weight
+pytree ("tp" mesh axis on the output dim for column-parallel, the input dim
+for row-parallel). Under `jit` over a Mesh, GSPMD partitions the matmuls and
+inserts the all-reduce that the reference performs manually in
+`RowParallelLinear.forward` (`linear.py:562-565`).
+
+Weight layout is [in_features, out_features] (x @ W) — transposed from the
+HF/torch [out, in] layout at load time — so the contraction dim is the
+leading dim XLA prefers for MXU tiling.
+
+Each layer owns a `weight_loader(param, hf_weight, shard_id)` that places
+(possibly stacked) HF checkpoint tensors into the merged parameter, the
+same per-param loader pattern as the reference (`linear.py:196-213`).
+Quantization plugs in via LinearMethod objects (reference
+`LinearMethodBase`, `linear.py:20-38`).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+ParamDict = Dict[str, jax.Array]
+SpecDict = Dict[str, P]
+
+
+class LinearMethod:
+    """Creates and applies the weights of a linear layer.
+
+    The unquantized base class; quant methods (gptq/awq/...) subclass this
+    and store packed params (reference `linear.py:20-76`).
+    """
+
+    def create_weights(self, in_features: int, out_features: int,
+                       dtype: jnp.dtype, bias: bool,
+                       out_axis: Optional[str], in_axis: Optional[str]
+                       ) -> Tuple[ParamDict, SpecDict]:
+        params = {"weight": jnp.zeros((in_features, out_features),
+                                      dtype=dtype)}
+        specs = {"weight": P(in_axis, out_axis)}
+        if bias:
+            params["bias"] = jnp.zeros((out_features,), dtype=dtype)
+            specs["bias"] = P(out_axis)
+        return params, specs
+
+    def apply(self, params: ParamDict, x: jax.Array) -> jax.Array:
+        y = x @ params["weight"]
+        if "bias" in params:
+            y = y + params["bias"]
+        return y
+
+    def load_weight(self, params: ParamDict, name: str,
+                    hf_tensor: np.ndarray) -> np.ndarray:
+        """Convert one HF checkpoint tensor to this method's layout.
+        For dense weights: torch [out, in] -> [in, out]."""
+        if name == "weight":
+            return np.ascontiguousarray(hf_tensor.T)
+        return hf_tensor
+
+
+class LinearBase:
+    """Shared shape/spec bookkeeping. Subclasses set sharding axes."""
+
+    out_axis: Optional[str] = None
+    in_axis: Optional[str] = None
+
+    def __init__(self, in_features: int, out_features: int, *,
+                 bias: bool = False, dtype: jnp.dtype = jnp.bfloat16,
+                 linear_method: Optional[LinearMethod] = None) -> None:
+        self.in_features = in_features
+        self.out_features = out_features
+        self.bias = bias
+        self.dtype = dtype
+        self.linear_method = linear_method or LinearMethod()
+
+    def init(self) -> ParamDict:
+        params, _ = self.linear_method.create_weights(
+            self.in_features, self.out_features, self.dtype, self.bias,
+            self.out_axis, self.in_axis)
+        return params
+
+    def specs(self) -> SpecDict:
+        _, specs = self.linear_method.create_weights(
+            self.in_features, self.out_features, self.dtype, self.bias,
+            self.out_axis, self.in_axis)
+        return specs
+
+    def __call__(self, params: ParamDict, x: jax.Array) -> jax.Array:
+        return self.linear_method.apply(params, x)
+
+    def weight_loader(self, params: Dict[str, np.ndarray], name: str,
+                      hf_tensor: np.ndarray,
+                      shard_id=None) -> None:
+        params[name] = self.linear_method.load_weight(params, name,
+                                                      hf_tensor)
+
+
+class ReplicatedLinear(LinearBase):
+    """Weight replicated on every shard (reference `linear.py:79`)."""
+
+
+class ColumnParallelLinear(LinearBase):
+    """Output dim sharded over the tp axis (reference `linear.py:132`)."""
+    out_axis = "tp"
+
+
+class RowParallelLinear(LinearBase):
+    """Input dim sharded over tp; GSPMD inserts the psum the reference
+    calls explicitly (`linear.py:562-565`)."""
+    in_axis = "tp"
+
+
+class MergedColumnParallelLinear(ColumnParallelLinear):
+    """Several column-parallel outputs fused in one matmul, e.g. gate+up
+    (reference `linear.py:230`). HF ships the pieces separately; the loader
+    writes each into its slice of the merged weight."""
+
+    def __init__(self, in_features: int, output_sizes, **kw) -> None:
+        self.output_sizes = list(output_sizes)
+        super().__init__(in_features, sum(self.output_sizes), **kw)
+
+    def weight_loader(self, params: Dict[str, np.ndarray], name: str,
+                      hf_tensor: np.ndarray, shard_id=None) -> None:
+        converted = self.linear_method.load_weight(params, name, hf_tensor)
+        if shard_id is None:
+            params[name] = converted
+            return
+        offset = sum(self.output_sizes[:shard_id])
+        size = self.output_sizes[shard_id]
+        if name not in params:
+            full_shape = (converted.shape[:-1] +
+                          (self.out_features,)) if name == "weight" else \
+                (self.out_features,)
+            params[name] = np.zeros(full_shape, dtype=converted.dtype)
+        params[name][..., offset:offset + size] = converted
+
+
+class QKVParallelLinear(ColumnParallelLinear):
+    """Fused QKV projection, column-sharded by attention head
+    (reference `linear.py:324`). Loader slices by ('q'|'k'|'v')."""
+
+    def __init__(self, hidden_size: int, head_size: int, num_heads: int,
+                 num_kv_heads: Optional[int] = None, **kw) -> None:
+        self.hidden_size = hidden_size
+        self.head_size = head_size
+        self.num_heads = num_heads
+        self.num_kv_heads = num_kv_heads if num_kv_heads is not None \
+            else num_heads
+        out = (num_heads + 2 * self.num_kv_heads) * head_size
+        super().__init__(hidden_size, out, **kw)
+
+    def shard_offsets(self) -> Dict[str, Tuple[int, int]]:
+        q = self.num_heads * self.head_size
+        kv = self.num_kv_heads * self.head_size
+        return {"q": (0, q), "k": (q, kv), "v": (q + kv, kv)}
+
+    def split(self, qkv: jax.Array
+              ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        q = self.num_heads * self.head_size
+        kv = self.num_kv_heads * self.head_size
+        return (qkv[..., :q], qkv[..., q:q + kv], qkv[..., q + kv:])
+
+    def weight_loader(self, params: Dict[str, np.ndarray], name: str,
+                      hf_tensor: np.ndarray, shard_id=None) -> None:
+        converted = self.linear_method.load_weight(params, name, hf_tensor)
+        if shard_id is None:
+            params[name] = converted
+            return
+        offset, size = self.shard_offsets()[shard_id]
+        if name not in params:
+            full_shape = (converted.shape[:-1] +
+                          (self.out_features,)) if name == "weight" else \
+                (self.out_features,)
+            params[name] = np.zeros(full_shape, dtype=converted.dtype)
+        params[name][..., offset:offset + size] = converted

@@ -1,0 +1,80 @@
+"""Vocab embedding and LM head.
+
+Reference: `aphrodite/modeling/layers/vocab_parallel_embedding.py`
+(pad_vocab_size `:19`, VocabParallelEmbedding `:39`, ParallelLMHead `:127`).
+
+TPU-first: the embedding table is annotated P("tp", None) (vocab axis
+sharded); the lookup is a plain `take` — GSPMD turns it into the same
+masked-lookup + all-reduce the reference hand-writes
+(`vocab_parallel_embedding.py:105-118`). The LM head reuses the table (or
+its own weight) as a [hidden, vocab] matmul with the vocab dim sharded, so
+logits come out vocab-sharded and the sampler's gather is a compiler-
+inserted collective (reference gathers explicitly, `sampler.py:47-60`).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+DEFAULT_VOCAB_PADDING_SIZE = 64
+
+
+def pad_vocab_size(vocab_size: int,
+                   pad_to: int = DEFAULT_VOCAB_PADDING_SIZE) -> int:
+    """Pad to multiple of pad_to (reference `:19`); also keeps the sharded
+    vocab dim divisible by tp."""
+    return ((vocab_size + pad_to - 1) // pad_to) * pad_to
+
+
+class VocabParallelEmbedding:
+    """Embedding table [padded_vocab, hidden], vocab-sharded over tp."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int, *,
+                 dtype: jnp.dtype = jnp.bfloat16,
+                 org_num_embeddings: Optional[int] = None,
+                 padding_size: int = DEFAULT_VOCAB_PADDING_SIZE) -> None:
+        self.org_vocab_size = org_num_embeddings or num_embeddings
+        self.num_embeddings = num_embeddings
+        self.num_embeddings_padded = pad_vocab_size(num_embeddings,
+                                                    padding_size)
+        self.embedding_dim = embedding_dim
+        self.dtype = dtype
+
+    def init(self) -> Dict[str, jax.Array]:
+        return {"weight": jnp.zeros(
+            (self.num_embeddings_padded, self.embedding_dim),
+            dtype=self.dtype)}
+
+    def specs(self) -> Dict[str, P]:
+        return {"weight": P("tp", None)}
+
+    def __call__(self, params: Dict[str, jax.Array],
+                 input_ids: jax.Array) -> jax.Array:
+        return jnp.take(params["weight"], input_ids, axis=0)
+
+    def weight_loader(self, params: Dict[str, np.ndarray], name: str,
+                      hf_tensor: np.ndarray, shard_id=None) -> None:
+        # Zero-pad rows beyond the checkpoint vocab (reference pads and
+        # masks; padded rows are never selected by valid token ids).
+        padded = np.zeros((self.num_embeddings_padded, self.embedding_dim),
+                          dtype=hf_tensor.dtype)
+        padded[:hf_tensor.shape[0]] = hf_tensor
+        params[name] = padded
+
+
+class ParallelLMHead(VocabParallelEmbedding):
+    """LM head: logits = hidden @ W.T with vocab sharded (reference `:127`).
+
+    Call `compute_logits` rather than __call__.
+    """
+
+    def compute_logits(self, params: Dict[str, jax.Array],
+                       hidden: jax.Array) -> jax.Array:
+        """hidden [..., hidden_dim] -> logits [..., org_vocab] (padding
+        columns sliced off so host-side sampling sees the true vocab)."""
+        logits = hidden @ params["weight"].T
+        return logits[..., :self.org_vocab_size]

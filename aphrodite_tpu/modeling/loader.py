@@ -1,0 +1,72 @@
+"""Model construction + weight loading entrypoint.
+
+Reference: `aphrodite/modeling/loader.py:35` (`get_model`).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from aphrodite_tpu.common.config import ModelConfig
+from aphrodite_tpu.common.logger import init_logger
+from aphrodite_tpu.modeling.hf_loader import (hf_model_weights_iterator,
+                                              initialize_dummy_params,
+                                              shard_params)
+from aphrodite_tpu.modeling.models import ModelRegistry
+
+logger = init_logger(__name__)
+
+_DTYPES = {
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+}
+
+
+def _get_model_architecture(config) -> type:
+    architectures = getattr(config, "architectures", [])
+    for arch in architectures:
+        model_cls = ModelRegistry.load_model_cls(arch)
+        if model_cls is not None:
+            return model_cls
+    raise ValueError(
+        f"Model architectures {architectures} are not supported for now. "
+        f"Supported architectures: {ModelRegistry.get_supported_archs()}")
+
+
+def get_model(model_config: ModelConfig,
+              mesh: Optional[Mesh] = None) -> Tuple[object, dict]:
+    """Build the model and its (sharded) parameters.
+
+    Returns (model, params). With a mesh, every parameter is device_put
+    with its NamedSharding; single-chip gets plain device arrays.
+    """
+    model_cls = _get_model_architecture(model_config.hf_config)
+    dtype = _DTYPES[model_config.dtype]
+
+    linear_method = None
+    if model_config.quantization is not None:
+        from aphrodite_tpu.modeling.layers.quantization import (
+            get_quantization_config)
+        quant_config = get_quantization_config(model_config)
+        linear_method = quant_config.get_linear_method()
+
+    model = model_cls(model_config.hf_config, dtype=dtype,
+                      linear_method=linear_method)
+
+    if model_config.load_format == "dummy":
+        params = initialize_dummy_params(model, seed=model_config.seed)
+        if mesh is not None:
+            import numpy as np
+            host = {k: {n: np.asarray(a) for n, a in b.items()}
+                    for k, b in params.items()}
+            params = shard_params(host, model.param_specs(), mesh, dtype)
+        return model, params
+
+    weights_iter = hf_model_weights_iterator(model_config.model,
+                                             model_config.load_format)
+    params_np = model.load_weights(weights_iter)
+    params = shard_params(params_np, model.param_specs(), mesh, dtype)
+    return model, params

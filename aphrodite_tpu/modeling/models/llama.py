@@ -1,0 +1,314 @@
+"""Llama-family causal LM (Llama/Llama-2/Mistral/Yi and friends).
+
+Reference: `aphrodite/modeling/models/llama.py` (LlamaAttention `:92`,
+LlamaDecoderLayer `:240`, LlamaForCausalLM `:318`, load_weights `:366`) and
+`models/mistral.py` (same architecture + sliding window).
+
+TPU-native design: the model is a pure function over a flat parameter
+pytree (dotted HF-style keys -> {name: array}); TP is PartitionSpec
+annotations (see layers/linear.py docstring); the whole forward jits into
+one SPMD program per (phase, bucket). Layers are Python-unrolled under jit.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from aphrodite_tpu.modeling.input_metadata import InputMetadata
+from aphrodite_tpu.modeling.layers.activation import silu_and_mul
+from aphrodite_tpu.modeling.layers.attention import PagedAttention
+from aphrodite_tpu.modeling.layers.layernorm import (fused_add_rms_norm,
+                                                     rms_norm)
+from aphrodite_tpu.modeling.layers.linear import (LinearMethod,
+                                                  MergedColumnParallelLinear,
+                                                  QKVParallelLinear,
+                                                  RowParallelLinear)
+from aphrodite_tpu.modeling.layers.rotary_embedding import get_rope
+from aphrodite_tpu.modeling.layers.vocab_embedding import (ParallelLMHead,
+                                                           VocabParallelEmbedding)
+
+KVCache = Tuple[jax.Array, jax.Array]
+Params = Dict[str, Dict[str, jax.Array]]
+
+
+class LlamaAttention:
+
+    def __init__(self, config, layer_prefix: str, dtype,
+                 linear_method: Optional[LinearMethod]) -> None:
+        hidden_size = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = getattr(config, "num_key_value_heads",
+                                    self.num_heads)
+        self.head_dim = getattr(config, "head_dim", None) or \
+            hidden_size // self.num_heads
+        self.prefix = layer_prefix
+
+        self.qkv_proj = QKVParallelLinear(
+            hidden_size, self.head_dim, self.num_heads, self.num_kv_heads,
+            bias=getattr(config, "attention_bias", False), dtype=dtype,
+            linear_method=linear_method)
+        self.o_proj = RowParallelLinear(
+            self.num_heads * self.head_dim, hidden_size,
+            bias=getattr(config, "attention_bias", False), dtype=dtype,
+            linear_method=linear_method)
+        self.rotary = get_rope(
+            self.head_dim, self.head_dim,
+            max_position=getattr(config, "max_position_embeddings", 8192),
+            base=getattr(config, "rope_theta", 10000.0),
+            is_neox_style=True,
+            rope_scaling=getattr(config, "rope_scaling", None))
+        self.attn = PagedAttention(
+            self.num_heads, self.head_dim,
+            scale=self.head_dim ** -0.5,
+            num_kv_heads=self.num_kv_heads,
+            sliding_window=getattr(config, "sliding_window", None))
+
+    def init(self) -> Dict[str, Dict[str, jax.Array]]:
+        return {
+            f"{self.prefix}.self_attn.qkv_proj": self.qkv_proj.init(),
+            f"{self.prefix}.self_attn.o_proj": self.o_proj.init(),
+        }
+
+    def specs(self) -> Dict[str, Dict[str, P]]:
+        return {
+            f"{self.prefix}.self_attn.qkv_proj": self.qkv_proj.specs(),
+            f"{self.prefix}.self_attn.o_proj": self.o_proj.specs(),
+        }
+
+    def __call__(self, params: Params, positions: jax.Array,
+                 hidden: jax.Array, kv_cache: Optional[KVCache],
+                 metadata: InputMetadata
+                 ) -> Tuple[jax.Array, Optional[KVCache]]:
+        qkv = self.qkv_proj(params[f"{self.prefix}.self_attn.qkv_proj"],
+                            hidden)
+        q, k, v = self.qkv_proj.split(qkv)
+        b, s = q.shape[:2]
+        q = q.reshape(b, s, self.num_heads, self.head_dim)
+        k = k.reshape(b, s, self.num_kv_heads, self.head_dim)
+        q, k = self.rotary(positions, q, k)
+        q = q.reshape(b, s, self.num_heads * self.head_dim)
+        k = k.reshape(b, s, self.num_kv_heads * self.head_dim)
+
+        k_pages, v_pages = kv_cache if kv_cache is not None else (None, None)
+        out, k_pages, v_pages = self.attn(q, k, v, k_pages, v_pages,
+                                          metadata)
+        out = self.o_proj(params[f"{self.prefix}.self_attn.o_proj"], out)
+        new_cache = None if k_pages is None else (k_pages, v_pages)
+        return out, new_cache
+
+
+class LlamaMLP:
+
+    def __init__(self, config, layer_prefix: str, dtype,
+                 linear_method: Optional[LinearMethod]) -> None:
+        self.prefix = layer_prefix
+        self.gate_up_proj = MergedColumnParallelLinear(
+            config.hidden_size, [config.intermediate_size] * 2,
+            dtype=dtype, linear_method=linear_method)
+        self.down_proj = RowParallelLinear(
+            config.intermediate_size, config.hidden_size, dtype=dtype,
+            linear_method=linear_method)
+
+    def init(self):
+        return {
+            f"{self.prefix}.mlp.gate_up_proj": self.gate_up_proj.init(),
+            f"{self.prefix}.mlp.down_proj": self.down_proj.init(),
+        }
+
+    def specs(self):
+        return {
+            f"{self.prefix}.mlp.gate_up_proj": self.gate_up_proj.specs(),
+            f"{self.prefix}.mlp.down_proj": self.down_proj.specs(),
+        }
+
+    def __call__(self, params: Params, hidden: jax.Array) -> jax.Array:
+        gate_up = self.gate_up_proj(
+            params[f"{self.prefix}.mlp.gate_up_proj"], hidden)
+        return self.down_proj(params[f"{self.prefix}.mlp.down_proj"],
+                              silu_and_mul(gate_up))
+
+
+class LlamaDecoderLayer:
+
+    def __init__(self, config, layer_idx: int, dtype,
+                 linear_method: Optional[LinearMethod]) -> None:
+        self.prefix = f"model.layers.{layer_idx}"
+        self.rms_eps = config.rms_norm_eps
+        self.self_attn = LlamaAttention(config, self.prefix, dtype,
+                                        linear_method)
+        self.mlp = LlamaMLP(config, self.prefix, dtype, linear_method)
+        self.dtype = dtype
+        self.hidden_size = config.hidden_size
+
+    def init(self):
+        params = {}
+        params.update(self.self_attn.init())
+        params.update(self.mlp.init())
+        ones = jnp.ones((self.hidden_size,), dtype=self.dtype)
+        params[f"{self.prefix}.input_layernorm"] = {"weight": ones}
+        params[f"{self.prefix}.post_attention_layernorm"] = {"weight": ones}
+        return params
+
+    def specs(self):
+        specs = {}
+        specs.update(self.self_attn.specs())
+        specs.update(self.mlp.specs())
+        specs[f"{self.prefix}.input_layernorm"] = {"weight": P(None)}
+        specs[f"{self.prefix}.post_attention_layernorm"] = {
+            "weight": P(None)}
+        return specs
+
+    def __call__(self, params: Params, positions, hidden, residual,
+                 kv_cache, metadata):
+        normed, residual = fused_add_rms_norm(
+            hidden, residual,
+            params[f"{self.prefix}.input_layernorm"]["weight"],
+            self.rms_eps)
+        attn_out, new_cache = self.self_attn(params, positions, normed,
+                                             kv_cache, metadata)
+        normed, residual = fused_add_rms_norm(
+            attn_out, residual,
+            params[f"{self.prefix}.post_attention_layernorm"]["weight"],
+            self.rms_eps)
+        mlp_out = self.mlp(params, normed)
+        return mlp_out, residual, new_cache
+
+
+class LlamaForCausalLM:
+    """Functional Llama. `__call__` returns final hidden states + updated
+    KV caches; `compute_logits` applies the LM head (separately, so decode
+    can compute logits only for the last token of each sequence)."""
+
+    def __init__(self, config, dtype: jnp.dtype = jnp.bfloat16,
+                 linear_method: Optional[LinearMethod] = None) -> None:
+        self.config = config
+        self.dtype = dtype
+        self.linear_method = linear_method
+        self.embed_tokens = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size, dtype=dtype)
+        self.layers = [
+            LlamaDecoderLayer(config, i, dtype, linear_method)
+            for i in range(config.num_hidden_layers)
+        ]
+        self.lm_head = ParallelLMHead(config.vocab_size,
+                                      config.hidden_size, dtype=dtype)
+        self.rms_eps = config.rms_norm_eps
+        self.tie_word_embeddings = getattr(config, "tie_word_embeddings",
+                                           False)
+
+    # ---- params ----
+    def init_params(self) -> Params:
+        params: Params = {"model.embed_tokens": self.embed_tokens.init()}
+        for layer in self.layers:
+            params.update(layer.init())
+        params["model.norm"] = {
+            "weight": jnp.ones((self.config.hidden_size,), dtype=self.dtype)
+        }
+        if not self.tie_word_embeddings:
+            params["lm_head"] = self.lm_head.init()
+        return params
+
+    def param_specs(self) -> Dict[str, Dict[str, P]]:
+        specs = {"model.embed_tokens": self.embed_tokens.specs()}
+        for layer in self.layers:
+            specs.update(layer.specs())
+        specs["model.norm"] = {"weight": P(None)}
+        if not self.tie_word_embeddings:
+            specs["lm_head"] = self.lm_head.specs()
+        return specs
+
+    # ---- forward ----
+    def __call__(
+        self,
+        params: Params,
+        input_ids: jax.Array,       # [batch, seq]
+        positions: jax.Array,       # [batch, seq]
+        kv_caches: Optional[List[KVCache]],
+        metadata: InputMetadata,
+    ) -> Tuple[jax.Array, Optional[List[KVCache]]]:
+        hidden = self.embed_tokens(params["model.embed_tokens"], input_ids)
+        residual = None
+        new_caches: List[KVCache] = []
+        for i, layer in enumerate(self.layers):
+            cache = kv_caches[i] if kv_caches is not None else None
+            hidden, residual, new_cache = layer(params, positions, hidden,
+                                                residual, cache, metadata)
+            if new_cache is not None:
+                new_caches.append(new_cache)
+        hidden = rms_norm(hidden + residual,
+                          params["model.norm"]["weight"], self.rms_eps)
+        return hidden, (new_caches if kv_caches is not None else None)
+
+    def compute_logits(self, params: Params,
+                       hidden: jax.Array) -> jax.Array:
+        head = params["model.embed_tokens"] if self.tie_word_embeddings \
+            else params["lm_head"]
+        return self.lm_head.compute_logits(head, hidden)
+
+    # ---- weight loading ----
+    # (HF name fragment, our merged param, shard id) — mirrors the
+    # reference's stacked_params_mapping (`models/llama.py:368-375`).
+    _STACKED = [
+        ("q_proj", "qkv_proj", "q"),
+        ("k_proj", "qkv_proj", "k"),
+        ("v_proj", "qkv_proj", "v"),
+        ("gate_proj", "gate_up_proj", 0),
+        ("up_proj", "gate_up_proj", 1),
+    ]
+
+    def load_weights(self, weights: Iterable[Tuple[str, np.ndarray]]
+                     ) -> Dict[str, Dict[str, np.ndarray]]:
+        """Consume an iterator of HF (name, numpy tensor); return the
+        host-side param tree (numpy) ready for device_put with shardings."""
+        loaders = {}
+        for layer in self.layers:
+            p = layer.prefix
+            loaders[f"{p}.self_attn.qkv_proj"] = layer.self_attn.qkv_proj
+            loaders[f"{p}.self_attn.o_proj"] = layer.self_attn.o_proj
+            loaders[f"{p}.mlp.gate_up_proj"] = layer.mlp.gate_up_proj
+            loaders[f"{p}.mlp.down_proj"] = layer.mlp.down_proj
+
+        params: Dict[str, Dict[str, np.ndarray]] = {}
+
+        def bucket(key: str) -> Dict[str, np.ndarray]:
+            return params.setdefault(key, {})
+
+        for name, tensor in weights:
+            if "rotary_emb.inv_freq" in name:
+                continue
+            if name.startswith("lm_head"):
+                if self.tie_word_embeddings:
+                    continue
+                self.lm_head.weight_loader(bucket("lm_head"), "weight",
+                                           tensor)
+                continue
+            if name == "model.embed_tokens.weight":
+                self.embed_tokens.weight_loader(
+                    bucket("model.embed_tokens"), "weight", tensor)
+                continue
+            if name == "model.norm.weight":
+                bucket("model.norm")["weight"] = tensor
+                continue
+            if name.endswith("_layernorm.weight"):
+                key, pname = name.rsplit(".", 1)
+                bucket(key)[pname] = tensor
+                continue
+
+            for hf_frag, merged, shard_id in self._STACKED:
+                if f".{hf_frag}." in name:
+                    key = name.replace(hf_frag, merged)
+                    key, pname = key.rsplit(".", 1)
+                    loaders[key].weight_loader(bucket(key), pname, tensor,
+                                               shard_id)
+                    break
+            else:
+                if name.endswith((".weight", ".bias")):
+                    key, pname = name.rsplit(".", 1)
+                    if key in loaders:
+                        loaders[key].weight_loader(bucket(key), pname,
+                                                   tensor)
+        return params
