@@ -114,10 +114,18 @@ def hf_model_weights_iterator(
 
     has_safetensors = bool(glob.glob(os.path.join(model_path,
                                                   "*.safetensors")))
+    has_bins = bool(glob.glob(os.path.join(model_path, "*.bin")))
     if load_format == "safetensors" or (load_format == "auto" and
                                         has_safetensors):
+        if not has_safetensors:
+            raise ValueError(
+                f"No *.safetensors files found in {model_path}.")
         yield from safetensors_weights_iterator(model_path)
     elif load_format in ("auto", "pt"):
+        if not has_bins:
+            raise ValueError(
+                f"No weight files (*.safetensors / *.bin) found in "
+                f"{model_path}.")
         yield from torch_bin_weights_iterator(model_path)
     else:
         raise ValueError(f"Unsupported load format {load_format} for "

@@ -45,14 +45,21 @@ class LinearMethod:
     def create_weights(self, in_features: int, out_features: int,
                        dtype: jnp.dtype, bias: bool,
                        out_axis: Optional[str], in_axis: Optional[str]
-                       ) -> Tuple[ParamDict, SpecDict]:
+                       ) -> ParamDict:
         params = {"weight": jnp.zeros((in_features, out_features),
                                       dtype=dtype)}
-        specs = {"weight": P(in_axis, out_axis)}
         if bias:
             params["bias"] = jnp.zeros((out_features,), dtype=dtype)
+        return params
+
+    def create_specs(self, bias: bool, out_axis: Optional[str],
+                     in_axis: Optional[str]) -> SpecDict:
+        """Specs without allocating any arrays (param_specs() runs for
+        every layer on the load path)."""
+        specs = {"weight": P(in_axis, out_axis)}
+        if bias:
             specs["bias"] = P(out_axis)
-        return params, specs
+        return specs
 
     def apply(self, params: ParamDict, x: jax.Array) -> jax.Array:
         y = x @ params["weight"]
@@ -85,16 +92,13 @@ class LinearBase:
         self.linear_method = linear_method or LinearMethod()
 
     def init(self) -> ParamDict:
-        params, _ = self.linear_method.create_weights(
+        return self.linear_method.create_weights(
             self.in_features, self.out_features, self.dtype, self.bias,
             self.out_axis, self.in_axis)
-        return params
 
     def specs(self) -> SpecDict:
-        _, specs = self.linear_method.create_weights(
-            self.in_features, self.out_features, self.dtype, self.bias,
-            self.out_axis, self.in_axis)
-        return specs
+        return self.linear_method.create_specs(self.bias, self.out_axis,
+                                               self.in_axis)
 
     def __call__(self, params: ParamDict, x: jax.Array) -> jax.Array:
         return self.linear_method.apply(params, x)
@@ -121,7 +125,21 @@ class RowParallelLinear(LinearBase):
     in_axis = "tp"
 
 
-class MergedColumnParallelLinear(ColumnParallelLinear):
+class _ShardedLoadMixin(LinearBase):
+    """Shared placement of an HF shard into a slice of a merged param."""
+
+    def _write_shard(self, params: Dict[str, np.ndarray], name: str,
+                     converted: np.ndarray, offset: int,
+                     size: int) -> None:
+        if name not in params:
+            full_shape = (converted.shape[:-1] +
+                          (self.out_features,)) if name == "weight" else \
+                (self.out_features,)
+            params[name] = np.zeros(full_shape, dtype=converted.dtype)
+        params[name][..., offset:offset + size] = converted
+
+
+class MergedColumnParallelLinear(_ShardedLoadMixin, ColumnParallelLinear):
     """Several column-parallel outputs fused in one matmul, e.g. gate+up
     (reference `linear.py:230`). HF ships the pieces separately; the loader
     writes each into its slice of the merged weight."""
@@ -137,16 +155,11 @@ class MergedColumnParallelLinear(ColumnParallelLinear):
             params[name] = converted
             return
         offset = sum(self.output_sizes[:shard_id])
-        size = self.output_sizes[shard_id]
-        if name not in params:
-            full_shape = (converted.shape[:-1] +
-                          (self.out_features,)) if name == "weight" else \
-                (self.out_features,)
-            params[name] = np.zeros(full_shape, dtype=converted.dtype)
-        params[name][..., offset:offset + size] = converted
+        self._write_shard(params, name, converted,
+                          offset, self.output_sizes[shard_id])
 
 
-class QKVParallelLinear(ColumnParallelLinear):
+class QKVParallelLinear(_ShardedLoadMixin, ColumnParallelLinear):
     """Fused QKV projection, column-sharded by attention head
     (reference `linear.py:324`). Loader slices by ('q'|'k'|'v')."""
 
@@ -178,9 +191,4 @@ class QKVParallelLinear(ColumnParallelLinear):
             params[name] = converted
             return
         offset, size = self.shard_offsets()[shard_id]
-        if name not in params:
-            full_shape = (converted.shape[:-1] +
-                          (self.out_features,)) if name == "weight" else \
-                (self.out_features,)
-            params[name] = np.zeros(full_shape, dtype=converted.dtype)
-        params[name][..., offset:offset + size] = converted
+        self._write_shard(params, name, converted, offset, size)

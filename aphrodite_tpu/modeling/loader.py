@@ -48,8 +48,13 @@ def get_model(model_config: ModelConfig,
 
     linear_method = None
     if model_config.quantization is not None:
-        from aphrodite_tpu.modeling.layers.quantization import (
-            get_quantization_config)
+        try:
+            from aphrodite_tpu.modeling.layers.quantization import (
+                get_quantization_config)
+        except ImportError as e:
+            raise NotImplementedError(
+                f"Quantization method {model_config.quantization!r} is not "
+                "implemented yet in the TPU backend.") from e
         quant_config = get_quantization_config(model_config)
         linear_method = quant_config.get_linear_method()
 

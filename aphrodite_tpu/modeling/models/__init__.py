@@ -10,21 +10,14 @@ from typing import List, Optional, Type
 
 # HF architecture name -> (module under aphrodite_tpu.modeling.models,
 # class name). Llama covers the Llama-family checkpoints the reference
-# maps to its LlamaForCausalLM; Mistral/Yi/DeciLM are Llama-architecture
-# variants parameterized by their HF configs.
+# maps to its LlamaForCausalLM; Mistral/Yi are Llama-architecture
+# variants parameterized by their HF configs. Entries are added here
+# only once the module exists.
 _MODELS = {
     "LlamaForCausalLM": ("llama", "LlamaForCausalLM"),
     "LLaMAForCausalLM": ("llama", "LlamaForCausalLM"),
     "MistralForCausalLM": ("llama", "LlamaForCausalLM"),
     "YiForCausalLM": ("llama", "LlamaForCausalLM"),
-    "DeciLMForCausalLM": ("decilm", "DeciLMForCausalLM"),
-    "MixtralForCausalLM": ("mixtral", "MixtralForCausalLM"),
-    "DeepseekForCausalLM": ("deepseek", "DeepseekForCausalLM"),
-    "OPTForCausalLM": ("opt", "OPTForCausalLM"),
-    "GPTJForCausalLM": ("gpt_j", "GPTJForCausalLM"),
-    "GPTNeoXForCausalLM": ("gpt_neox", "GPTNeoXForCausalLM"),
-    "PhiForCausalLM": ("phi", "PhiForCausalLM"),
-    "Qwen2ForCausalLM": ("qwen2", "Qwen2ForCausalLM"),
 }
 
 

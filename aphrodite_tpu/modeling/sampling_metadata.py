@@ -1,0 +1,269 @@
+"""Per-batch sampling bookkeeping and vectorized sampler knobs.
+
+Reference: `aphrodite/modeling/sampling_metadata.py` (SamplingMetadata
+`:30`, SamplingTensors.from_sampling_metadata `:108`, Persistent/Output
+metadata `:13-28`).
+
+Host side builds `SamplingMetadata` (Python lists, ragged); it is
+flattened once per step into `SamplingTensors` — a fixed-width struct of
+device arrays, padded to the logits row count — which the jitted sampler
+consumes. The `do_*` flags are static gates: each disables a whole
+pipeline stage at trace time when no sequence in the batch uses it, the
+same fast-path elision the reference does dynamically.
+
+Mirostat state (`mu`) persists across steps host-side in
+`PersistentMetadata`, round-tripping through `OutputMetadata` exactly as
+the reference (`sampling_metadata.py:13-28`).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from aphrodite_tpu.common.sampling_params import (SamplingParams,
+                                                  SamplingType)
+from aphrodite_tpu.common.sequence import SequenceData
+
+_SAMPLING_EPS = 1e-5
+
+
+class PersistentMetadata:
+    """Per-seq state that survives across steps (mirostat mu)."""
+
+    def __init__(self, data: Optional[Dict[int, dict]] = None) -> None:
+        self._metadata: Dict[int, dict] = data or {}
+
+    def get(self, seq_id: int) -> dict:
+        return self._metadata.get(seq_id, {})
+
+
+class OutputMetadata(PersistentMetadata):
+    """Mutable variant the sampler writes back into."""
+
+    def add(self, seq_id: int, key: str, val) -> None:
+        self._metadata.setdefault(seq_id, {})[key] = val
+
+
+@dataclass
+class SamplingMetadata:
+    """Ragged per-group sampling info for one step.
+
+    seq_groups: per scheduled group, (seq_ids, sampling_params).
+    seq_data: seq id -> SequenceData (for penalties' token histories).
+    prompt_lens: per prompt group, the prompt length (empty for decode).
+    selected_token_indices: flat indices into the [rows, vocab] logits for
+        the tokens we sample from (last token of each prompt / each decode
+        row), reference `_prepare_sample` (`model_runner.py:372-451`).
+    categorized_sample_indices: SamplingType -> row indices within the
+        selected logits, post-selection.
+    """
+    seq_groups: List[Tuple[List[int], SamplingParams]]
+    seq_data: Dict[int, SequenceData]
+    prompt_lens: List[int]
+    selected_token_indices: jax.Array
+    categorized_sample_indices: Dict[SamplingType, List[int]]
+    persistent_metadata: PersistentMetadata = field(
+        default_factory=PersistentMetadata)
+    output_metadata: OutputMetadata = field(default_factory=OutputMetadata)
+
+
+@struct.dataclass
+class SamplingTensors:
+    """Fixed-shape device-side sampler knobs, one row per sampled token.
+
+    All arrays are [rows] or [rows, k]; token-history tensors are padded
+    with vocab_size (an out-of-range id scatter-dropped by the penalty
+    stage).
+    """
+    temperatures: jax.Array
+    dynatemp_mins: jax.Array
+    dynatemp_maxs: jax.Array
+    dynatemp_exps: jax.Array
+    top_ps: jax.Array
+    top_ks: jax.Array
+    top_as: jax.Array
+    min_ps: jax.Array
+    tfss: jax.Array
+    eta_cutoffs: jax.Array
+    epsilon_cutoffs: jax.Array
+    typical_ps: jax.Array
+    miro_taus: jax.Array
+    miro_etas: jax.Array
+    miro_mus: jax.Array
+    smoothing_factors: jax.Array
+    presence_penalties: jax.Array
+    frequency_penalties: jax.Array
+    repetition_penalties: jax.Array
+    prompt_tokens: jax.Array      # [rows, max_prompt_len] padded w/ vocab
+    output_tokens: jax.Array      # [rows, max_output_len] padded w/ vocab
+    banned_tokens: jax.Array      # [rows, max_bans] padded w/ vocab
+    # Static gates (trace-time):
+    do_penalties: bool = struct.field(pytree_node=False, default=False)
+    do_temperatures: bool = struct.field(pytree_node=False, default=False)
+    do_top_p_top_k: bool = struct.field(pytree_node=False, default=False)
+    do_top_as: bool = struct.field(pytree_node=False, default=False)
+    do_min_p: bool = struct.field(pytree_node=False, default=False)
+    do_tfss: bool = struct.field(pytree_node=False, default=False)
+    do_eta_cutoffs: bool = struct.field(pytree_node=False, default=False)
+    do_epsilon_cutoffs: bool = struct.field(pytree_node=False,
+                                            default=False)
+    do_typical_ps: bool = struct.field(pytree_node=False, default=False)
+    do_quadratic: bool = struct.field(pytree_node=False, default=False)
+    do_mirostat: bool = struct.field(pytree_node=False, default=False)
+    do_token_bans: bool = struct.field(pytree_node=False, default=False)
+
+
+def _pad_2d(rows: List[List[int]], pad_value: int) -> np.ndarray:
+    width = max(1, max((len(r) for r in rows), default=1))
+    out = np.full((len(rows), width), pad_value, dtype=np.int32)
+    for i, r in enumerate(rows):
+        out[i, :len(r)] = r
+    return out
+
+
+def build_sampling_tensors(
+    metadata: SamplingMetadata,
+    vocab_size: int,
+    dtype=jnp.float32,
+) -> Tuple[SamplingTensors, Dict[int, int]]:
+    """Flatten SamplingMetadata into SamplingTensors.
+
+    Mirrors `SamplingTensors.from_sampling_metadata`
+    (`sampling_metadata.py:108-261`) incl. the prompt-logprobs row
+    expansion: when a prompt group requests prompt_logprobs, the penalty/
+    temperature rows are replicated for every prompt position.
+
+    Returns (tensors, row_to_seq_id) where row_to_seq_id maps sampled rows
+    to sequence ids (for mirostat state round-trip).
+    """
+    temperatures, top_ps, top_ks, top_as, min_ps = [], [], [], [], []
+    tfss, eta, eps, typical, smoothing = [], [], [], [], []
+    dynatemp_mins, dynatemp_maxs, dynatemp_exps = [], [], []
+    miro_taus, miro_etas, miro_mus = [], [], []
+    pres_pen, freq_pen, rep_pen = [], [], []
+    prompt_tokens: List[List[int]] = []
+    output_tokens: List[List[int]] = []
+    banned_tokens: List[List[int]] = []
+    row_to_seq: Dict[int, int] = {}
+
+    do = dict(penalties=False, temperatures=False, top_p_top_k=False,
+              top_as=False, min_p=False, tfss=False, eta=False,
+              epsilon=False, typical=False, quadratic=False,
+              mirostat=False, bans=False)
+
+    prompt_idx = 0
+    for group_idx, (seq_ids, p) in enumerate(metadata.seq_groups):
+        temperature = p.temperature
+        if temperature < _SAMPLING_EPS:
+            temperature = 1.0      # zero temp == greedy: no-op scaling
+        else:
+            if temperature != 1.0 or p.dynatemp_range > 0:
+                do["temperatures"] = True
+        if p.dynatemp_range > 0:
+            do["temperatures"] = True
+        if p.top_p < 1.0 - _SAMPLING_EPS or p.top_k not in (-1, vocab_size):
+            do["top_p_top_k"] = True
+        if p.top_a > 0.0:
+            do["top_as"] = True
+        if p.min_p > _SAMPLING_EPS:
+            do["min_p"] = True
+        if p.tfs < 1.0 - _SAMPLING_EPS:
+            do["tfss"] = True
+        if p.eta_cutoff > _SAMPLING_EPS:
+            do["eta"] = True
+        if p.epsilon_cutoff > _SAMPLING_EPS:
+            do["epsilon"] = True
+        if p.typical_p < 1.0 - _SAMPLING_EPS:
+            do["typical"] = True
+        if p.smoothing_factor > _SAMPLING_EPS:
+            do["quadratic"] = True
+        if p.mirostat_mode == 2:
+            do["mirostat"] = True
+        if p.custom_token_bans:
+            do["bans"] = True
+        if abs(p.presence_penalty) >= _SAMPLING_EPS or \
+                abs(p.frequency_penalty) >= _SAMPLING_EPS or \
+                abs(p.repetition_penalty - 1.0) >= _SAMPLING_EPS:
+            do["penalties"] = True
+
+        is_prompt = group_idx < len(metadata.prompt_lens)
+        rows: List[int] = []
+        if is_prompt and p.prompt_logprobs is not None:
+            rows.extend([seq_ids[0]] * (metadata.prompt_lens[group_idx] - 1))
+        rows.extend(seq_ids)
+        if is_prompt:
+            prompt_idx += 1
+
+        for seq_id in rows:
+            data = metadata.seq_data[seq_id]
+            temperatures.append(temperature)
+            dyn_range = p.dynatemp_range
+            dynatemp_mins.append(max(temperature - dyn_range, 0.0))
+            dynatemp_maxs.append(temperature + dyn_range)
+            dynatemp_exps.append(p.dynatemp_exponent)
+            top_ps.append(p.top_p)
+            top_ks.append(vocab_size if p.top_k == -1
+                          else min(p.top_k, vocab_size))
+            top_as.append(p.top_a)
+            min_ps.append(p.min_p)
+            tfss.append(p.tfs)
+            eta.append(p.eta_cutoff)
+            eps.append(p.epsilon_cutoff)
+            typical.append(p.typical_p)
+            smoothing.append(p.smoothing_factor)
+            miro_taus.append(p.mirostat_tau)
+            miro_etas.append(p.mirostat_eta)
+            mu = metadata.persistent_metadata.get(seq_id).get(
+                "miro_mu", 2.0 * p.mirostat_tau)
+            miro_mus.append(mu)
+            pres_pen.append(p.presence_penalty)
+            freq_pen.append(p.frequency_penalty)
+            rep_pen.append(p.repetition_penalty)
+            prompt_tokens.append(list(data.prompt_token_ids))
+            output_tokens.append(list(data.output_token_ids))
+            banned_tokens.append(list(p.custom_token_bans))
+            row_to_seq[len(temperatures) - 1] = seq_id
+
+    f = lambda x: jnp.asarray(np.asarray(x, dtype=np.float32), dtype=dtype)
+    tensors = SamplingTensors(
+        temperatures=f(temperatures),
+        dynatemp_mins=f(dynatemp_mins),
+        dynatemp_maxs=f(dynatemp_maxs),
+        dynatemp_exps=f(dynatemp_exps),
+        top_ps=f(top_ps),
+        top_ks=jnp.asarray(np.asarray(top_ks, dtype=np.int32)),
+        top_as=f(top_as),
+        min_ps=f(min_ps),
+        tfss=f(tfss),
+        eta_cutoffs=f(eta),
+        epsilon_cutoffs=f(eps),
+        typical_ps=f(typical),
+        miro_taus=f(miro_taus),
+        miro_etas=f(miro_etas),
+        miro_mus=f(miro_mus),
+        smoothing_factors=f(smoothing),
+        presence_penalties=f(pres_pen),
+        frequency_penalties=f(freq_pen),
+        repetition_penalties=f(rep_pen),
+        prompt_tokens=jnp.asarray(_pad_2d(prompt_tokens, vocab_size)),
+        output_tokens=jnp.asarray(_pad_2d(output_tokens, vocab_size)),
+        banned_tokens=jnp.asarray(_pad_2d(banned_tokens, vocab_size)),
+        do_penalties=do["penalties"],
+        do_temperatures=do["temperatures"],
+        do_top_p_top_k=do["top_p_top_k"],
+        do_top_as=do["top_as"],
+        do_min_p=do["min_p"],
+        do_tfss=do["tfss"],
+        do_eta_cutoffs=do["eta"],
+        do_epsilon_cutoffs=do["epsilon"],
+        do_typical_ps=do["typical"],
+        do_quadratic=do["quadratic"],
+        do_mirostat=do["mirostat"],
+        do_token_bans=do["bans"],
+    )
+    return tensors, row_to_seq
