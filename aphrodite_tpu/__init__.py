@@ -31,6 +31,9 @@ def __getattr__(name):
         from aphrodite_tpu.engine.args_tools import EngineArgs
         return EngineArgs
     if name == "AphroditeEngine":
-        from aphrodite_tpu.engine.engine import AphroditeEngine
+        from aphrodite_tpu.engine.aphrodite_engine import AphroditeEngine
         return AphroditeEngine
+    if name == "AsyncAphrodite":
+        from aphrodite_tpu.engine.async_aphrodite import AsyncAphrodite
+        return AsyncAphrodite
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,1 @@
+"""User-facing entrypoints: offline LLM class + HTTP servers."""

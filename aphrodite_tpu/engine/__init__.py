@@ -1,0 +1,1 @@
+"""Engine layer: request lifecycle, scheduling loop, async serving."""

@@ -1,0 +1,379 @@
+"""The core engine: request lifecycle + step loop.
+
+Reference: `aphrodite/engine/aphrodite_engine.py` (AphroditeEngine `:37`,
+add_request `:387`, step `:754`, _process_sequence_group_outputs `:550`,
+_check_stop `:913`, _decode_sequence `:893`, from_engine_args `:359`).
+
+TPU-native simplifications vs the reference: no Ray bootstrap, no
+`_run_workers` fan-out — the single TPUExecutor drives the whole (possibly
+multi-chip SPMD) replica, so `step()` is:
+schedule -> executor.execute_model -> process outputs. Everything else
+(beam-search output processing, stop conditions, incremental detok,
+prefix pool, metrics) keeps reference semantics.
+"""
+from __future__ import annotations
+
+import time
+from typing import Iterable, List, Optional, Union
+
+from aphrodite_tpu.common.config import (CacheConfig, DeviceConfig,
+                                         LoRAConfig, ModelConfig,
+                                         ParallelConfig, SchedulerConfig)
+from aphrodite_tpu.common.logger import init_logger
+from aphrodite_tpu.common.outputs import RequestOutput
+from aphrodite_tpu.common.sampling_params import SamplingParams
+from aphrodite_tpu.common.sequence import (SamplerOutput, Sequence,
+                                           SequenceGroup,
+                                           SequenceGroupOutput,
+                                           SequenceStatus)
+from aphrodite_tpu.engine.args_tools import EngineArgs
+from aphrodite_tpu.engine.metrics import StatLogger, Stats
+from aphrodite_tpu.executor.executor import TPUExecutor
+from aphrodite_tpu.processing.scheduler import (Scheduler,
+                                                SchedulerOutputs)
+from aphrodite_tpu.transformers_utils.tokenizer import (
+    TokenizerGroup, detokenize_incrementally)
+from aphrodite_tpu.common.utils import Counter
+
+logger = init_logger(__name__)
+
+
+class AphroditeEngine:
+    """Synchronous engine; AsyncAphrodite wraps it for serving."""
+
+    def __init__(
+        self,
+        model_config: ModelConfig,
+        cache_config: CacheConfig,
+        parallel_config: ParallelConfig,
+        scheduler_config: SchedulerConfig,
+        device_config: DeviceConfig,
+        lora_config: Optional[LoRAConfig],
+        log_stats: bool = False,
+        skip_tokenizer_init: bool = False,
+    ) -> None:
+        logger.info(
+            "Initializing TPU engine: model=%r dtype=%s max_len=%d "
+            "tp=%d pp=%d dp=%d kv_dtype=%s seed=%d",
+            model_config.model, model_config.dtype,
+            model_config.max_model_len,
+            parallel_config.tensor_parallel_size,
+            parallel_config.pipeline_parallel_size,
+            parallel_config.data_parallel_size,
+            cache_config.cache_dtype, model_config.seed)
+        self.model_config = model_config
+        self.cache_config = cache_config
+        self.parallel_config = parallel_config
+        self.scheduler_config = scheduler_config
+        self.device_config = device_config
+        self.lora_config = lora_config
+        self.log_stats = log_stats
+
+        if skip_tokenizer_init:
+            self.tokenizer = None
+        else:
+            self._init_tokenizer()
+        self.seq_counter = Counter()
+
+        self.executor = TPUExecutor(model_config, cache_config,
+                                    parallel_config, scheduler_config,
+                                    device_config)
+        self.scheduler = Scheduler(scheduler_config, cache_config,
+                                   lora_config)
+        self.stat_logger = StatLogger(
+            labels=dict(model_name=model_config.model)) if log_stats \
+            else None
+
+    # -- construction --
+
+    @classmethod
+    def from_engine_args(cls, engine_args: EngineArgs) -> "AphroditeEngine":
+        configs = engine_args.create_engine_configs()
+        engine = cls(*configs, log_stats=not engine_args.disable_log_stats,
+                     skip_tokenizer_init=engine_args.skip_tokenizer_init)
+        return engine
+
+    def _init_tokenizer(self, **kwargs) -> None:
+        init_kwargs = dict(
+            enable_lora=bool(self.lora_config),
+            max_num_seqs=self.scheduler_config.max_num_seqs,
+            max_input_length=None,
+            tokenizer_mode=self.model_config.tokenizer_mode,
+            trust_remote_code=self.model_config.trust_remote_code,
+            tokenizer_revision=self.model_config.tokenizer_revision)
+        init_kwargs.update(kwargs)
+        self.tokenizer = TokenizerGroup(self.model_config.tokenizer,
+                                        **init_kwargs)
+
+    # -- request lifecycle --
+
+    def add_request(
+        self,
+        request_id: str,
+        prompt: Optional[str],
+        sampling_params: SamplingParams,
+        prompt_token_ids: Optional[List[int]] = None,
+        arrival_time: Optional[float] = None,
+        prefix_pos: Optional[int] = None,
+    ) -> None:
+        """Tokenize, build the seq group, hand to the scheduler
+        (reference add_request :387-469)."""
+        if arrival_time is None:
+            arrival_time = time.monotonic()
+        if prompt_token_ids is None:
+            assert prompt is not None
+            prompt_token_ids = self.tokenizer.encode(prompt)
+
+        block_size = self.cache_config.block_size
+        seq_id = next(self.seq_counter)
+        seq = Sequence(seq_id, prompt, prompt_token_ids, block_size)
+
+        prefix = None
+        if prefix_pos is not None:
+            prefix = self.scheduler.prefix_pool.add_or_get_prefix(
+                prompt_token_ids[:prefix_pos])
+
+        seq_group = SequenceGroup(request_id, [seq], sampling_params,
+                                  arrival_time, prefix=prefix)
+        self.scheduler.add_seq_group(seq_group)
+
+    def abort_request(self, request_id: Union[str, Iterable[str]]) -> None:
+        self.scheduler.abort_seq_group(request_id)
+
+    def get_model_config(self) -> ModelConfig:
+        return self.model_config
+
+    def get_num_unfinished_requests(self) -> int:
+        return self.scheduler.get_num_unfinished_seq_groups()
+
+    def has_unfinished_requests(self) -> bool:
+        return self.scheduler.has_unfinished_seqs()
+
+    # -- the step --
+
+    def step(self) -> List[RequestOutput]:
+        """One engine iteration = (usually) one new token per running seq
+        (reference step :754-828)."""
+        seq_group_metadata_list, scheduler_outputs = \
+            self.scheduler.schedule()
+
+        if not scheduler_outputs.is_empty():
+            output = self.executor.execute_model(
+                seq_group_metadata_list,
+                scheduler_outputs.blocks_to_swap_in,
+                scheduler_outputs.blocks_to_swap_out,
+                scheduler_outputs.blocks_to_copy)
+        else:
+            output = []
+
+        return self._process_model_outputs(output, scheduler_outputs)
+
+    # -- output processing (reference :550-752) --
+
+    def _process_model_outputs(
+            self, output: SamplerOutput,
+            scheduler_outputs: SchedulerOutputs) -> List[RequestOutput]:
+        scheduled_seq_groups = scheduler_outputs.scheduled_seq_groups
+        for seq_group, outputs in zip(scheduled_seq_groups, output):
+            self._process_sequence_group_outputs(seq_group, outputs)
+
+        self.scheduler.free_finished_seq_groups()
+
+        request_outputs: List[RequestOutput] = []
+        for seq_group in scheduled_seq_groups:
+            request_outputs.append(RequestOutput.from_seq_group(seq_group))
+        for seq_group in scheduler_outputs.ignored_seq_groups:
+            request_outputs.append(RequestOutput.from_seq_group(seq_group))
+
+        if self.stat_logger is not None:
+            self.stat_logger.log(
+                self._get_stats(scheduler_outputs))
+        return request_outputs
+
+    def _process_sequence_group_outputs(
+            self, seq_group: SequenceGroup,
+            outputs: SequenceGroupOutput) -> None:
+        # Prompt logprobs.
+        if outputs.prompt_logprobs is not None:
+            seq_group.prompt_logprobs = outputs.prompt_logprobs
+
+        samples = outputs.samples
+        parent_seqs = seq_group.get_seqs(status=SequenceStatus.RUNNING)
+        existing_finished_seqs = seq_group.get_finished_seqs()
+        parent_child_dict = {seq.seq_id: [] for seq in parent_seqs}
+        for sample in samples:
+            parent_child_dict[sample.parent_seq_id].append(sample)
+
+        child_seqs = []
+        for parent in parent_seqs:
+            child_samples = parent_child_dict[parent.seq_id]
+            if not child_samples:
+                # Dropped by beam pruning: free.
+                parent.status = SequenceStatus.FINISHED_ABORTED
+                seq_group.remove(parent.seq_id)
+                self.scheduler.free_seq(parent)
+                continue
+            for child_sample in child_samples[:-1]:
+                new_child_seq_id = next(self.seq_counter)
+                child = parent.fork(new_child_seq_id)
+                child.append_token_id(child_sample.output_token,
+                                      child_sample.logprobs)
+                child.persistent_data = child_sample.persistent_data
+                child_seqs.append((child, parent))
+            last = child_samples[-1]
+            parent.append_token_id(last.output_token, last.logprobs)
+            parent.persistent_data = last.persistent_data
+            child_seqs.append((parent, parent))
+
+        for seq, _ in child_seqs:
+            self._decode_sequence(seq, seq_group.sampling_params)
+            self._check_stop(seq, seq_group.sampling_params)
+
+        if not seq_group.sampling_params.use_beam_search:
+            # Non-beam: fork new children in the scheduler, free finished.
+            for seq, parent in child_seqs:
+                if seq is not parent:
+                    seq_group.add(seq)
+                    self.scheduler.fork_seq(parent, seq)
+            for seq, parent in child_seqs:
+                if seq is parent and seq.is_finished():
+                    self.scheduler.free_seq(seq)
+            return
+
+        # ---- beam search selection (reference :622-721) ----
+        params = seq_group.sampling_params
+        beam_width = params.best_of
+        length_penalty = params.length_penalty
+
+        new_finished = [(seq, parent) for seq, parent in child_seqs
+                        if seq.is_finished()]
+        existing_finished = [(seq, None) for seq in existing_finished_seqs]
+        all_finished = existing_finished + new_finished
+        all_finished.sort(
+            key=lambda x: x[0].get_beam_search_score(length_penalty),
+            reverse=True)
+        for seq, parent in all_finished[:beam_width]:
+            if parent is not None and seq is not parent:
+                seq_group.add(seq)
+                if not seq.is_finished():
+                    self.scheduler.fork_seq(parent, seq)
+        for seq, parent in all_finished[beam_width:]:
+            if parent is None:
+                seq_group.remove(seq.seq_id)      # existing, now pruned
+            elif seq is not parent:
+                pass                              # never added: drop
+            else:
+                seq_group.remove(seq.seq_id)
+                self.scheduler.free_seq(seq)
+
+        running = [(seq, parent) for seq, parent in child_seqs
+                   if not seq.is_finished()]
+        running.sort(
+            key=lambda x: x[0].get_beam_search_score(length_penalty),
+            reverse=True)
+        stop = self._check_beam_search_early_stopping(
+            params.early_stopping, params, all_finished, running)
+
+        for seq, parent in running[:beam_width]:
+            if seq is not parent:
+                seq_group.add(seq)
+                self.scheduler.fork_seq(parent, seq)
+        for seq, parent in running[beam_width:]:
+            if seq is parent:
+                seq_group.remove(seq.seq_id)
+                self.scheduler.free_seq(seq)
+
+    def _check_beam_search_early_stopping(self, early_stopping, params,
+                                          finished, running) -> bool:
+        if not finished or not running:
+            return False
+        if early_stopping is True:
+            return len(finished) >= params.best_of
+        return False
+
+    def _decode_sequence(self, seq: Sequence,
+                         params: SamplingParams) -> None:
+        """Incremental detokenization (reference :893-911)."""
+        if self.tokenizer is None:     # token-id-only mode (benchmarks)
+            return
+        tokenizer = self.tokenizer.get_lora_tokenizer()
+        (new_tokens, new_output_text, prefix_offset,
+         read_offset) = detokenize_incrementally(
+             tokenizer,
+             all_input_ids=seq.get_token_ids(),
+             prev_tokens=seq.tokens,
+             prefix_offset=seq.prefix_offset,
+             read_offset=seq.read_offset,
+             skip_special_tokens=params.skip_special_tokens,
+             spaces_between_special_tokens=
+             params.spaces_between_special_tokens)
+        if seq.tokens is None:
+            seq.tokens = new_tokens
+        else:
+            seq.tokens.extend(new_tokens)
+        seq.prefix_offset = prefix_offset
+        seq.read_offset = read_offset
+        seq.output_text += new_output_text
+
+    def _check_stop(self, seq: Sequence,
+                    params: SamplingParams) -> None:
+        """Stop conditions (reference _check_stop :913-959)."""
+        for stop_str in params.stop:
+            if seq.output_text.endswith(stop_str):
+                if not params.include_stop_str_in_output:
+                    seq.output_text = \
+                        seq.output_text[:-len(stop_str)]
+                seq.status = SequenceStatus.FINISHED_STOPPED
+                return
+        if seq.get_last_token_id() in params.stop_token_ids:
+            seq.status = SequenceStatus.FINISHED_STOPPED
+            return
+        if seq.get_len() > self.scheduler_config.max_model_len:
+            seq.status = SequenceStatus.FINISHED_LENGTH_CAPPED
+            return
+        if seq.get_output_len() == params.max_tokens:
+            seq.status = SequenceStatus.FINISHED_LENGTH_CAPPED
+            return
+        if (not params.ignore_eos and self.tokenizer is not None and
+                seq.get_last_token_id() ==
+                self.tokenizer.get_lora_tokenizer().eos_token_id):
+            seq.status = SequenceStatus.FINISHED_STOPPED
+            return
+
+    # -- stats (reference _get_stats :830-891) --
+
+    def _get_stats(self,
+                   scheduler_outputs: Optional[SchedulerOutputs]) -> Stats:
+        now = time.monotonic()
+        num_total_gpu = self.cache_config.num_gpu_blocks or 1
+        num_free_gpu = \
+            self.scheduler.block_manager.get_num_free_gpu_blocks()
+        gpu_cache_usage = 1.0 - num_free_gpu / num_total_gpu
+        num_total_cpu = self.cache_config.num_cpu_blocks or 0
+        cpu_cache_usage = 0.0
+        if num_total_cpu > 0:
+            num_free_cpu = \
+                self.scheduler.block_manager.get_num_free_cpu_blocks()
+            cpu_cache_usage = 1.0 - num_free_cpu / num_total_cpu
+
+        num_prompt_tokens = 0
+        num_generation_tokens = 0
+        if scheduler_outputs is not None:
+            if scheduler_outputs.prompt_run:
+                num_prompt_tokens = scheduler_outputs.num_batched_tokens
+            else:
+                num_generation_tokens = \
+                    scheduler_outputs.num_batched_tokens
+
+        return Stats(
+            now=now,
+            num_running=len(self.scheduler.running),
+            num_waiting=len(self.scheduler.waiting),
+            num_swapped=len(self.scheduler.swapped),
+            gpu_cache_usage=gpu_cache_usage,
+            cpu_cache_usage=cpu_cache_usage,
+            num_prompt_tokens=num_prompt_tokens,
+            num_generation_tokens=num_generation_tokens,
+            time_to_first_tokens=[],
+            time_per_output_tokens=[],
+            time_e2e_requests=[])

@@ -1,0 +1,4 @@
+"""Execution layer: KV cache engine + model runner + single/multi-chip
+executors. TPU-native replacement for the reference's `task_handler/`
+(Worker/ModelRunner/CacheEngine) — no Ray, no NCCL: one host process
+drives SPMD-jitted step functions over a jax.sharding.Mesh."""

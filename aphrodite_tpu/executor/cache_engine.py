@@ -1,0 +1,161 @@
+"""KV-cache allocation and swap execution.
+
+Reference: `aphrodite/task_handler/cache_engine.py` (alloc `:48-49`, swap
+on side stream `:118-134`, copy `:136-146`) and the CUDA cache kernels
+(`kernels/cache_kernels.cu`).
+
+TPU-native: per layer the cache is (k_pages, v_pages) arrays of shape
+[num_kv_heads, num_pages, page_size, head_dim] (see ops/kv_cache.py for
+the layout rationale). Swap space is pinned host numpy; swap_in/out are
+`jax.device_put`/`device_get` of whole pages — JAX dispatches these
+asynchronously, which replaces the reference's dedicated CUDA stream +
+event machinery. Copy-on-write page copies run as one fused gather/
+scatter inside the jitted step (ops.kv_cache.copy_blocks).
+
+Under a mesh, pages are sharded over the tp axis on the kv-head dim —
+each chip holds its heads' pages, the direct analog of the reference's
+per-worker cache (`cache_engine.py:48`, num_heads divided by TP).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from aphrodite_tpu.common.config import CacheConfig, ModelConfig, ParallelConfig
+from aphrodite_tpu.common.logger import init_logger
+
+logger = init_logger(__name__)
+
+KVCache = Tuple[jax.Array, jax.Array]
+
+_CACHE_DTYPES = {
+    "auto": None,                 # follow model dtype
+    "fp8": jnp.float8_e5m2,
+    "int8": jnp.int8,
+}
+
+_MODEL_DTYPES = {
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+}
+
+
+class CacheEngine:
+    """Owns the paged KV cache for every layer + the host swap pool."""
+
+    def __init__(
+        self,
+        cache_config: CacheConfig,
+        model_config: ModelConfig,
+        parallel_config: ParallelConfig,
+        mesh: Optional[Mesh] = None,
+    ) -> None:
+        self.cache_config = cache_config
+        self.model_config = model_config
+        self.mesh = mesh
+
+        self.page_size = cache_config.block_size
+        self.num_device_pages = cache_config.num_gpu_blocks
+        self.num_host_pages = cache_config.num_cpu_blocks or 0
+        assert self.num_device_pages is not None
+
+        self.num_layers = model_config.hf_config.num_hidden_layers
+        self.num_kv_heads = model_config.get_total_num_kv_heads()
+        self.head_size = model_config.get_head_size()
+
+        model_dtype = _MODEL_DTYPES[model_config.dtype]
+        quant = _CACHE_DTYPES[cache_config.cache_dtype]
+        self.dtype = quant if quant is not None else model_dtype
+
+        self.kv_caches: List[KVCache] = self._allocate_device()
+        # Host swap pool: [layers, 2, heads, pages, page, dim] numpy.
+        self._host_pool: Optional[np.ndarray] = None
+        if self.num_host_pages > 0:
+            self._host_pool = np.zeros(
+                (self.num_layers, 2, self.num_kv_heads,
+                 self.num_host_pages, self.page_size, self.head_size),
+                dtype=np.float32)
+
+    # -- allocation --
+
+    def _page_shape(self) -> Tuple[int, int, int, int]:
+        return (self.num_kv_heads, self.num_device_pages, self.page_size,
+                self.head_size)
+
+    def _allocate_device(self) -> List[KVCache]:
+        shape = self._page_shape()
+        sharding = None
+        if self.mesh is not None:
+            sharding = NamedSharding(self.mesh, P("tp", None, None, None))
+
+        def alloc():
+            z = jnp.zeros(shape, dtype=self.dtype)
+            if sharding is not None:
+                z = jax.device_put(z, sharding)
+            return z
+
+        return [(alloc(), alloc()) for _ in range(self.num_layers)]
+
+    @property
+    def num_slots(self) -> int:
+        return self.num_device_pages * self.page_size
+
+    # -- swap --
+
+    def swap_out(self, mapping: Dict[int, int]) -> None:
+        """Device pages -> host pool (reference swap_out :141)."""
+        if not mapping:
+            return
+        src = np.fromiter(mapping.keys(), dtype=np.int64)
+        dst = np.fromiter(mapping.values(), dtype=np.int64)
+        for layer, (k_pages, v_pages) in enumerate(self.kv_caches):
+            # One bulk gather per side, then a single host transfer.
+            k_host = np.asarray(jnp.take(k_pages, src, axis=1),
+                                dtype=np.float32)
+            v_host = np.asarray(jnp.take(v_pages, src, axis=1),
+                                dtype=np.float32)
+            self._host_pool[layer, 0][:, dst] = k_host
+            self._host_pool[layer, 1][:, dst] = v_host
+
+    def swap_in(self, mapping: Dict[int, int]) -> None:
+        """Host pool -> device pages (reference swap_in :136)."""
+        if not mapping:
+            return
+        src = np.fromiter(mapping.keys(), dtype=np.int64)
+        dst = np.fromiter(mapping.values(), dtype=np.int64)
+        new_caches: List[KVCache] = []
+        for layer, (k_pages, v_pages) in enumerate(self.kv_caches):
+            k_in = jnp.asarray(self._host_pool[layer, 0][:, src],
+                               dtype=self.dtype)
+            v_in = jnp.asarray(self._host_pool[layer, 1][:, src],
+                               dtype=self.dtype)
+            k_pages = k_pages.at[:, dst].set(k_in)
+            v_pages = v_pages.at[:, dst].set(v_in)
+            new_caches.append((k_pages, v_pages))
+        self.kv_caches = new_caches
+
+    @staticmethod
+    def get_cache_block_size(cache_config: CacheConfig,
+                             model_config: ModelConfig,
+                             parallel_config: ParallelConfig) -> int:
+        """Bytes per page across all layers (reference
+        `cache_engine.py:148-171`), for the profiling -> page-count math.
+        Uses TOTAL kv heads: with TP sharding each chip holds
+        heads/tp, but it also only gets budget/tp of the pool."""
+        num_layers = model_config.hf_config.num_hidden_layers
+        num_heads = model_config.get_total_num_kv_heads()
+        head_size = model_config.get_head_size()
+        if cache_config.cache_dtype in ("fp8", "int8"):
+            elt = 1
+        elif model_config.dtype == "float32":
+            elt = 4
+        else:
+            elt = 2
+        per_token = num_heads * head_size * elt
+        return 2 * num_layers * cache_config.block_size * per_token

@@ -1,0 +1,140 @@
+"""TPUExecutor: owns the mesh, model, KV cache, and model runner.
+
+TPU-native replacement for the reference's `task_handler/worker.py` +
+`engine/ray_tools.py`: where the reference spawns one Ray actor per GPU
+and NCCL-broadcasts per-step metadata (`worker.py:187-212`), a TPU slice
+is driven by ONE host process whose jitted step function is SPMD over a
+`jax.sharding.Mesh` — the control plane collapses into XLA (SURVEY.md
+§2.3). Multi-host TPU pods use jax.distributed with the same code.
+
+Memory profiling (reference `profile_num_available_blocks`,
+`worker.py:102-143`) becomes: load weights, read the device's memory
+stats, and give the KV cache `gpu_memory_utilization` of what remains.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+
+from aphrodite_tpu.common.config import (CacheConfig, DeviceConfig,
+                                         ModelConfig, ParallelConfig,
+                                         SchedulerConfig)
+from aphrodite_tpu.common.logger import init_logger
+from aphrodite_tpu.common.sequence import (SamplerOutput,
+                                           SequenceGroupMetadata)
+from aphrodite_tpu.executor.cache_engine import CacheEngine
+from aphrodite_tpu.executor.model_runner import ModelRunner
+from aphrodite_tpu.modeling.loader import get_model
+
+logger = init_logger(__name__)
+
+_GB = 1 << 30
+# Fallback HBM budget when the backend exposes no memory stats (CPU
+# tests): enough for a few hundred tiny-model pages.
+_FALLBACK_CACHE_BYTES = 256 << 20
+
+
+def build_mesh(parallel_config: ParallelConfig,
+               device_config: DeviceConfig):
+    """Construct the (dp, pp, tp) mesh, or None for a single device."""
+    if parallel_config.world_size == 1:
+        return None
+    from jax.sharding import Mesh
+    devices = jax.devices()
+    if len(devices) < parallel_config.world_size:
+        raise ValueError(
+            f"world_size {parallel_config.world_size} exceeds available "
+            f"devices ({len(devices)}).")
+    shape = (parallel_config.data_parallel_size,
+             parallel_config.pipeline_parallel_size,
+             parallel_config.tensor_parallel_size)
+    mesh_devices = np.asarray(
+        devices[:parallel_config.world_size]).reshape(shape)
+    return Mesh(mesh_devices, ("dp", "pp", "tp"))
+
+
+class TPUExecutor:
+    """Single-replica executor (the engine's only 'worker')."""
+
+    def __init__(
+        self,
+        model_config: ModelConfig,
+        cache_config: CacheConfig,
+        parallel_config: ParallelConfig,
+        scheduler_config: SchedulerConfig,
+        device_config: DeviceConfig,
+    ) -> None:
+        self.model_config = model_config
+        self.cache_config = cache_config
+        self.parallel_config = parallel_config
+        self.scheduler_config = scheduler_config
+
+        self.mesh = build_mesh(parallel_config, device_config)
+        logger.info("Loading model %s ...", model_config.model)
+        self.model, self.params = get_model(model_config, self.mesh)
+
+        self._profile_and_size_cache()
+        self.cache_engine = CacheEngine(cache_config, model_config,
+                                        parallel_config, self.mesh)
+        self.model_runner = ModelRunner(
+            self.model, self.params, model_config, scheduler_config,
+            page_size=cache_config.block_size,
+            num_slots=self.cache_engine.num_slots,
+            mesh=self.mesh)
+
+    # -- sizing --
+
+    def _device_free_memory(self) -> int:
+        try:
+            stats = jax.devices()[0].memory_stats()
+            limit = stats.get("bytes_limit")
+            in_use = stats.get("bytes_in_use", 0)
+            if limit:
+                return int(limit - in_use)
+        except Exception:      # CPU backend has no memory_stats
+            pass
+        return 0
+
+    def _profile_and_size_cache(self) -> None:
+        if self.cache_config.num_gpu_blocks is not None:
+            return                       # explicitly sized (tests)
+        block_bytes = CacheEngine.get_cache_block_size(
+            self.cache_config, self.model_config, self.parallel_config)
+        free = self._device_free_memory()
+        if free <= 0:
+            budget = _FALLBACK_CACHE_BYTES
+        else:
+            # Weights are already resident; give the cache the configured
+            # fraction of what remains (leaving headroom for activations).
+            budget = int(free * self.cache_config.gpu_memory_utilization)
+        num_pages = max(budget // block_bytes, 16)
+        self.cache_config.num_gpu_blocks = int(num_pages)
+        if self.cache_config.num_cpu_blocks is None:
+            self.cache_config.num_cpu_blocks = int(
+                self.cache_config.swap_space_bytes // block_bytes)
+        logger.info("KV cache: %d device pages, %d host pages "
+                    "(%.2f GiB device)", self.cache_config.num_gpu_blocks,
+                    self.cache_config.num_cpu_blocks,
+                    num_pages * block_bytes / _GB)
+
+    # -- step execution --
+
+    def execute_model(
+        self,
+        seq_group_metadata_list: List[SequenceGroupMetadata],
+        blocks_to_swap_in: Dict[int, int],
+        blocks_to_swap_out: Dict[int, int],
+        blocks_to_copy: Dict[int, List[int]],
+    ) -> SamplerOutput:
+        if blocks_to_swap_out:
+            self.cache_engine.swap_out(blocks_to_swap_out)
+        if blocks_to_swap_in:
+            self.cache_engine.swap_in(blocks_to_swap_in)
+
+        output, new_caches = self.model_runner.execute_model(
+            seq_group_metadata_list, self.cache_engine.kv_caches,
+            blocks_to_copy)
+        self.cache_engine.kv_caches = new_caches
+        return output

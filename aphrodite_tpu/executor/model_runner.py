@@ -1,0 +1,323 @@
+"""Builds fixed-shape batches and runs the jitted step functions.
+
+Reference: `aphrodite/task_handler/model_runner.py` (_prepare_prompt
+`:102`, _prepare_decode `:245`, _prepare_sample `:372`, CUDA-graph capture
+`:654`). TPU-native mapping:
+
+- The reference's CUDA-graph batch-size buckets (`model_runner.py:31`)
+  become jit compile-cache buckets: every (phase, batch-bucket,
+  seq/page-bucket) shape compiles once and is replayed from XLA's
+  compilation cache — same amortization, no graph API needed.
+- Ragged host lists are padded into the fixed-shape InputMetadata ABI;
+  padded lanes use out-of-range indices so cache scatters drop them
+  (see ops/kv_cache.py).
+- KV page buffers are DONATED to the step function, so the cache update
+  is in-place in HBM (reference updates in place by pointer).
+- Sampling runs on the real (unpadded) logit rows.
+"""
+from __future__ import annotations
+
+import bisect
+import functools
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from aphrodite_tpu.common.config import (ModelConfig, ParallelConfig,
+                                         SchedulerConfig)
+from aphrodite_tpu.common.logger import init_logger
+from aphrodite_tpu.common.sequence import (SamplerOutput,
+                                           SequenceGroupMetadata)
+from aphrodite_tpu.modeling.input_metadata import InputMetadata
+from aphrodite_tpu.modeling.layers.sampler import Sampler
+from aphrodite_tpu.modeling.sampling_metadata import (OutputMetadata,
+                                                      PersistentMetadata,
+                                                      SamplingMetadata)
+from aphrodite_tpu.ops.kv_cache import copy_blocks as _copy_blocks_op
+
+logger = init_logger(__name__)
+
+# Decode batch buckets (reference capture sizes, model_runner.py:31).
+_DECODE_BATCH_BUCKETS = [1, 2, 4] + [8 * i for i in range(1, 33)]
+_PREFILL_BATCH_BUCKETS = [1, 2, 4, 8, 16, 32]
+_PAGES_BUCKET = 8          # block-table width granularity (Pallas chunk)
+
+
+def _bucket(value: int, buckets: List[int]) -> int:
+    idx = bisect.bisect_left(buckets, value)
+    if idx == len(buckets):
+        return buckets[-1] if value <= buckets[-1] else value
+    return buckets[idx]
+
+
+def _pow2_bucket(value: int, lo: int = 16) -> int:
+    b = lo
+    while b < value:
+        b *= 2
+    return b
+
+
+class ModelRunner:
+    """Drives one model replica (single chip or one SPMD mesh)."""
+
+    def __init__(
+        self,
+        model,
+        params,
+        model_config: ModelConfig,
+        scheduler_config: SchedulerConfig,
+        page_size: int,
+        num_slots: int,
+        mesh=None,
+    ) -> None:
+        self.model = model
+        self.params = params
+        self.model_config = model_config
+        self.scheduler_config = scheduler_config
+        self.page_size = page_size
+        self.num_slots = num_slots          # OOB pad value for slots
+        self.mesh = mesh
+        self.sampler = Sampler(model_config.get_vocab_size())
+
+        # One jitted program per (is_prompt, use_prefix); shape buckets
+        # land in XLA's compile cache keyed by array shapes.
+        self._step_fn = jax.jit(
+            self._step,
+            static_argnames=("is_prompt", "use_prefix"),
+            donate_argnums=(3,),      # kv_caches
+        )
+        self._copy_fn = jax.jit(self._copy_blocks, donate_argnums=(0,))
+
+    # ---- jitted bodies ----
+
+    def _step(self, params, input_ids, positions, kv_caches, metadata,
+              sel_indices, *, is_prompt: bool, use_prefix: bool):
+        meta = metadata.replace(is_prompt=is_prompt, use_prefix=use_prefix)
+        hidden, new_caches = self.model(params, input_ids, positions,
+                                        kv_caches, meta)
+        flat = hidden.reshape(-1, hidden.shape[-1])
+        rows = jnp.take(flat, sel_indices, axis=0)
+        logits = self.model.compute_logits(params, rows)
+        return logits, new_caches
+
+    def _copy_blocks(self, kv_caches, src, dst):
+        return [
+            _copy_blocks_op(k, v, src, dst) for (k, v) in kv_caches
+        ]
+
+    # ---- host batch builders ----
+
+    def _prepare_prompt(
+        self, seq_group_metadata_list: List[SequenceGroupMetadata]
+    ) -> Tuple[dict, SamplingMetadata]:
+        batch = len(seq_group_metadata_list)
+        padded_batch = _bucket(batch, _PREFILL_BATCH_BUCKETS)
+
+        prompt_lens: List[int] = []
+        seq_groups, seq_data_map = [], {}
+        use_prefix = False
+        for md in seq_group_metadata_list:
+            seq_id = next(iter(md.seq_data))
+            data = md.seq_data[seq_id]
+            # Chunk to compute = tokens not yet in cache (prefix cached).
+            ctx = 0
+            if md.prefix is not None and md.prefix.computed:
+                ctx = md.prefix.get_length()
+                use_prefix = True
+            prompt_lens.append(data.get_len() - ctx)
+            seq_groups.append(([seq_id], md.sampling_params))
+            seq_data_map[seq_id] = data
+
+        max_len = max(prompt_lens)
+        padded_len = _pow2_bucket(max_len)
+
+        ids = np.zeros((padded_batch, padded_len), dtype=np.int32)
+        pos = np.zeros((padded_batch, padded_len), dtype=np.int32)
+        slots = np.full((padded_batch * padded_len,), self.num_slots,
+                        dtype=np.int32)
+        ctx_lens = np.zeros((padded_batch,), dtype=np.int32)
+        plens = np.zeros((padded_batch,), dtype=np.int32)
+        max_pages = _PAGES_BUCKET
+        if use_prefix:
+            max_pages = max(
+                _PAGES_BUCKET,
+                -(-max((len(next(iter(md.block_tables.values()), []))
+                        for md in seq_group_metadata_list),
+                       default=1) // _PAGES_BUCKET) * _PAGES_BUCKET)
+        num_pages_oob = self.num_slots // self.page_size
+        tables = np.full((padded_batch, max_pages), num_pages_oob,
+                         dtype=np.int32)
+
+        selected: List[int] = []
+        sel_offset = 0
+        for i, md in enumerate(seq_group_metadata_list):
+            seq_id = next(iter(md.seq_data))
+            data = md.seq_data[seq_id]
+            all_tokens = data.get_token_ids()
+            ctx = 0
+            if md.prefix is not None and md.prefix.computed:
+                ctx = md.prefix.get_length()
+            chunk = all_tokens[ctx:]
+            n = len(chunk)
+            ids[i, :n] = chunk
+            pos[i, :n] = np.arange(ctx, ctx + n)
+            ctx_lens[i] = ctx
+            plens[i] = n
+            table = md.block_tables.get(seq_id, [])
+            tables[i, :len(table)] = table
+            for j in range(n):
+                abs_pos = ctx + j
+                page = table[abs_pos // self.page_size]
+                slots[i * padded_len + j] = (page * self.page_size +
+                                             abs_pos % self.page_size)
+            # Sampler rows: all prompt positions if prompt_logprobs else
+            # just the last (reference _prepare_sample, :372-451).
+            if md.sampling_params.prompt_logprobs is not None:
+                selected.extend(range(i * padded_len,
+                                      i * padded_len + n))
+            else:
+                selected.append(i * padded_len + n - 1)
+            sel_offset += n
+
+        metadata = InputMetadata(
+            slot_mapping=jnp.asarray(slots),
+            block_tables=jnp.asarray(tables),
+            context_lens=jnp.asarray(ctx_lens),
+            prompt_lens=jnp.asarray(plens),
+        )
+        prompt_offsets = [int(c) for c in ctx_lens[:batch]]
+        sampling = SamplingMetadata(
+            seq_groups=seq_groups,
+            seq_data=seq_data_map,
+            prompt_lens=prompt_lens,
+            selected_token_indices=jnp.asarray(selected, dtype=jnp.int32),
+            categorized_sample_indices={},
+            prompt_offsets=prompt_offsets,
+        )
+        # Pad sel to a bucket so the jitted step's shape is stable
+        # (pad rows repeat index 0; sliced off before sampling).
+        num_rows = len(selected)
+        padded_rows = -(-num_rows // _PAGES_BUCKET) * _PAGES_BUCKET
+        sel = np.zeros((padded_rows,), dtype=np.int32)
+        sel[:num_rows] = selected
+        inputs = dict(input_ids=jnp.asarray(ids), positions=jnp.asarray(pos),
+                      metadata=metadata, sel=jnp.asarray(sel),
+                      num_rows=num_rows,
+                      is_prompt=True, use_prefix=use_prefix)
+        return inputs, sampling
+
+    def _prepare_decode(
+        self, seq_group_metadata_list: List[SequenceGroupMetadata]
+    ) -> Tuple[dict, SamplingMetadata]:
+        seq_ids_flat: List[int] = []
+        seq_groups, seq_data_map, persistent = [], {}, {}
+        tokens, positions, slot_list, ctx_list, tables_list = \
+            [], [], [], [], []
+        sliding_window = self.model_config.get_sliding_window()
+
+        for md in seq_group_metadata_list:
+            group_ids = list(md.seq_data.keys())
+            seq_groups.append((group_ids, md.sampling_params))
+            for seq_id in group_ids:
+                data = md.seq_data[seq_id]
+                seq_data_map[seq_id] = data
+                persistent[seq_id] = md.persistent_data.get(seq_id, {})
+                seq_ids_flat.append(seq_id)
+                tokens.append(data.get_last_token_id())
+                pos = data.get_len() - 1
+                positions.append(pos)
+                table = md.block_tables[seq_id]
+                slot_pos = pos
+                ctx = pos + 1
+                if sliding_window is not None:
+                    # Block table wraps modulo window (reference
+                    # block_manager sliding-window reuse).
+                    ctx = min(ctx, sliding_window)
+                    slot_pos = pos % (len(table) * self.page_size) \
+                        if len(table) * self.page_size <= sliding_window \
+                        else pos
+                page = table[(slot_pos // self.page_size) % len(table)]
+                slot_list.append(page * self.page_size +
+                                 slot_pos % self.page_size)
+                ctx_list.append(ctx)
+                tables_list.append(table)
+
+        batch = len(tokens)
+        padded_batch = _bucket(batch, _DECODE_BATCH_BUCKETS)
+        max_pages = max(len(t) for t in tables_list)
+        max_pages = -(-max_pages // _PAGES_BUCKET) * _PAGES_BUCKET
+
+        ids = np.zeros((padded_batch, 1), dtype=np.int32)
+        pos_arr = np.zeros((padded_batch, 1), dtype=np.int32)
+        slots = np.full((padded_batch,), self.num_slots, dtype=np.int32)
+        ctx_lens = np.zeros((padded_batch,), dtype=np.int32)
+        num_pages_oob = self.num_slots // self.page_size
+        tables = np.full((padded_batch, max_pages), num_pages_oob,
+                         dtype=np.int32)
+
+        ids[:batch, 0] = tokens
+        pos_arr[:batch, 0] = positions
+        slots[:batch] = slot_list
+        ctx_lens[:batch] = ctx_list
+        for i, t in enumerate(tables_list):
+            tables[i, :len(t)] = t
+
+        metadata = InputMetadata(
+            slot_mapping=jnp.asarray(slots),
+            block_tables=jnp.asarray(tables),
+            context_lens=jnp.asarray(ctx_lens),
+        )
+        sampling = SamplingMetadata(
+            seq_groups=seq_groups,
+            seq_data=seq_data_map,
+            prompt_lens=[],
+            selected_token_indices=jnp.arange(batch, dtype=jnp.int32),
+            categorized_sample_indices={},
+            persistent_metadata=PersistentMetadata(persistent),
+        )
+        # sel covers the whole padded batch (stable shape per bucket);
+        # pad rows are sliced off before sampling.
+        inputs = dict(input_ids=jnp.asarray(ids),
+                      positions=jnp.asarray(pos_arr), metadata=metadata,
+                      sel=jnp.arange(padded_batch, dtype=jnp.int32),
+                      num_rows=batch,
+                      is_prompt=False, use_prefix=False)
+        return inputs, sampling
+
+    # ---- public API ----
+
+    def execute_model(
+        self,
+        seq_group_metadata_list: List[SequenceGroupMetadata],
+        kv_caches: List[Tuple[jax.Array, jax.Array]],
+        blocks_to_copy: Optional[Dict[int, List[int]]] = None,
+    ) -> Tuple[SamplerOutput, List[Tuple[jax.Array, jax.Array]]]:
+        if blocks_to_copy:
+            src, dst = [], []
+            for s, ds in blocks_to_copy.items():
+                for d in ds:
+                    src.append(s)
+                    dst.append(d)
+            kv_caches = self._copy_fn(kv_caches,
+                                      jnp.asarray(src, dtype=jnp.int32),
+                                      jnp.asarray(dst, dtype=jnp.int32))
+
+        if not seq_group_metadata_list:
+            return [], kv_caches
+
+        is_prompt = seq_group_metadata_list[0].is_prompt
+        if is_prompt:
+            inputs, sampling = self._prepare_prompt(seq_group_metadata_list)
+        else:
+            inputs, sampling = self._prepare_decode(seq_group_metadata_list)
+
+        logits, kv_caches = self._step_fn(
+            self.params, inputs["input_ids"], inputs["positions"],
+            kv_caches, inputs["metadata"], inputs["sel"],
+            is_prompt=inputs["is_prompt"],
+            use_prefix=inputs["use_prefix"])
+
+        output = self.sampler(logits[:inputs["num_rows"]], sampling)
+        return output, kv_caches

@@ -119,8 +119,10 @@ class PagedAttention:
         # Sliding window: context_lens are already clamped host-side to the
         # window and block tables wrap (reference model_runner.py:278-293),
         # so the kernels need no window logic in decode.
+        # Mosaic tiling: DMA slice last dim must be 128-aligned, so small
+        # heads (e.g. 64) take the XLA gather path for now.
         if self.use_pallas and jax.default_backend() == "tpu" and \
-                self.alibi_slopes is None:
+                self.alibi_slopes is None and self.head_size % 128 == 0:
             from aphrodite_tpu.ops.pallas.paged_attention import (
                 paged_decode_attention)
             out = paged_decode_attention(

@@ -177,8 +177,11 @@ def _apply_token_bans(logits, t: SamplingTensors) -> jax.Array:
 
 def _apply_quadratic(logits, t: SamplingTensors) -> jax.Array:
     max_logits = jnp.max(logits, axis=-1, keepdims=True)
-    return -(t.smoothing_factors[:, None] *
-             (logits - max_logits) ** 2) + max_logits
+    transformed = -(t.smoothing_factors[:, None] *
+                    (logits - max_logits) ** 2) + max_logits
+    # factor==0 must be a no-op: the formula would flatten the whole row
+    # to max_logits (every co-batched request corrupted).
+    return jnp.where(t.smoothing_factors[:, None] > 0, transformed, logits)
 
 
 def _apply_mirostat_v2(logits, t: SamplingTensors,
@@ -239,17 +242,44 @@ def _process_logits(logits: jax.Array, t: SamplingTensors,
     return logits, new_mus
 
 
-@functools.partial(jax.jit, static_argnames=("max_best_of",))
-def _sample_tokens(logits: jax.Array, keys: jax.Array, max_best_of: int
-                   ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Returns (greedy [rows], multinomial [rows, max_best_of],
-    logprobs [rows, vocab])."""
+@functools.partial(jax.jit,
+                   static_argnames=("max_best_of", "num_topk"))
+def _sample_tokens(logits: jax.Array, keys: jax.Array, max_best_of: int,
+                   num_topk: int):
+    """Device-side token selection + small result tensors.
+
+    Returns (greedy [rows], multinomial [rows, max_best_of], lp_greedy
+    [rows], lp_random [rows, max_best_of], topk_vals/topk_idx
+    [rows, num_topk], logprobs [rows, vocab]). Only the small tensors are
+    pulled to the host; the full logprobs stay on device and are sliced
+    per-row for the rare beam/prompt-logprobs paths (the reference
+    transfers top-k only as well, sampler.py:607-650).
+    """
     greedy = jnp.argmax(logits, axis=-1)
     draw = jax.vmap(
         lambda k, lg: jax.random.categorical(k, lg, shape=(max_best_of,)))
     random = draw(keys, logits)
     logprobs = jax.nn.log_softmax(logits, axis=-1)
-    return greedy, random, logprobs
+    rows = jnp.arange(logits.shape[0])
+    lp_greedy = logprobs[rows, greedy]
+    lp_random = jnp.take_along_axis(logprobs, random, axis=-1)
+    if num_topk > 0:
+        topk_vals, topk_idx = jax.lax.top_k(logprobs, num_topk)
+    else:
+        topk_vals = jnp.zeros((logits.shape[0], 0), logprobs.dtype)
+        topk_idx = jnp.zeros((logits.shape[0], 0), jnp.int32)
+    return greedy, random, lp_greedy, lp_random, topk_vals, topk_idx, \
+        logprobs
+
+
+@jax.jit
+def _make_row_keys(bases: jax.Array, salt1: jax.Array,
+                   salt2: jax.Array) -> jax.Array:
+    """Vectorized per-row PRNG keys: one dispatch for the whole batch."""
+    make = jax.vmap(
+        lambda b, s1, s2: jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(b), s1), s2))
+    return make(bases, salt1, salt2)
 
 
 # ------------------------------------------------------------- host side --
@@ -262,6 +292,12 @@ class Sampler:
     def __init__(self, vocab_size: int) -> None:
         self.vocab_size = vocab_size
         self._step = 0
+        # Process entropy so unseeded sampling differs across restarts
+        # (seeded requests are unaffected: their keys derive from the
+        # request seed only).
+        import os as _os
+        self._base_seed = int.from_bytes(_os.urandom(4), "little") \
+            & 0x7FFFFFFF
 
     def __call__(self, logits: jax.Array,
                  metadata: SamplingMetadata) -> SamplerOutput:
@@ -271,13 +307,14 @@ class Sampler:
                                                      self.vocab_size)
         rows = logits.shape[0]
         self._step += 1
-        keys = self._make_keys(metadata, rows, row_to_seq)
+        group_of = self._seq_to_group(metadata)
+        keys = self._make_keys(metadata, rows, row_to_seq, group_of)
 
         processed, new_mus = _process_logits(logits, tensors, keys)
         if tensors.do_mirostat:
             mus = np.asarray(new_mus)
             for row, seq_id in row_to_seq.items():
-                _, params = self._find_group(metadata, seq_id)
+                _, params = group_of.get(seq_id, (None, None))
                 if params is not None and params.mirostat_mode == 2:
                     metadata.output_metadata.add(seq_id, "miro_mu",
                                                  float(mus[row]))
@@ -286,45 +323,60 @@ class Sampler:
             p.best_of for (_, p) in metadata.seq_groups
             if p.sampling_type == SamplingType.RANDOM
         ])
-        greedy, random, logprobs = _sample_tokens(processed, keys,
-                                                  max_best_of)
-        return self._assemble(metadata, np.asarray(greedy),
-                              np.asarray(random), np.asarray(logprobs))
+        max_logprobs = max([0] + [
+            min(p.logprobs or 0, self.vocab_size - 1)
+            for (_, p) in metadata.seq_groups
+        ] + [
+            min(p.prompt_logprobs or 0, self.vocab_size - 1)
+            for (_, p) in metadata.seq_groups
+        ])
+        greedy, random, lp_greedy, lp_random, topk_vals, topk_idx, \
+            logprobs = _sample_tokens(processed, keys, max_best_of,
+                                      max_logprobs)
+        return self._assemble(
+            metadata, np.asarray(greedy), np.asarray(random),
+            np.asarray(lp_greedy), np.asarray(lp_random),
+            np.asarray(topk_vals), np.asarray(topk_idx), logprobs)
 
     # -- helpers --
 
+    @staticmethod
+    def _seq_to_group(metadata: SamplingMetadata) -> Dict[int, tuple]:
+        """seq_id -> (seq_ids, params), built once per step."""
+        return {
+            seq_id: (seq_ids, params)
+            for seq_ids, params in metadata.seq_groups
+            for seq_id in seq_ids
+        }
+
     def _make_keys(self, metadata: SamplingMetadata, rows: int,
-                   row_to_seq: Dict[int, int]) -> jax.Array:
-        """Per-row PRNG keys: seeded rows fold (seed, output_len) so they
-        are reproducible; unseeded rows fold a global step counter."""
-        keys = np.zeros((rows, 2), dtype=np.uint32)
+                   row_to_seq: Dict[int, int],
+                   group_of: Dict[int, tuple]) -> jax.Array:
+        """Per-row PRNG keys, computed in ONE vectorized dispatch.
+
+        Seeded rows: base=request seed, salts=(output_len, sibling index)
+        — reproducible regardless of batch composition or restarts.
+        Unseeded rows: base=process entropy ^ step, salt=row.
+        """
+        bases = np.empty((rows,), dtype=np.int64)
+        salt1 = np.empty((rows,), dtype=np.int32)
+        salt2 = np.empty((rows,), dtype=np.int32)
+        unseeded_base = (self._base_seed ^ self._step) & 0x7FFFFFFF
         for row in range(rows):
             seq_id = row_to_seq.get(row)
-            params = None
-            if seq_id is not None:
-                data, params = self._find_group(metadata, seq_id)
-            if params is not None and params.seed is not None:
-                # Fold (output_len, sibling index) so each step AND each
-                # sibling sequence of an n>1 group draws independently,
-                # reproducibly regardless of batch composition.
-                seq_ids, _ = next(
-                    (g for g in metadata.seq_groups if seq_id in g[0]))
-                out_len = len(metadata.seq_data[seq_id].output_token_ids)
-                base = jax.random.PRNGKey(params.seed)
-                key = jax.random.fold_in(base, out_len)
-                key = jax.random.fold_in(key, seq_ids.index(seq_id))
+            entry = group_of.get(seq_id) if seq_id is not None else None
+            if entry is not None and entry[1].seed is not None:
+                seq_ids, params = entry
+                bases[row] = params.seed
+                salt1[row] = len(
+                    metadata.seq_data[seq_id].output_token_ids)
+                salt2[row] = seq_ids.index(seq_id)
             else:
-                key = jax.random.fold_in(jax.random.PRNGKey(self._step),
-                                         row)
-            keys[row] = np.asarray(key, dtype=np.uint32)
-        return jnp.asarray(keys)
-
-    @staticmethod
-    def _find_group(metadata: SamplingMetadata, seq_id: int):
-        for seq_ids, params in metadata.seq_groups:
-            if seq_id in seq_ids:
-                return metadata.seq_data.get(seq_id), params
-        return None, None
+                bases[row] = unseeded_base
+                salt1[row] = row
+                salt2[row] = 0
+        return _make_row_keys(jnp.asarray(bases), jnp.asarray(salt1),
+                              jnp.asarray(salt2))
 
     def _apply_logits_processors(self, logits, metadata):
         """Host-side per-request callables (logit_bias, grammar, min-tokens
@@ -356,8 +408,13 @@ class Sampler:
         return jnp.asarray(arr)
 
     def _assemble(self, metadata: SamplingMetadata, greedy: np.ndarray,
-                  random: np.ndarray,
-                  logprobs: np.ndarray) -> SamplerOutput:
+                  random: np.ndarray, lp_greedy: np.ndarray,
+                  lp_random: np.ndarray, topk_vals: np.ndarray,
+                  topk_idx: np.ndarray,
+                  logprobs_dev: jax.Array) -> SamplerOutput:
+        """Per-group output assembly. Fast paths (greedy/random) touch
+        only the small host tensors; beam and prompt-logprobs groups
+        transfer just their own logprob rows from device."""
         outputs: List[SequenceGroupOutput] = []
         row = 0
         for group_idx, (seq_ids, params) in enumerate(metadata.seq_groups):
@@ -367,87 +424,102 @@ class Sampler:
             group_prompt_logprobs = None
             if is_prompt and params.prompt_logprobs is not None:
                 n = metadata.prompt_lens[group_idx] - 1
-                group_prompt_logprobs = [None]
+                ctx = metadata.prompt_offsets[group_idx] \
+                    if metadata.prompt_offsets else 0
+                group_prompt_logprobs = [None] if ctx == 0 else []
                 prompt_token_ids = \
                     metadata.seq_data[seq_ids[0]].prompt_token_ids
+                rows_np = np.asarray(logprobs_dev[row:row + n])
                 for j in range(n):
-                    tok = prompt_token_ids[j + 1]
+                    tok = prompt_token_ids[ctx + j + 1]
                     group_prompt_logprobs.append(
-                        self._top_logprobs(logprobs[row + j],
-                                           params.prompt_logprobs, tok))
+                        self._full_top_logprobs(rows_np[j],
+                                                params.prompt_logprobs,
+                                                tok))
                 row += n
 
-            sample_rows = slice(row, row + len(seq_ids))
             samples: List[SequenceOutput] = []
             if params.sampling_type == SamplingType.GREEDY:
                 token = int(greedy[row])
-                samples.append(self._make_output(
-                    seq_ids[0], seq_ids[0], token, logprobs[row], params,
-                    metadata))
+                lp = self._topk_logprobs(topk_vals, topk_idx, row, params,
+                                         token, float(lp_greedy[row]))
+                samples.append(SequenceOutput(
+                    seq_ids[0], token, lp,
+                    metadata.output_metadata.get(seq_ids[0])))
             elif params.sampling_type == SamplingType.BEAM:
                 samples = self._beam_sample(metadata, seq_ids, params,
-                                            logprobs, row, is_prompt)
+                                            logprobs_dev, row, is_prompt)
             else:
                 if is_prompt:
                     for i in range(params.best_of):
                         token = int(random[row, i])
-                        samples.append(self._make_output(
-                            seq_ids[0], seq_ids[0], token, logprobs[row],
-                            params, metadata))
+                        lp = self._topk_logprobs(
+                            topk_vals, topk_idx, row, params, token,
+                            float(lp_random[row, i]))
+                        samples.append(SequenceOutput(
+                            seq_ids[0], token, lp,
+                            metadata.output_metadata.get(seq_ids[0])))
                 else:
                     for offset, seq_id in enumerate(seq_ids):
                         token = int(random[row + offset, 0])
-                        samples.append(self._make_output(
-                            seq_id, seq_id, token, logprobs[row + offset],
-                            params, metadata))
-            row = sample_rows.stop
+                        lp = self._topk_logprobs(
+                            topk_vals, topk_idx, row + offset, params,
+                            token, float(lp_random[row + offset, 0]))
+                        samples.append(SequenceOutput(
+                            seq_id, token, lp,
+                            metadata.output_metadata.get(seq_id)))
+            row += len(seq_ids)
             outputs.append(SequenceGroupOutput(samples,
                                                group_prompt_logprobs))
         return outputs
 
-    def _beam_sample(self, metadata, seq_ids, params, logprobs, row,
+    def _beam_sample(self, metadata, seq_ids, params, logprobs_dev, row,
                      is_prompt) -> List[SequenceOutput]:
         """Beam search select (reference `_beam_search_sample`,
-        `sampler.py:462-527`): 2*best_of candidates."""
+        `sampler.py:462-527`): 2*best_of candidates. Transfers only this
+        group's logprob rows."""
         beam_width = params.best_of
+        out_meta = metadata.output_metadata
+
+        def mk(seq_id, token, row_np):
+            lp = self._full_top_logprobs(row_np, params.logprobs, token)
+            return SequenceOutput(seq_id, token, lp, out_meta.get(seq_id))
+
         if is_prompt:
-            lp = logprobs[row]
+            lp = np.asarray(logprobs_dev[row])
             top_idx = np.argpartition(-lp, 2 * beam_width)[:2 * beam_width]
             top_idx = top_idx[np.argsort(-lp[top_idx])]
-            return [
-                self._make_output(seq_ids[0], seq_ids[0], int(tok),
-                                  logprobs[row], params, metadata)
-                for tok in top_idx
-            ]
+            return [mk(seq_ids[0], int(tok), lp) for tok in top_idx]
+
+        seq_lp = np.asarray(logprobs_dev[row:row + len(seq_ids)])
         cum = np.asarray([
             metadata.seq_data[sid].cumulative_logprob for sid in seq_ids
         ])
-        seq_lp = logprobs[row:row + len(seq_ids)]
-        joint = seq_lp + cum[:, None]
-        flat = joint.reshape(-1)
+        flat = (seq_lp + cum[:, None]).reshape(-1)
         top_idx = np.argpartition(-flat, 2 * beam_width)[:2 * beam_width]
         top_idx = top_idx[np.argsort(-flat[top_idx])]
         vocab = seq_lp.shape[-1]
-        out = []
-        for flat_idx in top_idx:
-            parent = int(flat_idx) // vocab
-            token = int(flat_idx) % vocab
-            out.append(self._make_output(
-                seq_ids[parent], seq_ids[parent], token,
-                logprobs[row + parent], params, metadata))
-        return out
-
-    def _make_output(self, seq_id, parent_id, token, row_logprobs, params,
-                     metadata) -> SequenceOutput:
-        lp = self._top_logprobs(row_logprobs, params.logprobs, token)
-        return SequenceOutput(parent_id, token, lp,
-                              metadata.output_metadata.get(seq_id))
+        return [
+            mk(seq_ids[int(i) // vocab], int(i) % vocab,
+               seq_lp[int(i) // vocab]) for i in top_idx
+        ]
 
     @staticmethod
-    def _top_logprobs(row: np.ndarray, num_logprobs: Optional[int],
-                      sampled_token: int) -> Dict[int, float]:
-        """Top-n logprobs dict, always including the sampled token
-        (reference `_get_logprobs`, `sampler.py:607-650`)."""
+    def _topk_logprobs(topk_vals: np.ndarray, topk_idx: np.ndarray,
+                       row: int, params, sampled_token: int,
+                       sampled_lp: float) -> Dict[int, float]:
+        """Top-n logprobs dict from the device-side top-k, always
+        including the sampled token (reference `_get_logprobs`)."""
+        result = {sampled_token: sampled_lp}
+        n = params.logprobs or 0
+        for k in range(min(n, topk_idx.shape[-1])):
+            result[int(topk_idx[row, k])] = float(topk_vals[row, k])
+        return result
+
+    @staticmethod
+    def _full_top_logprobs(row: np.ndarray, num_logprobs: Optional[int],
+                           sampled_token: int) -> Dict[int, float]:
+        """Top-n over a full host row (beam / prompt-logprobs paths)."""
         result = {sampled_token: float(row[sampled_token])}
         if num_logprobs:
             num_logprobs = min(num_logprobs, row.shape[-1] - 1)

@@ -70,6 +70,9 @@ class SamplingMetadata:
     persistent_metadata: PersistentMetadata = field(
         default_factory=PersistentMetadata)
     output_metadata: OutputMetadata = field(default_factory=OutputMetadata)
+    # Per prompt group: tokens already in cache before this chunk (prefix
+    # caching / chunked prefill). Aligns prompt-logprobs attribution.
+    prompt_offsets: List[int] = field(default_factory=list)
 
 
 @struct.dataclass
@@ -216,10 +219,13 @@ def build_sampling_tensors(
             eps.append(p.epsilon_cutoff)
             typical.append(p.typical_p)
             smoothing.append(p.smoothing_factor)
-            miro_taus.append(p.mirostat_tau)
-            miro_etas.append(p.mirostat_eta)
+            # tau/eta/mu are zeroed unless mode==2 so the device row gate
+            # (tau > 0) agrees with the host mu write-back gate.
+            is_miro = p.mirostat_mode == 2
+            miro_taus.append(p.mirostat_tau if is_miro else 0.0)
+            miro_etas.append(p.mirostat_eta if is_miro else 0.0)
             mu = metadata.persistent_metadata.get(seq_id).get(
-                "miro_mu", 2.0 * p.mirostat_tau)
+                "miro_mu", 2.0 * p.mirostat_tau) if is_miro else 0.0
             miro_mus.append(mu)
             pres_pen.append(p.presence_penalty)
             freq_pen.append(p.frequency_penalty)

@@ -172,6 +172,9 @@ def detokenize_incrementally(
         # First call: decode everything so far.
         new_tokens = tokenizer.convert_ids_to_tokens(
             all_input_ids, skip_special_tokens=skip_special_tokens)
+        # Out-of-vocab ids decode to None (GGUF conversions, padded
+        # vocab): treat as empty.
+        new_tokens = [t if t is not None else "" for t in new_tokens]
         output_tokens = new_tokens
         prefix_offset = max(
             len(output_tokens) - _INITIAL_INCREMENTAL_DETOKENIZATION_OFFSET,

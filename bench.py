@@ -1,0 +1,106 @@
+"""Throughput benchmark: offline continuous-batching generation.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Baseline: the reference's peak batched output throughput for Mistral-7B
+fp16 on RTX 4090 is 5489.3 out-tok/s (reference README.md:59; BASELINE.md).
+This harness measures aggregate output tokens/s through the full engine
+(scheduler + paged cache + jitted model + sampler) on whatever device jax
+exposes. Until a 7B checkpoint runs on real TPU hardware the number is a
+same-methodology proxy (dummy-weight model sized by BENCH_MODEL env:
+tiny|7b), so vs_baseline is only meaningful for the 7b config.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+BASELINE_TOKS = 5489.3     # reference README.md:59 (Mistral-7B fp16)
+
+
+def main() -> None:
+    size = os.environ.get("BENCH_MODEL", "tiny")
+    import jax
+
+    if size == "7b":
+        hidden, layers, heads, kv_heads, inter = 4096, 32, 32, 8, 14336
+        vocab = 32000
+        batch, steps, prompt_len = 64, 64, 128
+    else:
+        hidden, layers, heads, kv_heads, inter = 512, 4, 8, 4, 1024
+        vocab = 2048
+        batch, steps, prompt_len = 32, 32, 64
+
+    import json as _json
+    import tempfile
+    tmp = tempfile.mkdtemp(prefix="bench-model-")
+    with open(os.path.join(tmp, "config.json"), "w") as f:
+        _json.dump({
+            "architectures": ["LlamaForCausalLM"],
+            "model_type": "llama",
+            "vocab_size": vocab,
+            "hidden_size": hidden,
+            "intermediate_size": inter,
+            "num_hidden_layers": layers,
+            "num_attention_heads": heads,
+            "num_key_value_heads": kv_heads,
+            "max_position_embeddings": 4096,
+            "rms_norm_eps": 1e-5,
+            "rope_theta": 10000.0,
+            "tie_word_embeddings": False,
+            "torch_dtype": "bfloat16",
+            "bos_token_id": 1,
+            "eos_token_id": 2,
+        }, f)
+
+    from aphrodite_tpu.common.sampling_params import SamplingParams
+    from aphrodite_tpu.engine.aphrodite_engine import AphroditeEngine
+    from aphrodite_tpu.engine.args_tools import EngineArgs
+
+    engine = AphroditeEngine.from_engine_args(EngineArgs(
+        model=tmp, tokenizer=tmp, load_format="dummy", dtype="bfloat16",
+        max_model_len=2048, max_num_seqs=batch, disable_log_stats=True,
+        skip_tokenizer_init=True))
+
+    sp = SamplingParams(temperature=0.0, max_tokens=steps,
+                        ignore_eos=True)
+    rng_tokens = [[(7 * i + j) % (vocab - 10) + 5
+                   for j in range(prompt_len)] for i in range(batch)]
+
+    # Warmup: compile prefill+decode buckets.
+    _run(engine, sp, rng_tokens[:2], steps)
+    t0 = time.perf_counter()
+    total_out = _run(engine, sp, rng_tokens, steps)
+    dt = time.perf_counter() - t0
+
+    toks = total_out / dt
+    print(json.dumps({
+        "metric": f"offline_throughput_{size}",
+        "value": round(toks, 1),
+        "unit": "out_tok/s",
+        "vs_baseline": round(toks / BASELINE_TOKS, 4),
+    }))
+
+
+def _run(engine, sp, prompts_tokens, steps) -> int:
+    from aphrodite_tpu.common.sequence import Sequence, SequenceGroup
+    import time as _t
+    for i, toks in enumerate(prompts_tokens):
+        seq = Sequence(next(engine.seq_counter), None, list(toks),
+                       engine.cache_config.block_size)
+        group = SequenceGroup(f"bench-{i}-{_t.monotonic_ns()}", [seq], sp,
+                              _t.monotonic())
+        engine.scheduler.add_seq_group(group)
+    total = 0
+    while engine.has_unfinished_requests():
+        outs = engine.step()
+        for o in outs:
+            if o.finished:
+                total += sum(len(c.token_ids) for c in o.outputs)
+    return total
+
+
+if __name__ == "__main__":
+    main()

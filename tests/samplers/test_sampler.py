@@ -200,3 +200,35 @@ def test_logits_processor_bias():
     meta = make_metadata([([0], params)], {0: SequenceData([1])})
     out = sampler(peaked_logits(1, peak=3), meta)
     assert out[0].samples[0].output_token == 12
+
+
+def test_quadratic_does_not_corrupt_cobatched_greedy():
+    """smoothing_factor=0 rows must be untouched when batched with a
+    quadratic-sampling request (regression: where-guard in the stage)."""
+    sampler = Sampler(VOCAB)
+    logits = np.zeros((2, VOCAB), dtype=np.float32)
+    logits[0, 7] = 5.0     # greedy row
+    logits[1, 9] = 5.0     # quadratic row
+    groups = [([0], SamplingParams(temperature=0.0)),
+              ([1], SamplingParams(temperature=0.0, smoothing_factor=0.5))]
+    seq_data = {0: SequenceData([1]), 1: SequenceData([1])}
+    out = sampler(jnp.asarray(logits), make_metadata(groups, seq_data))
+    assert out[0].samples[0].output_token == 7
+    assert out[1].samples[0].output_token == 9
+
+
+def test_mirostat_mode0_with_tau_set_is_ignored():
+    """mirostat_tau set but mode=0 must NOT trigger mirostat masking
+    (regression: device gate now derives from mode==2)."""
+    sampler = Sampler(VOCAB)
+    logits = np.zeros((2, VOCAB), dtype=np.float32)
+    logits[0, 5] = 6.0
+    groups = [([0], SamplingParams(temperature=0.0, mirostat_mode=0,
+                                   mirostat_tau=1.0)),
+              ([1], SamplingParams(temperature=1.0, mirostat_mode=2,
+                                   mirostat_tau=2.0, mirostat_eta=0.1))]
+    seq_data = {0: SequenceData([1]), 1: SequenceData([1])}
+    out = sampler(jnp.asarray(logits), make_metadata(groups, seq_data))
+    assert out[0].samples[0].output_token == 5
+    assert "miro_mu" not in out[0].samples[0].persistent_data
+    assert "miro_mu" in out[1].samples[0].persistent_data
