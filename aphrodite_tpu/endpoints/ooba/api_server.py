@@ -1,0 +1,132 @@
+"""text-generation-webui (Ooba)-compatible server on aiohttp.
+
+Reference: `aphrodite/endpoints/ooba/api_server.py:45-159` —
+/api/v1/generate with field aliases (stopping_strings -> stop,
+max_new_tokens -> max_tokens, ban_eos_token -> ignore_eos, min_length ->
+BanEOSUntil), newline-delimited JSON streaming, /api/v1/model, /health.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+from dataclasses import fields as dataclass_fields
+
+from aiohttp import web
+
+from aphrodite_tpu.common.logger import init_logger
+from aphrodite_tpu.common.logits_processor import BanEOSUntil
+from aphrodite_tpu.common.sampling_params import SamplingParams
+from aphrodite_tpu.common.utils import random_uuid
+from aphrodite_tpu.endpoints.utils import request_disconnected
+from aphrodite_tpu.engine.args_tools import AsyncEngineArgs
+from aphrodite_tpu.engine.async_aphrodite import AsyncAphrodite
+
+logger = init_logger(__name__)
+
+_PARAM_NAMES = {f.name for f in dataclass_fields(SamplingParams)}
+
+
+class OobaServer:
+
+    def __init__(self, engine: AsyncAphrodite, served_model: str) -> None:
+        self.engine = engine
+        self.served_model = served_model
+        self.tokenizer = engine.engine.tokenizer.tokenizer
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post("/api/v1/generate", self.generate)
+        app.router.add_get("/api/v1/model", self.get_model)
+        app.router.add_get("/health", self.health)
+        return app
+
+    async def generate(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        try:
+            prompt = body.pop("prompt")
+        except KeyError:
+            return web.json_response({"detail": "prompt is required"},
+                                     status=422)
+        stream = body.pop("stream", False)
+
+        # Ooba field aliases (reference :59-68).
+        if "stopping_strings" in body:
+            body["stop"] = body.pop("stopping_strings")
+        if "max_new_tokens" in body:
+            body["max_tokens"] = body.pop("max_new_tokens")
+        if "min_length" in body:
+            body["min_tokens"] = body.pop("min_length")
+        if "ban_eos_token" in body:
+            body["ignore_eos"] = body.pop("ban_eos_token")
+        if body.get("top_k") == 0:
+            body["top_k"] = -1
+
+        min_length = body.pop("min_tokens", 0)
+        if body.get("ignore_eos", False):
+            min_length = body.get("max_tokens", 16)
+        processors = []
+        eos_id = self.tokenizer.eos_token_id
+        if min_length and eos_id is not None:
+            processors.append(BanEOSUntil(min_length, eos_id))
+
+        kwargs = {k: v for k, v in body.items() if k in _PARAM_NAMES}
+        if processors:
+            kwargs["logits_processors"] = processors
+        try:
+            sampling_params = SamplingParams(**kwargs)
+        except Exception as err:
+            return web.json_response({"detail": str(err)}, status=422)
+
+        request_id = random_uuid()
+        gen = self.engine.generate(prompt, sampling_params, request_id)
+
+        if stream:
+            response = web.StreamResponse()
+            await response.prepare(request)
+            async for request_output in gen:
+                ret = {"results": [{"text": out.text}
+                                   for out in request_output.outputs]}
+                await response.write(
+                    (json.dumps(ret) + "\n\n").encode())
+            await response.write_eof()
+            return response
+
+        final = None
+        async for request_output in gen:
+            if await request_disconnected(request):
+                await self.engine.abort(request_id)
+                return web.Response(status=499)
+            final = request_output
+        assert final is not None
+        return web.json_response(
+            {"results": [{"text": out.text} for out in final.outputs]})
+
+    async def get_model(self, request) -> web.Response:
+        return web.json_response(
+            {"result": f"aphrodite-tpu/{self.served_model}"})
+
+    async def health(self, request) -> web.Response:
+        await self.engine.check_health()
+        return web.Response(status=200)
+
+
+def build_app(engine: AsyncAphrodite, served_model: str) -> web.Application:
+    return OobaServer(engine, served_model).build_app()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(
+        description="Aphrodite-TPU Ooba-compatible API server")
+    parser.add_argument("--host", type=str, default=None)
+    parser.add_argument("--port", type=int, default=5000)
+    parser.add_argument("--served-model-name", type=str, default=None)
+    parser = AsyncEngineArgs.add_cli_args(parser)
+    args = parser.parse_args()
+    engine = AsyncAphrodite.from_engine_args(
+        AsyncEngineArgs.from_cli_args(args))
+    app = build_app(engine, args.served_model_name or args.model)
+    web.run_app(app, host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

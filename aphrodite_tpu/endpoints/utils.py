@@ -1,0 +1,9 @@
+"""Shared helpers for the aiohttp frontends."""
+from __future__ import annotations
+
+from aiohttp import web
+
+
+async def request_disconnected(request: web.Request) -> bool:
+    """True when the client hung up (abort-on-disconnect checks)."""
+    return request.transport is None or request.transport.is_closing()

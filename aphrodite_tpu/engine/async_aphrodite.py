@@ -1,0 +1,310 @@
+"""Async serving engine: per-request streams over the background step loop.
+
+Reference: `aphrodite/engine/async_aphrodite.py` (AsyncStream `:41`,
+RequestTracker `:73`, _AsyncAphrodite.step_async `:175`, AsyncAphrodite
+`:280`, run_engine_loop `:404`, generate `:469`, abort `:569`).
+
+TPU-native notes: the device step is dispatched from a thread-pool
+executor so the asyncio loop stays responsive while XLA runs (the
+reference's Ray/await machinery collapses to one `run_in_executor`); the
+engine-as-Ray-actor mode has no equivalent because there are no worker
+processes.
+"""
+from __future__ import annotations
+
+import asyncio
+import functools
+import time
+from typing import (AsyncIterator, Callable, Dict, Iterable, List,
+                    Optional, Set, Tuple, Type, Union)
+
+from aphrodite_tpu.common.config import ModelConfig
+from aphrodite_tpu.common.logger import init_logger
+from aphrodite_tpu.common.outputs import RequestOutput
+from aphrodite_tpu.common.sampling_params import SamplingParams
+from aphrodite_tpu.engine.aphrodite_engine import AphroditeEngine
+from aphrodite_tpu.engine.args_tools import AsyncEngineArgs
+
+logger = init_logger(__name__)
+
+
+class AsyncEngineDeadError(RuntimeError):
+    pass
+
+
+def _raise_exception_on_finish(task: asyncio.Task,
+                               request_tracker: "RequestTracker") -> None:
+    msg = ("Task finished unexpectedly. This should never happen! "
+           "Please open an issue on Github.")
+    try:
+        try:
+            task.result()
+        except asyncio.CancelledError:
+            return
+        except Exception as exc:
+            raise AsyncEngineDeadError(
+                msg + " See stack trace above for the actual cause.") \
+                from exc
+        raise AsyncEngineDeadError(msg)
+    except Exception as exc:
+        request_tracker.propagate_exception(exc)
+        raise exc
+
+
+class AsyncStream:
+    """Per-request stream of RequestOutputs (reference `:41`)."""
+
+    def __init__(self, request_id: str) -> None:
+        self.request_id = request_id
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._finished = False
+
+    def put(self, item: Union[RequestOutput, Exception]) -> None:
+        if self._finished:
+            return
+        self._queue.put_nowait(item)
+
+    def finish(self) -> None:
+        self._queue.put_nowait(StopAsyncIteration())
+        self._finished = True
+
+    @property
+    def finished(self) -> bool:
+        return self._finished
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> RequestOutput:
+        result = await self._queue.get()
+        if isinstance(result, Exception):
+            raise result
+        return result
+
+
+class RequestTracker:
+    """Synchronizes request arrival/abort between frontend coroutines and
+    the engine loop (reference `:73`)."""
+
+    def __init__(self) -> None:
+        self._request_streams: Dict[str, AsyncStream] = {}
+        self._finished_requests: asyncio.Queue = asyncio.Queue()
+        self._new_requests: asyncio.Queue = asyncio.Queue()
+        self.new_requests_event: Optional[asyncio.Event] = None
+
+    def __contains__(self, item) -> bool:
+        return item in self._request_streams
+
+    def init_event(self) -> None:
+        self.new_requests_event = asyncio.Event()
+
+    def propagate_exception(self, exc: Exception,
+                            request_id: Optional[str] = None) -> None:
+        if request_id is not None:
+            self._request_streams[request_id].put(exc)
+        else:
+            for stream in self._request_streams.values():
+                stream.put(exc)
+
+    def process_request_output(self, request_output: RequestOutput,
+                               *, verbose: bool = False) -> None:
+        request_id = request_output.request_id
+        if request_id not in self._request_streams:
+            return          # already aborted
+        self._request_streams[request_id].put(request_output)
+        if request_output.finished:
+            if verbose:
+                logger.info("Finished request %s.", request_id)
+            self.abort_request(request_id)
+
+    def add_request(self, request_id: str,
+                    **engine_add_request_kwargs) -> AsyncStream:
+        if request_id in self._request_streams:
+            raise KeyError(f"Request {request_id} already exists.")
+        stream = AsyncStream(request_id)
+        self._new_requests.put_nowait(
+            (stream, {"request_id": request_id,
+                      **engine_add_request_kwargs}))
+        if self.new_requests_event is not None:
+            self.new_requests_event.set()
+        return stream
+
+    def abort_request(self, request_id: str, *,
+                      verbose: bool = False) -> None:
+        if verbose:
+            logger.info("Aborted request %s.", request_id)
+        self._finished_requests.put_nowait(request_id)
+        if request_id not in self._request_streams or \
+                self._request_streams[request_id].finished:
+            return
+        self._request_streams[request_id].finish()
+
+    def get_new_and_finished_requests(
+            self) -> Tuple[List[dict], Set[str]]:
+        new_requests: List[dict] = []
+        finished_requests: Set[str] = set()
+        while not self._finished_requests.empty():
+            request_id = self._finished_requests.get_nowait()
+            finished_requests.add(request_id)
+            self._request_streams.pop(request_id, None)
+        while not self._new_requests.empty():
+            stream, request = self._new_requests.get_nowait()
+            if stream.request_id in finished_requests:
+                stream.finish()       # aborted before scheduling
+                continue
+            self._request_streams[stream.request_id] = stream
+            new_requests.append(request)
+        if self.new_requests_event is not None:
+            self.new_requests_event.clear()
+        return new_requests, finished_requests
+
+    async def wait_for_new_requests(self) -> None:
+        await self.new_requests_event.wait()
+
+
+class AsyncAphrodite:
+    """Async wrapper: background loop drives the sync engine
+    (reference `:280`)."""
+
+    def __init__(self, *args, log_requests: bool = True,
+                 start_engine_loop: bool = True,
+                 max_log_len: Optional[int] = None, **kwargs) -> None:
+        self.engine = AphroditeEngine(*args, **kwargs)
+        self.log_requests = log_requests
+        self.max_log_len = max_log_len
+        self.start_engine_loop = start_engine_loop
+        self._request_tracker = RequestTracker()
+        self.background_loop: Optional[asyncio.Future] = None
+        self._background_loop_unshielded = None
+
+    @classmethod
+    def from_engine_args(cls, engine_args: AsyncEngineArgs,
+                         start_engine_loop: bool = True
+                         ) -> "AsyncAphrodite":
+        configs = engine_args.create_engine_configs()
+        return cls(*configs,
+                   log_stats=not engine_args.disable_log_stats,
+                   skip_tokenizer_init=engine_args.skip_tokenizer_init,
+                   log_requests=not engine_args.disable_log_requests,
+                   max_log_len=engine_args.max_log_len,
+                   start_engine_loop=start_engine_loop)
+
+    @property
+    def is_running(self) -> bool:
+        return (self.background_loop is not None
+                and not self.background_loop.done())
+
+    def start_background_loop(self) -> None:
+        if self.is_running:
+            raise RuntimeError("Background loop is already running.")
+        self._request_tracker.init_event()
+        loop = asyncio.get_event_loop()
+        self._background_loop_unshielded = loop.create_task(
+            self.run_engine_loop())
+        self._background_loop_unshielded.add_done_callback(
+            functools.partial(_raise_exception_on_finish,
+                              request_tracker=self._request_tracker))
+        self.background_loop = asyncio.shield(
+            self._background_loop_unshielded)
+
+    async def engine_step(self) -> bool:
+        """Kick the engine; returns True if there is in-flight work."""
+        new_requests, finished_requests = \
+            self._request_tracker.get_new_and_finished_requests()
+
+        for new_request in new_requests:
+            try:
+                self.engine.add_request(**new_request)
+            except ValueError as e:
+                request_id = new_request["request_id"]
+                self._request_tracker.propagate_exception(e, request_id)
+                self._request_tracker.abort_request(request_id)
+
+        if finished_requests:
+            self.engine.abort_request(finished_requests)
+
+        # Run the (blocking, device-dispatching) step off-loop.
+        loop = asyncio.get_event_loop()
+        request_outputs = await loop.run_in_executor(None,
+                                                     self.engine.step)
+        for request_output in request_outputs:
+            self._request_tracker.process_request_output(
+                request_output, verbose=self.log_requests)
+        return len(request_outputs) > 0
+
+    async def run_engine_loop(self) -> None:
+        has_requests_in_progress = False
+        while True:
+            if not has_requests_in_progress:
+                await self._request_tracker.wait_for_new_requests()
+            has_requests_in_progress = await self.engine_step()
+            await asyncio.sleep(0)
+
+    async def add_request(
+        self,
+        request_id: str,
+        prompt: Optional[str],
+        sampling_params: SamplingParams,
+        prompt_token_ids: Optional[List[int]] = None,
+        arrival_time: Optional[float] = None,
+        prefix_pos: Optional[int] = None,
+    ) -> AsyncStream:
+        if self.log_requests:
+            max_len = self.max_log_len if self.max_log_len is not None \
+                else 80
+            shortened = prompt
+            if prompt and len(prompt) > max_len:
+                shortened = prompt[:max_len] + ("…" if max_len else "")
+            logger.info("Received request %s: prompt=%r params=%s",
+                        request_id, shortened, sampling_params)
+        if not self.is_running:
+            if self.start_engine_loop:
+                self.start_background_loop()
+            else:
+                raise AsyncEngineDeadError(
+                    "Background loop is not running. If it was running, "
+                    "inspect the output to find the stacktrace of the "
+                    "error that caused the background loop to stop "
+                    "(AsyncEngineDeadError).")
+        return self._request_tracker.add_request(
+            request_id,
+            prompt=prompt,
+            sampling_params=sampling_params,
+            prompt_token_ids=prompt_token_ids,
+            arrival_time=arrival_time or time.monotonic(),
+            prefix_pos=prefix_pos)
+
+    async def generate(
+        self,
+        prompt: Optional[str],
+        sampling_params: SamplingParams,
+        request_id: str,
+        prompt_token_ids: Optional[List[int]] = None,
+        prefix_pos: Optional[int] = None,
+    ) -> AsyncIterator[RequestOutput]:
+        """Stream RequestOutputs for one request (reference `:469`)."""
+        try:
+            stream = await self.add_request(
+                request_id, prompt, sampling_params,
+                prompt_token_ids=prompt_token_ids, prefix_pos=prefix_pos)
+            async for request_output in stream:
+                yield request_output
+        except (Exception, asyncio.CancelledError) as e:
+            self._abort(request_id)
+            raise e
+
+    async def abort(self, request_id: str) -> None:
+        if not self.is_running:
+            raise AsyncEngineDeadError("Background loop is not running.")
+        self._abort(request_id)
+
+    def _abort(self, request_id: str) -> None:
+        self._request_tracker.abort_request(
+            request_id, verbose=self.log_requests)
+
+    async def get_model_config(self) -> ModelConfig:
+        return self.engine.get_model_config()
+
+    async def check_health(self) -> None:
+        if not self.is_running:
+            raise AsyncEngineDeadError("Background loop is stopped.")

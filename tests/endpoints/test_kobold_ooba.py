@@ -1,0 +1,145 @@
+"""KoboldAI + Ooba frontend tests (reference: `endpoints/kobold`,
+`endpoints/ooba` route behavior)."""
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from aphrodite_tpu.engine.args_tools import AsyncEngineArgs
+from aphrodite_tpu.engine.async_aphrodite import AsyncAphrodite
+
+
+@pytest.fixture(scope="module")
+def servers(tiny_model_dir):
+    loop = asyncio.new_event_loop()
+
+    async def setup():
+        engine = AsyncAphrodite.from_engine_args(AsyncEngineArgs(
+            model=tiny_model_dir, load_format="dummy", dtype="float32",
+            max_model_len=256, max_num_seqs=8, swap_space=0.01,
+            disable_log_stats=True, disable_log_requests=True))
+        from aphrodite_tpu.endpoints.kobold.api_server import (
+            build_app as build_kobold)
+        from aphrodite_tpu.endpoints.ooba.api_server import (
+            build_app as build_ooba)
+        kobold = TestClient(TestServer(build_kobold(engine, "tiny")))
+        ooba = TestClient(TestServer(build_ooba(engine, "tiny")))
+        await kobold.start_server()
+        await ooba.start_server()
+        return kobold, ooba
+
+    kobold, ooba = loop.run_until_complete(setup())
+    yield loop, kobold, ooba
+    loop.run_until_complete(kobold.close())
+    loop.run_until_complete(ooba.close())
+    loop.close()
+
+
+def test_kobold_generate(servers):
+    loop, kobold, _ = servers
+
+    async def go():
+        r = await kobold.post("/api/v1/generate", json={
+            "prompt": "the quick brown", "max_length": 6,
+            "max_context_length": 128, "temperature": 0.0})
+        body = await r.json()
+        assert r.status == 200, body
+        assert len(body["results"]) == 1
+        assert isinstance(body["results"][0]["text"], str)
+    loop.run_until_complete(go())
+
+
+def test_kobold_generate_rejects_bad_context(servers):
+    loop, kobold, _ = servers
+
+    async def go():
+        r = await kobold.post("/api/v1/generate", json={
+            "prompt": "x", "max_length": 300, "max_context_length": 128})
+        assert r.status == 422
+    loop.run_until_complete(go())
+
+
+def test_kobold_stream(servers):
+    loop, kobold, _ = servers
+
+    async def go():
+        r = await kobold.post("/api/extra/generate/stream", json={
+            "prompt": "hello", "max_length": 4,
+            "max_context_length": 128, "temperature": 0.0})
+        assert r.status == 200
+        events = []
+        async for line in r.content:
+            line = line.decode().strip()
+            if line.startswith("data: "):
+                events.append(json.loads(line[6:]))
+        assert events
+        assert all("token" in e for e in events)
+    loop.run_until_complete(go())
+
+
+def test_kobold_info_routes(servers):
+    loop, kobold, _ = servers
+
+    async def go():
+        r = await kobold.get("/api/v1/info/version")
+        assert (await r.json())["result"]
+        r = await kobold.get("/api/v1/model")
+        assert "tiny" in (await r.json())["result"]
+        r = await kobold.get("/api/v1/config/max_context_length")
+        assert (await r.json())["value"] == 256
+        r = await kobold.get("/api/v1/config/soft_prompts_list")
+        assert (await r.json())["values"] == []
+        r = await kobold.post("/api/extra/tokencount",
+                              json={"prompt": "hello world"})
+        assert (await r.json())["value"] > 0
+    loop.run_until_complete(go())
+
+
+def test_kobold_abort_noop(servers):
+    loop, kobold, _ = servers
+
+    async def go():
+        r = await kobold.post("/api/extra/abort",
+                              json={"genkey": "nonexistent"})
+        assert r.status == 200
+    loop.run_until_complete(go())
+
+
+def test_ooba_generate(servers):
+    loop, _, ooba = servers
+
+    async def go():
+        r = await ooba.post("/api/v1/generate", json={
+            "prompt": "the quick", "max_new_tokens": 5,
+            "temperature": 0.0, "ban_eos_token": True})
+        body = await r.json()
+        assert r.status == 200, body
+        assert len(body["results"]) == 1
+    loop.run_until_complete(go())
+
+
+def test_ooba_stream(servers):
+    loop, _, ooba = servers
+
+    async def go():
+        r = await ooba.post("/api/v1/generate", json={
+            "prompt": "hello", "max_new_tokens": 4, "stream": True,
+            "temperature": 0.0})
+        chunks = []
+        async for raw in r.content:
+            raw = raw.decode().strip()
+            if raw:
+                chunks.append(json.loads(raw))
+        assert chunks
+        assert "results" in chunks[-1]
+    loop.run_until_complete(go())
+
+
+def test_ooba_model_route(servers):
+    loop, _, ooba = servers
+
+    async def go():
+        r = await ooba.get("/api/v1/model")
+        assert "tiny" in (await r.json())["result"]
+    loop.run_until_complete(go())

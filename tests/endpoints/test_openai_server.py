@@ -1,0 +1,221 @@
+"""OpenAI API server integration tests over a real aiohttp app
+(reference strategy: `tests/async_engine/test_openai_server.py`, but
+in-process instead of a subprocess uvicorn)."""
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from aphrodite_tpu.engine.args_tools import AsyncEngineArgs
+from aphrodite_tpu.engine.async_aphrodite import AsyncAphrodite
+from aphrodite_tpu.endpoints.openai.api_server import build_app
+
+MODEL_KEY = "tiny"
+
+
+@pytest.fixture(scope="module")
+def server_ctx(tiny_model_dir):
+    """One engine + app per module; each test drives it via asyncio.run
+    on a dedicated loop owned by the module."""
+    loop = asyncio.new_event_loop()
+
+    async def setup():
+        engine = AsyncAphrodite.from_engine_args(AsyncEngineArgs(
+            model=tiny_model_dir, load_format="dummy", dtype="float32",
+            max_model_len=256, max_num_seqs=8, swap_space=0.01,
+            disable_log_stats=False, disable_log_requests=True))
+        app = build_app(engine, MODEL_KEY)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        return engine, client
+
+    engine, client = loop.run_until_complete(setup())
+    yield loop, client
+    loop.run_until_complete(client.close())
+    loop.close()
+
+
+def run(server_ctx, coro_fn):
+    loop, client = server_ctx
+    return loop.run_until_complete(coro_fn(client))
+
+
+def test_health(server_ctx):
+    async def go(client):
+        # Health requires a running background loop; trigger it with a
+        # first tiny request if needed.
+        r = await client.post("/v1/completions", json={
+            "model": MODEL_KEY, "prompt": "hi", "max_tokens": 1,
+            "ignore_eos": True})
+        assert r.status == 200, await r.text()
+        r = await client.get("/health")
+        assert r.status == 200
+    run(server_ctx, go)
+
+
+def test_models(server_ctx):
+    async def go(client):
+        r = await client.get("/v1/models")
+        body = await r.json()
+        assert r.status == 200
+        assert body["data"][0]["id"] == MODEL_KEY
+    run(server_ctx, go)
+
+
+def test_tokenize(server_ctx):
+    async def go(client):
+        r = await client.post("/v1/tokenize",
+                              json={"prompt": "hello world"})
+        body = await r.json()
+        assert r.status == 200
+        assert body["count"] == len(body["tokens"]) > 0
+        assert body["max_model_len"] == 256
+    run(server_ctx, go)
+
+
+def test_completion_basic(server_ctx):
+    async def go(client):
+        r = await client.post("/v1/completions", json={
+            "model": MODEL_KEY, "prompt": "the quick brown",
+            "max_tokens": 6, "temperature": 0.0, "ignore_eos": True})
+        body = await r.json()
+        assert r.status == 200, body
+        assert body["object"] == "text_completion"
+        assert len(body["choices"]) == 1
+        assert body["choices"][0]["finish_reason"] == "length"
+        assert body["usage"]["completion_tokens"] == 6
+    run(server_ctx, go)
+
+
+def test_completion_wrong_model_404(server_ctx):
+    async def go(client):
+        r = await client.post("/v1/completions", json={
+            "model": "nope", "prompt": "x", "max_tokens": 1})
+        assert r.status == 404
+    run(server_ctx, go)
+
+
+def test_completion_n_choices(server_ctx):
+    async def go(client):
+        r = await client.post("/v1/completions", json={
+            "model": MODEL_KEY, "prompt": "hello", "max_tokens": 4,
+            "n": 2, "best_of": 2, "seed": 5, "ignore_eos": True})
+        body = await r.json()
+        assert r.status == 200, body
+        assert len(body["choices"]) == 2
+    run(server_ctx, go)
+
+
+def test_completion_logprobs(server_ctx):
+    async def go(client):
+        r = await client.post("/v1/completions", json={
+            "model": MODEL_KEY, "prompt": "hello", "max_tokens": 3,
+            "temperature": 0.0, "logprobs": 2, "ignore_eos": True})
+        body = await r.json()
+        assert r.status == 200, body
+        lp = body["choices"][0]["logprobs"]
+        assert len(lp["tokens"]) == 3
+        assert len(lp["top_logprobs"]) == 3
+        assert all(len(d) >= 2 for d in lp["top_logprobs"])
+    run(server_ctx, go)
+
+
+def test_completion_streaming(server_ctx):
+    async def go(client):
+        r = await client.post("/v1/completions", json={
+            "model": MODEL_KEY, "prompt": "the quick", "max_tokens": 5,
+            "temperature": 0.0, "stream": True, "ignore_eos": True})
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        chunks, done = [], False
+        async for line in r.content:
+            line = line.decode().strip()
+            if not line.startswith("data: "):
+                continue
+            payload = line[len("data: "):]
+            if payload == "[DONE]":
+                done = True
+                break
+            chunks.append(json.loads(payload))
+        assert done
+        assert chunks
+        assert chunks[-1]["choices"][0]["finish_reason"] == "length"
+    run(server_ctx, go)
+
+
+def test_chat_completion(server_ctx):
+    async def go(client):
+        r = await client.post("/v1/chat/completions", json={
+            "model": MODEL_KEY,
+            "messages": [{"role": "user", "content": "say hi"}],
+            "max_tokens": 5, "temperature": 0.0, "ignore_eos": True})
+        body = await r.json()
+        assert r.status == 200, body
+        msg = body["choices"][0]["message"]
+        assert msg["role"] == "assistant"
+        assert isinstance(msg["content"], str)
+    run(server_ctx, go)
+
+
+def test_chat_streaming(server_ctx):
+    async def go(client):
+        r = await client.post("/v1/chat/completions", json={
+            "model": MODEL_KEY,
+            "messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 4, "stream": True, "ignore_eos": True})
+        assert r.status == 200
+        saw_role = saw_done = False
+        async for line in r.content:
+            line = line.decode().strip()
+            if not line.startswith("data: "):
+                continue
+            payload = line[len("data: "):]
+            if payload == "[DONE]":
+                saw_done = True
+                break
+            chunk = json.loads(payload)
+            delta = chunk["choices"][0]["delta"]
+            if delta.get("role") == "assistant":
+                saw_role = True
+        assert saw_role and saw_done
+    run(server_ctx, go)
+
+
+def test_logit_bias_forces_token(server_ctx):
+    async def go(client):
+        r = await client.post("/v1/completions", json={
+            "model": MODEL_KEY, "prompt": "hello", "max_tokens": 3,
+            "temperature": 0.0, "logit_bias": {"42": 100.0},
+            "logprobs": 0, "ignore_eos": True})
+        body = await r.json()
+        assert r.status == 200, body
+        # +100 bias must make token 42 win every greedy step; logprobs
+        # tokens echo the sampled token strings.
+        lp = body["choices"][0]["logprobs"]
+        # All three sampled tokens identical (token id 42's string).
+        assert len(set(lp["tokens"])) == 1
+    run(server_ctx, go)
+
+
+def test_logit_bias_out_of_vocab_rejected(server_ctx):
+    async def go(client):
+        r = await client.post("/v1/completions", json={
+            "model": MODEL_KEY, "prompt": "hello", "max_tokens": 2,
+            "logit_bias": {"99999999": 5.0}})
+        assert r.status == 400
+        # Engine must still be alive afterwards.
+        r = await client.post("/v1/completions", json={
+            "model": MODEL_KEY, "prompt": "hi", "max_tokens": 1,
+            "ignore_eos": True})
+        assert r.status == 200
+    run(server_ctx, go)
+
+
+def test_metrics_endpoint(server_ctx):
+    async def go(client):
+        r = await client.get("/metrics")
+        assert r.status == 200
+        text = await r.text()
+        assert "aphrodite" in text
+    run(server_ctx, go)

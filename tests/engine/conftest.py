@@ -1,72 +1,1 @@
-"""Engine test fixtures: a fully offline tiny Llama checkpoint directory
-(config.json + trained-in-process BPE tokenizer + dummy weights via
---load-format dummy). No network, no real checkpoints — the reference's
-engine tests require GPUs + HF hub; this runs anywhere."""
-import json
-import os
-
-import pytest
-
-
-_CORPUS = [
-    "the quick brown fox jumps over the lazy dog",
-    "hello world this is a tiny tokenizer training corpus",
-    "continuous batching over a paged key value cache",
-    "tensor parallel meshes shard attention heads",
-    "sampling with top p top k and repetition penalties",
-    "0123456789 !?.,:;()[]{}",
-] * 4
-
-
-@pytest.fixture(scope="session")
-def tiny_model_dir(tmp_path_factory):
-    path = tmp_path_factory.mktemp("tiny-llama")
-
-    # 1. Tokenizer: ByteLevel BPE trained in-process (offline).
-    from tokenizers import (Tokenizer, decoders, models, pre_tokenizers,
-                            trainers)
-    tok = Tokenizer(models.BPE(unk_token=None))
-    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=True)
-    tok.decoder = decoders.ByteLevel()
-    trainer = trainers.BpeTrainer(
-        vocab_size=512,
-        special_tokens=["<s>", "</s>", "<pad>"],
-        initial_alphabet=pre_tokenizers.ByteLevel.alphabet())
-    tok.train_from_iterator(_CORPUS, trainer)
-    tok.save(str(path / "tokenizer.json"))
-    vocab_size = tok.get_vocab_size()
-    (path / "tokenizer_config.json").write_text(json.dumps({
-        "tokenizer_class": "PreTrainedTokenizerFast",
-        "bos_token": "<s>",
-        "eos_token": "</s>",
-        "pad_token": "<pad>",
-        "model_max_length": 512,
-    }))
-
-    # 2. Tiny Llama config.
-    (path / "config.json").write_text(json.dumps({
-        "architectures": ["LlamaForCausalLM"],
-        "model_type": "llama",
-        "vocab_size": vocab_size,
-        "hidden_size": 64,
-        "intermediate_size": 128,
-        "num_hidden_layers": 2,
-        "num_attention_heads": 4,
-        "num_key_value_heads": 2,
-        "max_position_embeddings": 512,
-        "rms_norm_eps": 1e-6,
-        "rope_theta": 10000.0,
-        "tie_word_embeddings": False,
-        "torch_dtype": "float32",
-        "bos_token_id": 0,
-        "eos_token_id": 1,
-    }))
-    return str(path)
-
-
-@pytest.fixture(scope="session")
-def tiny_llm(tiny_model_dir):
-    from aphrodite_tpu.endpoints.llm import LLM
-    return LLM(model=tiny_model_dir, load_format="dummy", dtype="float32",
-               block_size=16, max_model_len=256, max_num_seqs=16,
-               swap_space=0.01)
+"""Engine fixtures live in tests/conftest.py (shared with API tests)."""
