@@ -167,6 +167,16 @@ class ModelConfig:
         total = self.get_total_num_kv_heads()
         return max(1, total // parallel_config.tensor_parallel_size)
 
+    def get_kv_heads_per_layer(self) -> list:
+        """Per-layer KV head counts (DeciLM-style variable GQA,
+        reference models/decilm.py); uniform for everything else."""
+        per_layer = getattr(self.hf_config, "num_key_value_heads_per_layer",
+                            None)
+        if per_layer is not None:
+            return list(per_layer)
+        return [self.get_total_num_kv_heads()] * \
+            self.hf_config.num_hidden_layers
+
     def get_num_attention_heads(
             self, parallel_config: "ParallelConfig") -> int:
         return (self.hf_config.num_attention_heads //

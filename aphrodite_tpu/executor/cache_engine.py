@@ -67,6 +67,7 @@ class CacheEngine:
 
         self.num_layers = model_config.hf_config.num_hidden_layers
         self.num_kv_heads = model_config.get_total_num_kv_heads()
+        self.kv_heads_per_layer = model_config.get_kv_heads_per_layer()
         self.head_size = model_config.get_head_size()
 
         model_dtype = _MODEL_DTYPES[model_config.dtype]
@@ -74,33 +75,37 @@ class CacheEngine:
         self.dtype = quant if quant is not None else model_dtype
 
         self.kv_caches: List[KVCache] = self._allocate_device()
-        # Host swap pool: [layers, 2, heads, pages, page, dim] numpy.
-        self._host_pool: Optional[np.ndarray] = None
+        # Host swap pool: per layer [2, heads_i, pages, page, dim] numpy
+        # (list because DeciLM-style models vary heads per layer).
+        self._host_pool: Optional[List[np.ndarray]] = None
         if self.num_host_pages > 0:
-            self._host_pool = np.zeros(
-                (self.num_layers, 2, self.num_kv_heads,
-                 self.num_host_pages, self.page_size, self.head_size),
-                dtype=np.float32)
+            self._host_pool = [
+                np.zeros((2, heads, self.num_host_pages, self.page_size,
+                          self.head_size), dtype=np.float32)
+                for heads in self.kv_heads_per_layer
+            ]
 
     # -- allocation --
 
-    def _page_shape(self) -> Tuple[int, int, int, int]:
-        return (self.num_kv_heads, self.num_device_pages, self.page_size,
-                self.head_size)
-
     def _allocate_device(self) -> List[KVCache]:
-        shape = self._page_shape()
-        sharding = None
-        if self.mesh is not None:
-            sharding = NamedSharding(self.mesh, P("tp", None, None, None))
-
-        def alloc():
+        def alloc(num_heads: int):
+            shape = (num_heads, self.num_device_pages, self.page_size,
+                     self.head_size)
             z = jnp.zeros(shape, dtype=self.dtype)
-            if sharding is not None:
-                z = jax.device_put(z, sharding)
+            if self.mesh is not None:
+                tp = self.mesh.shape["tp"]
+                if num_heads % tp == 0:
+                    spec = P("tp", None, None, None)
+                else:
+                    # Fewer KV heads than chips: replicate the pages,
+                    # exactly as the reference replicates KV heads when
+                    # heads < tp (common/config.py:265-273).
+                    spec = P(None, None, None, None)
+                z = jax.device_put(z, NamedSharding(self.mesh, spec))
             return z
 
-        return [(alloc(), alloc()) for _ in range(self.num_layers)]
+        return [(alloc(heads), alloc(heads))
+                for heads in self.kv_heads_per_layer]
 
     @property
     def num_slots(self) -> int:
@@ -120,8 +125,8 @@ class CacheEngine:
                                 dtype=np.float32)
             v_host = np.asarray(jnp.take(v_pages, src, axis=1),
                                 dtype=np.float32)
-            self._host_pool[layer, 0][:, dst] = k_host
-            self._host_pool[layer, 1][:, dst] = v_host
+            self._host_pool[layer][0][:, dst] = k_host
+            self._host_pool[layer][1][:, dst] = v_host
 
     def swap_in(self, mapping: Dict[int, int]) -> None:
         """Host pool -> device pages (reference swap_in :136)."""
@@ -131,9 +136,9 @@ class CacheEngine:
         dst = np.fromiter(mapping.values(), dtype=np.int64)
         new_caches: List[KVCache] = []
         for layer, (k_pages, v_pages) in enumerate(self.kv_caches):
-            k_in = jnp.asarray(self._host_pool[layer, 0][:, src],
+            k_in = jnp.asarray(self._host_pool[layer][0][:, src],
                                dtype=self.dtype)
-            v_in = jnp.asarray(self._host_pool[layer, 1][:, src],
+            v_in = jnp.asarray(self._host_pool[layer][1][:, src],
                                dtype=self.dtype)
             k_pages = k_pages.at[:, dst].set(k_in)
             v_pages = v_pages.at[:, dst].set(v_in)
@@ -148,8 +153,7 @@ class CacheEngine:
         `cache_engine.py:148-171`), for the profiling -> page-count math.
         Uses TOTAL kv heads: with TP sharding each chip holds
         heads/tp, but it also only gets budget/tp of the pool."""
-        num_layers = model_config.hf_config.num_hidden_layers
-        num_heads = model_config.get_total_num_kv_heads()
+        total_heads = sum(model_config.get_kv_heads_per_layer())
         head_size = model_config.get_head_size()
         if cache_config.cache_dtype in ("fp8", "int8"):
             elt = 1
@@ -157,5 +161,5 @@ class CacheEngine:
             elt = 4
         else:
             elt = 2
-        per_token = num_heads * head_size * elt
-        return 2 * num_layers * cache_config.block_size * per_token
+        per_token = total_heads * head_size * elt
+        return 2 * cache_config.block_size * per_token

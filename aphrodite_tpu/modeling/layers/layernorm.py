@@ -23,6 +23,18 @@ def rms_norm(x: jax.Array, weight: jax.Array,
     return (y * weight.astype(jnp.float32)).astype(x.dtype)
 
 
+def layer_norm(x: jax.Array, weight: jax.Array, bias: jax.Array,
+               eps: float = 1e-5) -> jax.Array:
+    """Standard LayerNorm (mean-centered) for OPT/GPT-NeoX/GPT-J/Phi
+    families; float32 accumulation."""
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean((xf - mean) ** 2, axis=-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    y = y * weight.astype(jnp.float32) + bias.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
 def fused_add_rms_norm(
     x: jax.Array,
     residual: Optional[jax.Array],

@@ -1,0 +1,38 @@
+"""DeciLM: Llama with per-layer variable GQA
+(reference: `aphrodite/modeling/models/decilm.py`, 125 LoC — a Llama
+subclass parameterized by config.num_key_value_heads_per_layer).
+"""
+from __future__ import annotations
+
+import copy
+from typing import Iterable, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from aphrodite_tpu.modeling.layers.linear import LinearMethod
+from aphrodite_tpu.modeling.models.llama import (LlamaDecoderLayer,
+                                                 LlamaForCausalLM)
+
+
+class DeciLMForCausalLM(LlamaForCausalLM):
+    """Each decoder layer gets its own num_key_value_heads."""
+
+    def __init__(self, config, dtype: jnp.dtype = jnp.bfloat16,
+                 linear_method: LinearMethod = None) -> None:
+        kv_per_layer = list(config.num_key_value_heads_per_layer)
+        # Build with a uniform config first, then rebuild each layer with
+        # its own kv-head count.
+        config.num_key_value_heads = max(kv_per_layer)
+        super().__init__(config, dtype=dtype, linear_method=linear_method)
+        self.layers = []
+        for i, kv_heads in enumerate(kv_per_layer):
+            layer_config = copy.deepcopy(config)
+            layer_config.num_key_value_heads = kv_heads
+            self.layers.append(
+                LlamaDecoderLayer(layer_config, i, dtype, linear_method))
+
+    def load_weights(self, weights: Iterable[Tuple[str, np.ndarray]]):
+        """DeciLM checkpoints degroup KV weights; layout matches the
+        per-layer QKV shapes built above, so the Llama loader applies."""
+        return super().load_weights(weights)

@@ -1,0 +1,258 @@
+"""Deepseek MoE (reference: `aphrodite/modeling/models/deepseek.py`,
+502 LoC — fused-MoE path `:184`, shared experts + first-k dense layers).
+
+Llama attention + per-layer choice of dense MLP (first_k_dense_replace /
+moe_layer_freq) or FusedMoE with shared experts added on top.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from aphrodite_tpu.modeling.input_metadata import InputMetadata
+from aphrodite_tpu.modeling.layers.fused_moe import FusedMoE
+from aphrodite_tpu.modeling.layers.layernorm import (fused_add_rms_norm,
+                                                     rms_norm)
+from aphrodite_tpu.modeling.layers.linear import LinearMethod
+from aphrodite_tpu.modeling.models.llama import LlamaAttention, LlamaMLP
+from aphrodite_tpu.modeling.layers.vocab_embedding import (
+    ParallelLMHead, VocabParallelEmbedding)
+
+KVCache = Tuple[jax.Array, jax.Array]
+
+
+class DeepseekDecoderLayer:
+
+    def __init__(self, config, idx: int, dtype, linear_method) -> None:
+        self.prefix = f"model.layers.{idx}"
+        self.rms_eps = config.rms_norm_eps
+        self.self_attn = LlamaAttention(config, self.prefix, dtype,
+                                        linear_method)
+        self.is_moe = (
+            getattr(config, "n_routed_experts", None) is not None
+            and idx >= config.first_k_dense_replace
+            and idx % config.moe_layer_freq == 0)
+        if self.is_moe:
+            self.moe = FusedMoE(
+                num_experts=config.n_routed_experts,
+                top_k=config.num_experts_per_tok,
+                hidden_size=config.hidden_size,
+                intermediate_size=config.moe_intermediate_size,
+                renormalize=getattr(config, "norm_topk_prob", False),
+                dtype=dtype)
+            self.n_shared = getattr(config, "n_shared_experts", 0) or 0
+            if self.n_shared:
+                shared_config = _MLPConfig(
+                    config.hidden_size,
+                    config.moe_intermediate_size * self.n_shared)
+                self.shared_mlp = LlamaMLP(
+                    shared_config, f"{self.prefix}.shared", dtype,
+                    linear_method)
+        else:
+            self.mlp = LlamaMLP(config, self.prefix, dtype, linear_method)
+        self.dtype = dtype
+        self.hidden_size = config.hidden_size
+
+    def init(self):
+        p = {}
+        p.update(self.self_attn.init())
+        if self.is_moe:
+            p[f"{self.prefix}.mlp_moe"] = self.moe.init()
+            if self.n_shared:
+                p.update(self.shared_mlp.init())
+        else:
+            p.update(self.mlp.init())
+        ones = jnp.ones((self.hidden_size,), dtype=self.dtype)
+        p[f"{self.prefix}.input_layernorm"] = {"weight": ones}
+        p[f"{self.prefix}.post_attention_layernorm"] = {"weight": ones}
+        return p
+
+    def specs(self):
+        s = {}
+        s.update(self.self_attn.specs())
+        if self.is_moe:
+            s[f"{self.prefix}.mlp_moe"] = self.moe.specs()
+            if self.n_shared:
+                s.update(self.shared_mlp.specs())
+        else:
+            s.update(self.mlp.specs())
+        s[f"{self.prefix}.input_layernorm"] = {"weight": P(None)}
+        s[f"{self.prefix}.post_attention_layernorm"] = {"weight": P(None)}
+        return s
+
+    def __call__(self, params, positions, hidden, residual, kv_cache,
+                 metadata):
+        normed, residual = fused_add_rms_norm(
+            hidden, residual,
+            params[f"{self.prefix}.input_layernorm"]["weight"],
+            self.rms_eps)
+        attn_out, new_cache = self.self_attn(params, positions, normed,
+                                             kv_cache, metadata)
+        normed, residual = fused_add_rms_norm(
+            attn_out, residual,
+            params[f"{self.prefix}.post_attention_layernorm"]["weight"],
+            self.rms_eps)
+        if self.is_moe:
+            out = self.moe(params[f"{self.prefix}.mlp_moe"], normed)
+            if self.n_shared:
+                out = out + self.shared_mlp(params, normed)
+        else:
+            out = self.mlp(params, normed)
+        return out, residual, new_cache
+
+
+class _MLPConfig:
+    """Minimal config shim for a shared-expert LlamaMLP."""
+
+    def __init__(self, hidden_size: int, intermediate_size: int) -> None:
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size
+
+
+class DeepseekForCausalLM:
+
+    def __init__(self, config, dtype: jnp.dtype = jnp.bfloat16,
+                 linear_method: Optional[LinearMethod] = None) -> None:
+        self.config = config
+        self.dtype = dtype
+        self.embed_tokens = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size, dtype=dtype)
+        self.layers = [
+            DeepseekDecoderLayer(config, i, dtype, linear_method)
+            for i in range(config.num_hidden_layers)
+        ]
+        self.lm_head = ParallelLMHead(config.vocab_size,
+                                      config.hidden_size, dtype=dtype)
+        self.rms_eps = config.rms_norm_eps
+        self.tie_word_embeddings = getattr(config, "tie_word_embeddings",
+                                           False)
+
+    def init_params(self):
+        params = {"model.embed_tokens": self.embed_tokens.init()}
+        for layer in self.layers:
+            params.update(layer.init())
+        params["model.norm"] = {
+            "weight": jnp.ones((self.config.hidden_size,),
+                               dtype=self.dtype)}
+        if not self.tie_word_embeddings:
+            params["lm_head"] = self.lm_head.init()
+        return params
+
+    def param_specs(self):
+        specs = {"model.embed_tokens": self.embed_tokens.specs()}
+        for layer in self.layers:
+            specs.update(layer.specs())
+        specs["model.norm"] = {"weight": P(None)}
+        if not self.tie_word_embeddings:
+            specs["lm_head"] = self.lm_head.specs()
+        return specs
+
+    def __call__(self, params, input_ids, positions, kv_caches,
+                 metadata: InputMetadata):
+        hidden = self.embed_tokens(params["model.embed_tokens"],
+                                   input_ids)
+        residual = None
+        new_caches: List[KVCache] = []
+        for i, layer in enumerate(self.layers):
+            cache = kv_caches[i] if kv_caches is not None else None
+            hidden, residual, new_cache = layer(params, positions, hidden,
+                                                residual, cache, metadata)
+            if new_cache is not None:
+                new_caches.append(new_cache)
+        hidden = rms_norm(hidden + residual,
+                          params["model.norm"]["weight"], self.rms_eps)
+        return hidden, (new_caches if kv_caches is not None else None)
+
+    def compute_logits(self, params, hidden):
+        head = params["model.embed_tokens"] if self.tie_word_embeddings \
+            else params["lm_head"]
+        return self.lm_head.compute_logits(head, hidden)
+
+    _STACKED = [("q_proj", "qkv_proj", "q"), ("k_proj", "qkv_proj", "k"),
+                ("v_proj", "qkv_proj", "v"),
+                ("gate_proj", "gate_up_proj", 0),
+                ("up_proj", "gate_up_proj", 1)]
+    _EXPERT_MAP = {"gate_proj": "w_gate", "up_proj": "w_up",
+                   "down_proj": "w_down"}
+
+    def load_weights(self, weights: Iterable[Tuple[str, np.ndarray]]):
+        loaders = {}
+        moes = {}
+        for layer in self.layers:
+            p = layer.prefix
+            loaders[f"{p}.self_attn.qkv_proj"] = layer.self_attn.qkv_proj
+            loaders[f"{p}.self_attn.o_proj"] = layer.self_attn.o_proj
+            if layer.is_moe:
+                moes[p] = layer.moe
+                if layer.n_shared:
+                    sp = layer.shared_mlp.prefix
+                    loaders[f"{sp}.mlp.gate_up_proj"] = \
+                        layer.shared_mlp.gate_up_proj
+                    loaders[f"{sp}.mlp.down_proj"] = \
+                        layer.shared_mlp.down_proj
+            else:
+                loaders[f"{p}.mlp.gate_up_proj"] = layer.mlp.gate_up_proj
+                loaders[f"{p}.mlp.down_proj"] = layer.mlp.down_proj
+        params: Dict[str, Dict[str, np.ndarray]] = {}
+
+        def bucket(key):
+            return params.setdefault(key, {})
+
+        for name, tensor in weights:
+            if "rotary_emb.inv_freq" in name:
+                continue
+            if name.startswith("lm_head"):
+                if self.tie_word_embeddings:
+                    continue
+                self.lm_head.weight_loader(bucket("lm_head"), "weight",
+                                           tensor)
+                continue
+            if name == "model.embed_tokens.weight":
+                self.embed_tokens.weight_loader(
+                    bucket("model.embed_tokens"), "weight", tensor)
+                continue
+            if name == "model.norm.weight":
+                bucket("model.norm")["weight"] = tensor
+                continue
+            if name.endswith("_layernorm.weight"):
+                key, pname = name.rsplit(".", 1)
+                bucket(key)[pname] = tensor
+                continue
+            if ".mlp.experts." in name:
+                layer_prefix = name.split(".mlp.experts.")[0]
+                rest = name.split(".mlp.experts.")[1]
+                expert_id = int(rest.split(".")[0])
+                which = self._EXPERT_MAP[rest.split(".")[1]]
+                moes[layer_prefix].load_expert_weight(
+                    bucket(f"{layer_prefix}.mlp_moe"), which, expert_id,
+                    tensor)
+                continue
+            if ".mlp.gate.weight" in name:
+                layer_prefix = name.split(".mlp.gate.weight")[0].rstrip(
+                    ".")
+                moes[layer_prefix].load_gate_weight(
+                    bucket(f"{layer_prefix}.mlp_moe"), tensor)
+                continue
+            if ".mlp.shared_experts." in name:
+                # -> shared LlamaMLP params under "<prefix>.shared.mlp.*"
+                name = name.replace(".mlp.shared_experts.",
+                                    ".shared.mlp.")
+            for hf_frag, merged, shard_id in self._STACKED:
+                if f".{hf_frag}." in name:
+                    key = name.replace(hf_frag, merged)
+                    key, pname = key.rsplit(".", 1)
+                    if key in loaders:
+                        loaders[key].weight_loader(bucket(key), pname,
+                                                   tensor, shard_id)
+                    break
+            else:
+                if name.endswith((".weight", ".bias")):
+                    key, pname = name.rsplit(".", 1)
+                    if key in loaders:
+                        loaders[key].weight_loader(bucket(key), pname,
+                                                   tensor)
+        return params

@@ -1,0 +1,238 @@
+"""GPT-J (reference: `aphrodite/modeling/models/gpt_j.py`, 314 LoC).
+
+GPT-J-style (interleaved) partial rotary, parallel attention+MLP
+residual, single pre-layernorm per block, biased LM head.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from aphrodite_tpu.modeling.input_metadata import InputMetadata
+from aphrodite_tpu.modeling.layers.activation import get_act_fn
+from aphrodite_tpu.modeling.layers.attention import PagedAttention
+from aphrodite_tpu.modeling.layers.layernorm import layer_norm
+from aphrodite_tpu.modeling.layers.linear import (ColumnParallelLinear,
+                                                  LinearMethod,
+                                                  QKVParallelLinear,
+                                                  RowParallelLinear)
+from aphrodite_tpu.modeling.layers.rotary_embedding import get_rope
+from aphrodite_tpu.modeling.layers.vocab_embedding import (
+    ParallelLMHead, VocabParallelEmbedding)
+
+KVCache = Tuple[jax.Array, jax.Array]
+
+
+class GPTJAttention:
+
+    def __init__(self, config, prefix: str, dtype,
+                 linear_method: Optional[LinearMethod]) -> None:
+        self.prefix = prefix
+        hidden = config.n_embd
+        self.num_heads = config.n_head
+        self.head_dim = hidden // self.num_heads
+        self.qkv_proj = QKVParallelLinear(
+            hidden, self.head_dim, self.num_heads, bias=False, dtype=dtype,
+            linear_method=linear_method)
+        self.out_proj = RowParallelLinear(hidden, hidden, bias=False,
+                                          dtype=dtype,
+                                          linear_method=linear_method)
+        self.rotary = get_rope(
+            self.head_dim, config.rotary_dim,
+            max_position=config.n_positions,
+            base=10000.0,
+            is_neox_style=False)
+        self.attn = PagedAttention(self.num_heads, self.head_dim,
+                                   scale=self.head_dim ** -0.5)
+
+    def init(self):
+        return {f"{self.prefix}.qkv_proj": self.qkv_proj.init(),
+                f"{self.prefix}.out_proj": self.out_proj.init()}
+
+    def specs(self):
+        return {f"{self.prefix}.qkv_proj": self.qkv_proj.specs(),
+                f"{self.prefix}.out_proj": self.out_proj.specs()}
+
+    def __call__(self, params, positions, hidden, kv_cache, metadata):
+        qkv = self.qkv_proj(params[f"{self.prefix}.qkv_proj"], hidden)
+        q, k, v = self.qkv_proj.split(qkv)
+        b, s = q.shape[:2]
+        q = q.reshape(b, s, self.num_heads, self.head_dim)
+        k = k.reshape(b, s, self.num_heads, self.head_dim)
+        q, k = self.rotary(positions, q, k)
+        q = q.reshape(b, s, -1)
+        k = k.reshape(b, s, -1)
+        k_pages, v_pages = kv_cache if kv_cache is not None else (None,
+                                                                 None)
+        out, k_pages, v_pages = self.attn(q, k, v, k_pages, v_pages,
+                                          metadata)
+        out = self.out_proj(params[f"{self.prefix}.out_proj"], out)
+        return out, (None if k_pages is None else (k_pages, v_pages))
+
+
+class GPTJBlock:
+
+    def __init__(self, config, idx: int, dtype, linear_method) -> None:
+        self.prefix = f"transformer.h.{idx}"
+        self.attn = GPTJAttention(config, f"{self.prefix}.attn", dtype,
+                                  linear_method)
+        hidden = config.n_embd
+        inner = getattr(config, "n_inner", None) or 4 * hidden
+        self.fc_in = ColumnParallelLinear(hidden, inner, bias=True,
+                                          dtype=dtype,
+                                          linear_method=linear_method)
+        self.fc_out = RowParallelLinear(inner, hidden, bias=True,
+                                        dtype=dtype,
+                                        linear_method=linear_method)
+        self.act = get_act_fn(config.activation_function)
+        self.dtype = dtype
+        self.hidden = hidden
+        self.eps = config.layer_norm_epsilon
+
+    def init(self):
+        p = {}
+        p.update(self.attn.init())
+        p[f"{self.prefix}.mlp.fc_in"] = self.fc_in.init()
+        p[f"{self.prefix}.mlp.fc_out"] = self.fc_out.init()
+        p[f"{self.prefix}.ln_1"] = {
+            "weight": jnp.ones((self.hidden,), dtype=self.dtype),
+            "bias": jnp.zeros((self.hidden,), dtype=self.dtype)}
+        return p
+
+    def specs(self):
+        s = {}
+        s.update(self.attn.specs())
+        s[f"{self.prefix}.mlp.fc_in"] = self.fc_in.specs()
+        s[f"{self.prefix}.mlp.fc_out"] = self.fc_out.specs()
+        s[f"{self.prefix}.ln_1"] = {"weight": P(None), "bias": P(None)}
+        return s
+
+    def __call__(self, params, positions, hidden, kv_cache, metadata):
+        ln = params[f"{self.prefix}.ln_1"]
+        normed = layer_norm(hidden, ln["weight"], ln["bias"], self.eps)
+        attn_out, new_cache = self.attn(params, positions, normed,
+                                        kv_cache, metadata)
+        mlp_out = self.fc_out(
+            params[f"{self.prefix}.mlp.fc_out"],
+            self.act(self.fc_in(params[f"{self.prefix}.mlp.fc_in"],
+                                normed)))
+        return hidden + attn_out + mlp_out, new_cache
+
+
+class GPTJForCausalLM:
+
+    def __init__(self, config, dtype: jnp.dtype = jnp.bfloat16,
+                 linear_method: Optional[LinearMethod] = None) -> None:
+        self.config = config
+        self.dtype = dtype
+        self.wte = VocabParallelEmbedding(config.vocab_size,
+                                          config.n_embd, dtype=dtype)
+        self.layers = [
+            GPTJBlock(config, i, dtype, linear_method)
+            for i in range(config.n_layer)
+        ]
+        self.lm_head = ParallelLMHead(config.vocab_size, config.n_embd,
+                                      dtype=dtype)
+        self.tie_word_embeddings = False
+
+    def init_params(self):
+        cfg = self.config
+        params = {"transformer.wte": self.wte.init()}
+        for layer in self.layers:
+            params.update(layer.init())
+        params["transformer.ln_f"] = {
+            "weight": jnp.ones((cfg.n_embd,), dtype=self.dtype),
+            "bias": jnp.zeros((cfg.n_embd,), dtype=self.dtype)}
+        head = self.lm_head.init()
+        head["bias"] = jnp.zeros((self.lm_head.num_embeddings_padded,),
+                                 dtype=self.dtype)
+        params["lm_head"] = head
+        return params
+
+    def param_specs(self):
+        specs = {"transformer.wte": self.wte.specs()}
+        for layer in self.layers:
+            specs.update(layer.specs())
+        specs["transformer.ln_f"] = {"weight": P(None), "bias": P(None)}
+        head = self.lm_head.specs()
+        head["bias"] = P("tp")
+        specs["lm_head"] = head
+        return specs
+
+    def __call__(self, params, input_ids, positions, kv_caches,
+                 metadata: InputMetadata):
+        hidden = self.wte(params["transformer.wte"], input_ids)
+        new_caches: List[KVCache] = []
+        for i, layer in enumerate(self.layers):
+            cache = kv_caches[i] if kv_caches is not None else None
+            hidden, new_cache = layer(params, positions, hidden, cache,
+                                      metadata)
+            if new_cache is not None:
+                new_caches.append(new_cache)
+        ln = params["transformer.ln_f"]
+        hidden = layer_norm(hidden, ln["weight"], ln["bias"],
+                            self.config.layer_norm_epsilon)
+        return hidden, (new_caches if kv_caches is not None else None)
+
+    def compute_logits(self, params, hidden):
+        logits = self.lm_head.compute_logits(params["lm_head"], hidden)
+        bias = params["lm_head"].get("bias")
+        if bias is not None:
+            logits = logits + bias[:self.lm_head.org_vocab_size]
+        return logits
+
+    _STACKED = [("q_proj", "qkv_proj", "q"), ("k_proj", "qkv_proj", "k"),
+                ("v_proj", "qkv_proj", "v")]
+
+    def load_weights(self, weights: Iterable[Tuple[str, np.ndarray]]):
+        loaders = {}
+        for layer in self.layers:
+            p = layer.prefix
+            loaders[f"{p}.attn.qkv_proj"] = layer.attn.qkv_proj
+            loaders[f"{p}.attn.out_proj"] = layer.attn.out_proj
+            loaders[f"{p}.mlp.fc_in"] = layer.fc_in
+            loaders[f"{p}.mlp.fc_out"] = layer.fc_out
+        params: Dict[str, Dict[str, np.ndarray]] = {}
+
+        def bucket(key):
+            return params.setdefault(key, {})
+
+        for name, tensor in weights:
+            if "attn.bias" in name or "attn.masked_bias" in name:
+                continue
+            if name == "transformer.wte.weight":
+                self.wte.weight_loader(bucket("transformer.wte"),
+                                       "weight", tensor)
+                continue
+            if name == "lm_head.weight":
+                self.lm_head.weight_loader(bucket("lm_head"), "weight",
+                                           tensor)
+                continue
+            if name == "lm_head.bias":
+                padded = np.zeros((self.lm_head.num_embeddings_padded,),
+                                  dtype=tensor.dtype)
+                padded[:tensor.shape[0]] = tensor
+                bucket("lm_head")["bias"] = padded
+                continue
+            if ".ln_1." in name or name.startswith("transformer.ln_f"):
+                key, pname = name.rsplit(".", 1)
+                bucket(key)[pname] = tensor
+                continue
+            for hf_frag, merged, shard_id in self._STACKED:
+                if f".{hf_frag}." in name:
+                    key = name.replace(hf_frag, merged)
+                    key, pname = key.rsplit(".", 1)
+                    loaders[key].weight_loader(bucket(key), pname, tensor,
+                                               shard_id)
+                    break
+            else:
+                if name.endswith((".weight", ".bias")):
+                    key, pname = name.rsplit(".", 1)
+                    if key in loaders:
+                        loaders[key].weight_loader(bucket(key), pname,
+                                                   tensor)
+        return params

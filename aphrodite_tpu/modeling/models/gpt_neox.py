@@ -1,0 +1,252 @@
+"""GPT-NeoX family (Pythia etc.; reference:
+`aphrodite/modeling/models/gpt_neox.py`, 301 LoC).
+
+Partial rotary (rotary_pct), parallel-residual option, LayerNorm with
+bias, untied embed_out. HF stores query_key_value interleaved per head
+([h0_q h0_k h0_v h1_q ...]); the loader de-interleaves into the merged
+[Q|K|V] layout.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from aphrodite_tpu.modeling.input_metadata import InputMetadata
+from aphrodite_tpu.modeling.layers.activation import get_act_fn
+from aphrodite_tpu.modeling.layers.attention import PagedAttention
+from aphrodite_tpu.modeling.layers.layernorm import layer_norm
+from aphrodite_tpu.modeling.layers.linear import (ColumnParallelLinear,
+                                                  LinearMethod,
+                                                  QKVParallelLinear,
+                                                  RowParallelLinear)
+from aphrodite_tpu.modeling.layers.rotary_embedding import get_rope
+from aphrodite_tpu.modeling.layers.vocab_embedding import (
+    ParallelLMHead, VocabParallelEmbedding)
+
+KVCache = Tuple[jax.Array, jax.Array]
+
+
+class GPTNeoXAttention:
+
+    def __init__(self, config, prefix: str, dtype,
+                 linear_method: Optional[LinearMethod]) -> None:
+        self.prefix = prefix
+        hidden = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.head_dim = hidden // self.num_heads
+        self.qkv_proj = QKVParallelLinear(
+            hidden, self.head_dim, self.num_heads, bias=True, dtype=dtype,
+            linear_method=linear_method)
+        self.dense = RowParallelLinear(hidden, hidden, bias=True,
+                                       dtype=dtype,
+                                       linear_method=linear_method)
+        rotary_dim = int(self.head_dim * config.rotary_pct)
+        self.rotary = get_rope(
+            self.head_dim, rotary_dim,
+            max_position=config.max_position_embeddings,
+            base=getattr(config, "rotary_emb_base", 10000.0),
+            is_neox_style=True)
+        self.attn = PagedAttention(self.num_heads, self.head_dim,
+                                   scale=self.head_dim ** -0.5)
+
+    def init(self):
+        return {f"{self.prefix}.qkv_proj": self.qkv_proj.init(),
+                f"{self.prefix}.dense": self.dense.init()}
+
+    def specs(self):
+        return {f"{self.prefix}.qkv_proj": self.qkv_proj.specs(),
+                f"{self.prefix}.dense": self.dense.specs()}
+
+    def __call__(self, params, positions, hidden, kv_cache, metadata):
+        qkv = self.qkv_proj(params[f"{self.prefix}.qkv_proj"], hidden)
+        q, k, v = self.qkv_proj.split(qkv)
+        b, s = q.shape[:2]
+        q = q.reshape(b, s, self.num_heads, self.head_dim)
+        k = k.reshape(b, s, self.num_heads, self.head_dim)
+        q, k = self.rotary(positions, q, k)
+        q = q.reshape(b, s, -1)
+        k = k.reshape(b, s, -1)
+        k_pages, v_pages = kv_cache if kv_cache is not None else (None,
+                                                                 None)
+        out, k_pages, v_pages = self.attn(q, k, v, k_pages, v_pages,
+                                          metadata)
+        out = self.dense(params[f"{self.prefix}.dense"], out)
+        return out, (None if k_pages is None else (k_pages, v_pages))
+
+
+class GPTNeoXLayer:
+
+    def __init__(self, config, idx: int, dtype, linear_method) -> None:
+        self.prefix = f"gpt_neox.layers.{idx}"
+        self.config = config
+        self.attention = GPTNeoXAttention(
+            config, f"{self.prefix}.attention", dtype, linear_method)
+        hidden = config.hidden_size
+        self.dense_h_to_4h = ColumnParallelLinear(
+            hidden, config.intermediate_size, bias=True, dtype=dtype,
+            linear_method=linear_method)
+        self.dense_4h_to_h = RowParallelLinear(
+            config.intermediate_size, hidden, bias=True, dtype=dtype,
+            linear_method=linear_method)
+        self.act = get_act_fn(config.hidden_act)
+        self.dtype = dtype
+        self.hidden = hidden
+        self.eps = config.layer_norm_eps
+
+    def _ln(self):
+        return {"weight": jnp.ones((self.hidden,), dtype=self.dtype),
+                "bias": jnp.zeros((self.hidden,), dtype=self.dtype)}
+
+    def init(self):
+        p = {}
+        p.update(self.attention.init())
+        p[f"{self.prefix}.mlp.dense_h_to_4h"] = self.dense_h_to_4h.init()
+        p[f"{self.prefix}.mlp.dense_4h_to_h"] = self.dense_4h_to_h.init()
+        p[f"{self.prefix}.input_layernorm"] = self._ln()
+        p[f"{self.prefix}.post_attention_layernorm"] = self._ln()
+        return p
+
+    def specs(self):
+        s = {}
+        s.update(self.attention.specs())
+        s[f"{self.prefix}.mlp.dense_h_to_4h"] = self.dense_h_to_4h.specs()
+        s[f"{self.prefix}.mlp.dense_4h_to_h"] = self.dense_4h_to_h.specs()
+        ln = {"weight": P(None), "bias": P(None)}
+        s[f"{self.prefix}.input_layernorm"] = dict(ln)
+        s[f"{self.prefix}.post_attention_layernorm"] = dict(ln)
+        return s
+
+    def _mlp(self, params, x):
+        x = self.dense_h_to_4h(
+            params[f"{self.prefix}.mlp.dense_h_to_4h"], x)
+        x = self.act(x)
+        return self.dense_4h_to_h(
+            params[f"{self.prefix}.mlp.dense_4h_to_h"], x)
+
+    def __call__(self, params, positions, hidden, kv_cache, metadata):
+        ln1 = params[f"{self.prefix}.input_layernorm"]
+        ln2 = params[f"{self.prefix}.post_attention_layernorm"]
+        attn_in = layer_norm(hidden, ln1["weight"], ln1["bias"], self.eps)
+        attn_out, new_cache = self.attention(params, positions, attn_in,
+                                             kv_cache, metadata)
+        if self.config.use_parallel_residual:
+            # x + attn(ln1(x)) + mlp(ln2(x))
+            mlp_in = layer_norm(hidden, ln2["weight"], ln2["bias"],
+                                self.eps)
+            hidden = hidden + attn_out + self._mlp(params, mlp_in)
+        else:
+            attn_out = attn_out + hidden
+            mlp_in = layer_norm(attn_out, ln2["weight"], ln2["bias"],
+                                self.eps)
+            hidden = attn_out + self._mlp(params, mlp_in)
+        return hidden, new_cache
+
+
+class GPTNeoXForCausalLM:
+
+    def __init__(self, config, dtype: jnp.dtype = jnp.bfloat16,
+                 linear_method: Optional[LinearMethod] = None) -> None:
+        self.config = config
+        self.dtype = dtype
+        self.embed_in = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size, dtype=dtype)
+        self.layers = [
+            GPTNeoXLayer(config, i, dtype, linear_method)
+            for i in range(config.num_hidden_layers)
+        ]
+        self.embed_out = ParallelLMHead(config.vocab_size,
+                                        config.hidden_size, dtype=dtype)
+        self.tie_word_embeddings = False
+
+    def init_params(self):
+        cfg = self.config
+        params = {"gpt_neox.embed_in": self.embed_in.init()}
+        for layer in self.layers:
+            params.update(layer.init())
+        params["gpt_neox.final_layer_norm"] = {
+            "weight": jnp.ones((cfg.hidden_size,), dtype=self.dtype),
+            "bias": jnp.zeros((cfg.hidden_size,), dtype=self.dtype),
+        }
+        params["embed_out"] = self.embed_out.init()
+        return params
+
+    def param_specs(self):
+        specs = {"gpt_neox.embed_in": self.embed_in.specs()}
+        for layer in self.layers:
+            specs.update(layer.specs())
+        specs["gpt_neox.final_layer_norm"] = {"weight": P(None),
+                                              "bias": P(None)}
+        specs["embed_out"] = self.embed_out.specs()
+        return specs
+
+    def __call__(self, params, input_ids, positions, kv_caches,
+                 metadata: InputMetadata):
+        hidden = self.embed_in(params["gpt_neox.embed_in"], input_ids)
+        new_caches: List[KVCache] = []
+        for i, layer in enumerate(self.layers):
+            cache = kv_caches[i] if kv_caches is not None else None
+            hidden, new_cache = layer(params, positions, hidden, cache,
+                                      metadata)
+            if new_cache is not None:
+                new_caches.append(new_cache)
+        ln = params["gpt_neox.final_layer_norm"]
+        hidden = layer_norm(hidden, ln["weight"], ln["bias"],
+                            self.config.layer_norm_eps)
+        return hidden, (new_caches if kv_caches is not None else None)
+
+    def compute_logits(self, params, hidden):
+        return self.embed_out.compute_logits(params["embed_out"], hidden)
+
+    def _deinterleave(self, tensor: np.ndarray) -> np.ndarray:
+        """HF layout [heads*3*dim, ...] per-head-interleaved -> [Q|K|V]."""
+        num_heads = self.config.num_attention_heads
+        head_dim = self.config.hidden_size // num_heads
+        rest = tensor.shape[1:]
+        t = tensor.reshape(num_heads, 3, head_dim, *rest)
+        t = np.concatenate([t[:, 0], t[:, 1], t[:, 2]], axis=0)
+        return t.reshape(num_heads * 3 * head_dim, *rest)
+
+    def load_weights(self, weights: Iterable[Tuple[str, np.ndarray]]):
+        loaders = {}
+        for layer in self.layers:
+            p = layer.prefix
+            loaders[f"{p}.attention.qkv_proj"] = layer.attention.qkv_proj
+            loaders[f"{p}.attention.dense"] = layer.attention.dense
+            loaders[f"{p}.mlp.dense_h_to_4h"] = layer.dense_h_to_4h
+            loaders[f"{p}.mlp.dense_4h_to_h"] = layer.dense_4h_to_h
+        params: Dict[str, Dict[str, np.ndarray]] = {}
+
+        def bucket(key):
+            return params.setdefault(key, {})
+
+        for name, tensor in weights:
+            if "rotary_emb" in name or "attention.bias" in name or \
+                    "attention.masked_bias" in name:
+                continue
+            if name == "gpt_neox.embed_in.weight":
+                self.embed_in.weight_loader(bucket("gpt_neox.embed_in"),
+                                            "weight", tensor)
+                continue
+            if name == "embed_out.weight":
+                self.embed_out.weight_loader(bucket("embed_out"),
+                                             "weight", tensor)
+                continue
+            if "layernorm" in name or "final_layer_norm" in name:
+                key, pname = name.rsplit(".", 1)
+                bucket(key)[pname] = tensor
+                continue
+            if "query_key_value" in name:
+                tensor = self._deinterleave(tensor)
+                key = name.replace("query_key_value", "qkv_proj")
+                key, pname = key.rsplit(".", 1)
+                loaders[key].weight_loader(bucket(key), pname, tensor)
+                continue
+            if name.endswith((".weight", ".bias")):
+                key, pname = name.rsplit(".", 1)
+                if key in loaders:
+                    loaders[key].weight_loader(bucket(key), pname, tensor)
+        return params

@@ -47,13 +47,16 @@ class LlamaAttention:
             hidden_size // self.num_heads
         self.prefix = layer_prefix
 
+        # Qwen2-style checkpoints bias only the QKV projection
+        # (config.qkv_bias); Llama's attention_bias biases both.
+        attention_bias = getattr(config, "attention_bias", False)
         self.qkv_proj = QKVParallelLinear(
             hidden_size, self.head_dim, self.num_heads, self.num_kv_heads,
-            bias=getattr(config, "attention_bias", False), dtype=dtype,
-            linear_method=linear_method)
+            bias=attention_bias or getattr(config, "qkv_bias", False),
+            dtype=dtype, linear_method=linear_method)
         self.o_proj = RowParallelLinear(
             self.num_heads * self.head_dim, hidden_size,
-            bias=getattr(config, "attention_bias", False), dtype=dtype,
+            bias=attention_bias, dtype=dtype,
             linear_method=linear_method)
         self.rotary = get_rope(
             self.head_dim, self.head_dim,

@@ -1,0 +1,203 @@
+"""Mixtral MoE (reference: `aphrodite/modeling/models/mixtral.py`,
+445 LoC — expert partitioning `:115-120`, all-reduce combine `:161`).
+
+Llama-style attention + FusedMoE FFN with top-2-of-8 routing; expert
+weights stacked and expert-axis sharded (see layers/fused_moe.py).
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from aphrodite_tpu.modeling.input_metadata import InputMetadata
+from aphrodite_tpu.modeling.layers.fused_moe import FusedMoE
+from aphrodite_tpu.modeling.layers.layernorm import (fused_add_rms_norm,
+                                                     rms_norm)
+from aphrodite_tpu.modeling.layers.linear import LinearMethod
+from aphrodite_tpu.modeling.models.llama import LlamaAttention
+from aphrodite_tpu.modeling.layers.vocab_embedding import (
+    ParallelLMHead, VocabParallelEmbedding)
+
+KVCache = Tuple[jax.Array, jax.Array]
+
+
+class MixtralDecoderLayer:
+
+    def __init__(self, config, idx: int, dtype, linear_method) -> None:
+        self.prefix = f"model.layers.{idx}"
+        self.rms_eps = config.rms_norm_eps
+        self.self_attn = LlamaAttention(config, self.prefix, dtype,
+                                        linear_method)
+        self.moe = FusedMoE(
+            num_experts=config.num_local_experts,
+            top_k=config.num_experts_per_tok,
+            hidden_size=config.hidden_size,
+            intermediate_size=config.intermediate_size,
+            renormalize=True, dtype=dtype)
+        self.dtype = dtype
+        self.hidden_size = config.hidden_size
+
+    def init(self):
+        p = {}
+        p.update(self.self_attn.init())
+        p[f"{self.prefix}.block_sparse_moe"] = self.moe.init()
+        ones = jnp.ones((self.hidden_size,), dtype=self.dtype)
+        p[f"{self.prefix}.input_layernorm"] = {"weight": ones}
+        p[f"{self.prefix}.post_attention_layernorm"] = {"weight": ones}
+        return p
+
+    def specs(self):
+        s = {}
+        s.update(self.self_attn.specs())
+        s[f"{self.prefix}.block_sparse_moe"] = self.moe.specs()
+        s[f"{self.prefix}.input_layernorm"] = {"weight": P(None)}
+        s[f"{self.prefix}.post_attention_layernorm"] = {"weight": P(None)}
+        return s
+
+    def __call__(self, params, positions, hidden, residual, kv_cache,
+                 metadata):
+        normed, residual = fused_add_rms_norm(
+            hidden, residual,
+            params[f"{self.prefix}.input_layernorm"]["weight"],
+            self.rms_eps)
+        attn_out, new_cache = self.self_attn(params, positions, normed,
+                                             kv_cache, metadata)
+        normed, residual = fused_add_rms_norm(
+            attn_out, residual,
+            params[f"{self.prefix}.post_attention_layernorm"]["weight"],
+            self.rms_eps)
+        moe_out = self.moe(params[f"{self.prefix}.block_sparse_moe"],
+                           normed)
+        return moe_out, residual, new_cache
+
+
+class MixtralForCausalLM:
+
+    def __init__(self, config, dtype: jnp.dtype = jnp.bfloat16,
+                 linear_method: Optional[LinearMethod] = None) -> None:
+        self.config = config
+        self.dtype = dtype
+        self.embed_tokens = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size, dtype=dtype)
+        self.layers = [
+            MixtralDecoderLayer(config, i, dtype, linear_method)
+            for i in range(config.num_hidden_layers)
+        ]
+        self.lm_head = ParallelLMHead(config.vocab_size,
+                                      config.hidden_size, dtype=dtype)
+        self.rms_eps = config.rms_norm_eps
+        self.tie_word_embeddings = getattr(config, "tie_word_embeddings",
+                                           False)
+
+    def init_params(self):
+        params = {"model.embed_tokens": self.embed_tokens.init()}
+        for layer in self.layers:
+            params.update(layer.init())
+        params["model.norm"] = {
+            "weight": jnp.ones((self.config.hidden_size,),
+                               dtype=self.dtype)}
+        if not self.tie_word_embeddings:
+            params["lm_head"] = self.lm_head.init()
+        return params
+
+    def param_specs(self):
+        specs = {"model.embed_tokens": self.embed_tokens.specs()}
+        for layer in self.layers:
+            specs.update(layer.specs())
+        specs["model.norm"] = {"weight": P(None)}
+        if not self.tie_word_embeddings:
+            specs["lm_head"] = self.lm_head.specs()
+        return specs
+
+    def __call__(self, params, input_ids, positions, kv_caches,
+                 metadata: InputMetadata):
+        hidden = self.embed_tokens(params["model.embed_tokens"],
+                                   input_ids)
+        residual = None
+        new_caches: List[KVCache] = []
+        for i, layer in enumerate(self.layers):
+            cache = kv_caches[i] if kv_caches is not None else None
+            hidden, residual, new_cache = layer(params, positions, hidden,
+                                                residual, cache, metadata)
+            if new_cache is not None:
+                new_caches.append(new_cache)
+        hidden = rms_norm(hidden + residual,
+                          params["model.norm"]["weight"], self.rms_eps)
+        return hidden, (new_caches if kv_caches is not None else None)
+
+    def compute_logits(self, params, hidden):
+        head = params["model.embed_tokens"] if self.tie_word_embeddings \
+            else params["lm_head"]
+        return self.lm_head.compute_logits(head, hidden)
+
+    _STACKED = [("q_proj", "qkv_proj", "q"), ("k_proj", "qkv_proj", "k"),
+                ("v_proj", "qkv_proj", "v")]
+    # HF expert tensor name -> stacked param name (w1=gate, w3=up,
+    # w2=down in Mixtral convention).
+    _EXPERT_MAP = {"w1": "w_gate", "w3": "w_up", "w2": "w_down"}
+
+    def load_weights(self, weights: Iterable[Tuple[str, np.ndarray]]):
+        loaders = {}
+        for layer in self.layers:
+            p = layer.prefix
+            loaders[f"{p}.self_attn.qkv_proj"] = layer.self_attn.qkv_proj
+            loaders[f"{p}.self_attn.o_proj"] = layer.self_attn.o_proj
+        moes = {layer.prefix: layer.moe for layer in self.layers}
+        params: Dict[str, Dict[str, np.ndarray]] = {}
+
+        def bucket(key):
+            return params.setdefault(key, {})
+
+        for name, tensor in weights:
+            if "rotary_emb.inv_freq" in name:
+                continue
+            if name.startswith("lm_head"):
+                if self.tie_word_embeddings:
+                    continue
+                self.lm_head.weight_loader(bucket("lm_head"), "weight",
+                                           tensor)
+                continue
+            if name == "model.embed_tokens.weight":
+                self.embed_tokens.weight_loader(
+                    bucket("model.embed_tokens"), "weight", tensor)
+                continue
+            if name == "model.norm.weight":
+                bucket("model.norm")["weight"] = tensor
+                continue
+            if name.endswith("_layernorm.weight"):
+                key, pname = name.rsplit(".", 1)
+                bucket(key)[pname] = tensor
+                continue
+            if ".block_sparse_moe." in name:
+                layer_prefix = name.split(".block_sparse_moe.")[0]
+                moe = moes[layer_prefix]
+                moe_bucket = bucket(f"{layer_prefix}.block_sparse_moe")
+                rest = name.split(".block_sparse_moe.")[1]
+                if rest == "gate.weight":
+                    moe.load_gate_weight(moe_bucket, tensor)
+                else:
+                    # experts.<id>.w{1,2,3}.weight
+                    parts = rest.split(".")
+                    expert_id = int(parts[1])
+                    which = self._EXPERT_MAP[parts[2]]
+                    moe.load_expert_weight(moe_bucket, which, expert_id,
+                                           tensor)
+                continue
+            for hf_frag, merged, shard_id in self._STACKED:
+                if f".{hf_frag}." in name:
+                    key = name.replace(hf_frag, merged)
+                    key, pname = key.rsplit(".", 1)
+                    loaders[key].weight_loader(bucket(key), pname, tensor,
+                                               shard_id)
+                    break
+            else:
+                if name.endswith((".weight", ".bias")):
+                    key, pname = name.rsplit(".", 1)
+                    if key in loaders:
+                        loaders[key].weight_loader(bucket(key), pname,
+                                                   tensor)
+        return params

@@ -1,0 +1,253 @@
+"""OPT family (reference: `aphrodite/modeling/models/opt.py`, 388 LoC).
+
+Learned positional embeddings with the OPT +2 offset, pre/post layernorm
+variants, ReLU MLP, tied LM head.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from aphrodite_tpu.modeling.input_metadata import InputMetadata
+from aphrodite_tpu.modeling.layers.activation import get_act_fn
+from aphrodite_tpu.modeling.layers.attention import PagedAttention
+from aphrodite_tpu.modeling.layers.layernorm import layer_norm
+from aphrodite_tpu.modeling.layers.linear import (ColumnParallelLinear,
+                                                  LinearMethod,
+                                                  QKVParallelLinear,
+                                                  RowParallelLinear)
+from aphrodite_tpu.modeling.layers.vocab_embedding import (
+    ParallelLMHead, VocabParallelEmbedding)
+
+KVCache = Tuple[jax.Array, jax.Array]
+
+
+class OPTAttention:
+
+    def __init__(self, config, prefix: str, dtype,
+                 linear_method: Optional[LinearMethod]) -> None:
+        self.prefix = prefix
+        hidden = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.head_dim = hidden // self.num_heads
+        self.qkv_proj = QKVParallelLinear(
+            hidden, self.head_dim, self.num_heads, bias=config.enable_bias,
+            dtype=dtype, linear_method=linear_method)
+        self.out_proj = RowParallelLinear(
+            hidden, hidden, bias=config.enable_bias, dtype=dtype,
+            linear_method=linear_method)
+        self.attn = PagedAttention(self.num_heads, self.head_dim,
+                                   scale=self.head_dim ** -0.5)
+
+    def init(self):
+        return {
+            f"{self.prefix}.qkv_proj": self.qkv_proj.init(),
+            f"{self.prefix}.out_proj": self.out_proj.init(),
+        }
+
+    def specs(self):
+        return {
+            f"{self.prefix}.qkv_proj": self.qkv_proj.specs(),
+            f"{self.prefix}.out_proj": self.out_proj.specs(),
+        }
+
+    def __call__(self, params, hidden, kv_cache, metadata):
+        qkv = self.qkv_proj(params[f"{self.prefix}.qkv_proj"], hidden)
+        q, k, v = self.qkv_proj.split(qkv)
+        k_pages, v_pages = kv_cache if kv_cache is not None else (None,
+                                                                 None)
+        out, k_pages, v_pages = self.attn(q, k, v, k_pages, v_pages,
+                                          metadata)
+        out = self.out_proj(params[f"{self.prefix}.out_proj"], out)
+        return out, (None if k_pages is None else (k_pages, v_pages))
+
+
+class OPTDecoderLayer:
+
+    def __init__(self, config, idx: int, dtype, linear_method) -> None:
+        self.prefix = f"model.decoder.layers.{idx}"
+        self.config = config
+        self.self_attn = OPTAttention(config, f"{self.prefix}.self_attn",
+                                      dtype, linear_method)
+        hidden = config.hidden_size
+        self.fc1 = ColumnParallelLinear(hidden, config.ffn_dim,
+                                        bias=config.enable_bias,
+                                        dtype=dtype,
+                                        linear_method=linear_method)
+        self.fc2 = RowParallelLinear(config.ffn_dim, hidden,
+                                     bias=config.enable_bias, dtype=dtype,
+                                     linear_method=linear_method)
+        self.act = get_act_fn(config.activation_function)
+        self.dtype = dtype
+        self.hidden = hidden
+
+    def _ln_params(self, hidden):
+        return {"weight": jnp.ones((hidden,), dtype=self.dtype),
+                "bias": jnp.zeros((hidden,), dtype=self.dtype)}
+
+    def init(self):
+        p = {}
+        p.update(self.self_attn.init())
+        p[f"{self.prefix}.fc1"] = self.fc1.init()
+        p[f"{self.prefix}.fc2"] = self.fc2.init()
+        p[f"{self.prefix}.self_attn_layer_norm"] = self._ln_params(
+            self.hidden)
+        p[f"{self.prefix}.final_layer_norm"] = self._ln_params(self.hidden)
+        return p
+
+    def specs(self):
+        s = {}
+        s.update(self.self_attn.specs())
+        s[f"{self.prefix}.fc1"] = self.fc1.specs()
+        s[f"{self.prefix}.fc2"] = self.fc2.specs()
+        ln = {"weight": P(None), "bias": P(None)}
+        s[f"{self.prefix}.self_attn_layer_norm"] = dict(ln)
+        s[f"{self.prefix}.final_layer_norm"] = dict(ln)
+        return s
+
+    def __call__(self, params, hidden, kv_cache, metadata):
+        do_before = self.config.do_layer_norm_before
+        residual = hidden
+        ln1 = params[f"{self.prefix}.self_attn_layer_norm"]
+        if do_before:
+            hidden = layer_norm(hidden, ln1["weight"], ln1["bias"])
+        attn_out, new_cache = self.self_attn(params, hidden, kv_cache,
+                                             metadata)
+        hidden = residual + attn_out
+        if not do_before:
+            hidden = layer_norm(hidden, ln1["weight"], ln1["bias"])
+
+        residual = hidden
+        ln2 = params[f"{self.prefix}.final_layer_norm"]
+        if do_before:
+            hidden = layer_norm(hidden, ln2["weight"], ln2["bias"])
+        hidden = self.fc1(params[f"{self.prefix}.fc1"], hidden)
+        hidden = self.act(hidden)
+        hidden = self.fc2(params[f"{self.prefix}.fc2"], hidden)
+        hidden = residual + hidden
+        if not do_before:
+            hidden = layer_norm(hidden, ln2["weight"], ln2["bias"])
+        return hidden, new_cache
+
+
+class OPTForCausalLM:
+    """OPT with learned positions (+2 offset, HF convention)."""
+
+    def __init__(self, config, dtype: jnp.dtype = jnp.bfloat16,
+                 linear_method: Optional[LinearMethod] = None) -> None:
+        self.config = config
+        self.dtype = dtype
+        self.embed_tokens = VocabParallelEmbedding(
+            config.vocab_size, config.word_embed_proj_dim, dtype=dtype)
+        self.layers = [
+            OPTDecoderLayer(config, i, dtype, linear_method)
+            for i in range(config.num_hidden_layers)
+        ]
+        self.lm_head = ParallelLMHead(config.vocab_size,
+                                      config.word_embed_proj_dim,
+                                      dtype=dtype)
+        # OPT ties lm_head to embed_tokens.
+        self.tie_word_embeddings = True
+
+    def init_params(self):
+        cfg = self.config
+        params = {"model.decoder.embed_tokens": self.embed_tokens.init()}
+        params["model.decoder.embed_positions"] = {
+            "weight": jnp.zeros(
+                (cfg.max_position_embeddings + 2, cfg.hidden_size),
+                dtype=self.dtype)
+        }
+        for layer in self.layers:
+            params.update(layer.init())
+        if cfg.do_layer_norm_before and not getattr(
+                cfg, "_remove_final_layer_norm", False):
+            params["model.decoder.final_layer_norm"] = {
+                "weight": jnp.ones((cfg.hidden_size,), dtype=self.dtype),
+                "bias": jnp.zeros((cfg.hidden_size,), dtype=self.dtype),
+            }
+        return params
+
+    def param_specs(self):
+        specs = {"model.decoder.embed_tokens": self.embed_tokens.specs()}
+        specs["model.decoder.embed_positions"] = {"weight": P(None, None)}
+        for layer in self.layers:
+            specs.update(layer.specs())
+        specs["model.decoder.final_layer_norm"] = {
+            "weight": P(None), "bias": P(None)}
+        return specs
+
+    def __call__(self, params, input_ids, positions, kv_caches,
+                 metadata: InputMetadata):
+        hidden = self.embed_tokens(params["model.decoder.embed_tokens"],
+                                   input_ids)
+        pos_emb = jnp.take(
+            params["model.decoder.embed_positions"]["weight"],
+            positions + 2, axis=0)
+        hidden = hidden + pos_emb
+        new_caches: List[KVCache] = []
+        for i, layer in enumerate(self.layers):
+            cache = kv_caches[i] if kv_caches is not None else None
+            hidden, new_cache = layer(params, hidden, cache, metadata)
+            if new_cache is not None:
+                new_caches.append(new_cache)
+        final_ln = params.get("model.decoder.final_layer_norm")
+        if final_ln is not None:
+            hidden = layer_norm(hidden, final_ln["weight"],
+                                final_ln["bias"])
+        return hidden, (new_caches if kv_caches is not None else None)
+
+    def compute_logits(self, params, hidden):
+        return self.lm_head.compute_logits(
+            params["model.decoder.embed_tokens"], hidden)
+
+    _STACKED = [("q_proj", "qkv_proj", "q"), ("k_proj", "qkv_proj", "k"),
+                ("v_proj", "qkv_proj", "v")]
+
+    def load_weights(self, weights: Iterable[Tuple[str, np.ndarray]]):
+        loaders = {}
+        for layer in self.layers:
+            p = layer.prefix
+            loaders[f"{p}.self_attn.qkv_proj"] = layer.self_attn.qkv_proj
+            loaders[f"{p}.self_attn.out_proj"] = layer.self_attn.out_proj
+            loaders[f"{p}.fc1"] = layer.fc1
+            loaders[f"{p}.fc2"] = layer.fc2
+        params: Dict[str, Dict[str, np.ndarray]] = {}
+
+        def bucket(key):
+            return params.setdefault(key, {})
+
+        for name, tensor in weights:
+            if name.startswith("lm_head"):
+                continue          # tied
+            # HF ships OPT under "model.decoder." or bare "decoder.".
+            if name.startswith("decoder."):
+                name = "model." + name
+            if "embed_tokens" in name:
+                self.embed_tokens.weight_loader(
+                    bucket("model.decoder.embed_tokens"), "weight", tensor)
+                continue
+            if "embed_positions" in name:
+                bucket("model.decoder.embed_positions")["weight"] = tensor
+                continue
+            if "layer_norm" in name or "final_layer_norm" in name:
+                key, pname = name.rsplit(".", 1)
+                bucket(key)[pname] = tensor
+                continue
+            for hf_frag, merged, shard_id in self._STACKED:
+                if f".{hf_frag}." in name:
+                    key = name.replace(hf_frag, merged)
+                    key, pname = key.rsplit(".", 1)
+                    loaders[key].weight_loader(bucket(key), pname, tensor,
+                                               shard_id)
+                    break
+            else:
+                if name.endswith((".weight", ".bias")):
+                    key, pname = name.rsplit(".", 1)
+                    if key in loaders:
+                        loaders[key].weight_loader(bucket(key), pname,
+                                                   tensor)
+        return params

@@ -1,0 +1,240 @@
+"""Phi-1.5 / Phi-2 (reference: `aphrodite/modeling/models/phi.py`,
+337 LoC). Parallel attention+MLP residual from one pre-LayerNorm,
+partial neox-style rotary (partial_rotary_factor), biased LM head.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from aphrodite_tpu.modeling.input_metadata import InputMetadata
+from aphrodite_tpu.modeling.layers.activation import get_act_fn
+from aphrodite_tpu.modeling.layers.attention import PagedAttention
+from aphrodite_tpu.modeling.layers.layernorm import layer_norm
+from aphrodite_tpu.modeling.layers.linear import (ColumnParallelLinear,
+                                                  LinearMethod,
+                                                  QKVParallelLinear,
+                                                  RowParallelLinear)
+from aphrodite_tpu.modeling.layers.rotary_embedding import get_rope
+from aphrodite_tpu.modeling.layers.vocab_embedding import (
+    ParallelLMHead, VocabParallelEmbedding)
+
+KVCache = Tuple[jax.Array, jax.Array]
+
+
+class PhiAttention:
+
+    def __init__(self, config, prefix: str, dtype,
+                 linear_method: Optional[LinearMethod]) -> None:
+        self.prefix = prefix
+        hidden = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.head_dim = hidden // self.num_heads
+        self.qkv_proj = QKVParallelLinear(
+            hidden, self.head_dim, self.num_heads, bias=True, dtype=dtype,
+            linear_method=linear_method)
+        self.dense = RowParallelLinear(hidden, hidden, bias=True,
+                                       dtype=dtype,
+                                       linear_method=linear_method)
+        rotary_dim = int(self.head_dim *
+                         getattr(config, "partial_rotary_factor", 0.5))
+        self.rotary = get_rope(
+            self.head_dim, rotary_dim,
+            max_position=config.max_position_embeddings,
+            base=getattr(config, "rope_theta", 10000.0),
+            is_neox_style=True)
+        self.attn = PagedAttention(self.num_heads, self.head_dim,
+                                   scale=self.head_dim ** -0.5)
+
+    def init(self):
+        return {f"{self.prefix}.qkv_proj": self.qkv_proj.init(),
+                f"{self.prefix}.dense": self.dense.init()}
+
+    def specs(self):
+        return {f"{self.prefix}.qkv_proj": self.qkv_proj.specs(),
+                f"{self.prefix}.dense": self.dense.specs()}
+
+    def __call__(self, params, positions, hidden, kv_cache, metadata):
+        qkv = self.qkv_proj(params[f"{self.prefix}.qkv_proj"], hidden)
+        q, k, v = self.qkv_proj.split(qkv)
+        b, s = q.shape[:2]
+        q = q.reshape(b, s, self.num_heads, self.head_dim)
+        k = k.reshape(b, s, self.num_heads, self.head_dim)
+        q, k = self.rotary(positions, q, k)
+        q = q.reshape(b, s, -1)
+        k = k.reshape(b, s, -1)
+        k_pages, v_pages = kv_cache if kv_cache is not None else (None,
+                                                                 None)
+        out, k_pages, v_pages = self.attn(q, k, v, k_pages, v_pages,
+                                          metadata)
+        out = self.dense(params[f"{self.prefix}.dense"], out)
+        return out, (None if k_pages is None else (k_pages, v_pages))
+
+
+class PhiLayer:
+
+    def __init__(self, config, idx: int, dtype, linear_method) -> None:
+        self.prefix = f"model.layers.{idx}"
+        self.self_attn = PhiAttention(config, f"{self.prefix}.self_attn",
+                                      dtype, linear_method)
+        hidden = config.hidden_size
+        self.fc1 = ColumnParallelLinear(hidden, config.intermediate_size,
+                                        bias=True, dtype=dtype,
+                                        linear_method=linear_method)
+        self.fc2 = RowParallelLinear(config.intermediate_size, hidden,
+                                     bias=True, dtype=dtype,
+                                     linear_method=linear_method)
+        self.act = get_act_fn(config.hidden_act)
+        self.dtype = dtype
+        self.hidden = hidden
+        self.eps = config.layer_norm_eps
+
+    def init(self):
+        p = {}
+        p.update(self.self_attn.init())
+        p[f"{self.prefix}.mlp.fc1"] = self.fc1.init()
+        p[f"{self.prefix}.mlp.fc2"] = self.fc2.init()
+        p[f"{self.prefix}.input_layernorm"] = {
+            "weight": jnp.ones((self.hidden,), dtype=self.dtype),
+            "bias": jnp.zeros((self.hidden,), dtype=self.dtype)}
+        return p
+
+    def specs(self):
+        s = {}
+        s.update(self.self_attn.specs())
+        s[f"{self.prefix}.mlp.fc1"] = self.fc1.specs()
+        s[f"{self.prefix}.mlp.fc2"] = self.fc2.specs()
+        s[f"{self.prefix}.input_layernorm"] = {"weight": P(None),
+                                               "bias": P(None)}
+        return s
+
+    def __call__(self, params, positions, hidden, kv_cache, metadata):
+        ln = params[f"{self.prefix}.input_layernorm"]
+        normed = layer_norm(hidden, ln["weight"], ln["bias"], self.eps)
+        attn_out, new_cache = self.self_attn(params, positions, normed,
+                                             kv_cache, metadata)
+        mlp_out = self.fc2(params[f"{self.prefix}.mlp.fc2"],
+                           self.act(self.fc1(
+                               params[f"{self.prefix}.mlp.fc1"], normed)))
+        return hidden + attn_out + mlp_out, new_cache
+
+
+class PhiForCausalLM:
+
+    def __init__(self, config, dtype: jnp.dtype = jnp.bfloat16,
+                 linear_method: Optional[LinearMethod] = None) -> None:
+        self.config = config
+        self.dtype = dtype
+        self.embed_tokens = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size, dtype=dtype)
+        self.layers = [
+            PhiLayer(config, i, dtype, linear_method)
+            for i in range(config.num_hidden_layers)
+        ]
+        self.lm_head = ParallelLMHead(config.vocab_size,
+                                      config.hidden_size, dtype=dtype)
+        self.tie_word_embeddings = False
+
+    def init_params(self):
+        cfg = self.config
+        params = {"model.embed_tokens": self.embed_tokens.init()}
+        for layer in self.layers:
+            params.update(layer.init())
+        params["model.final_layernorm"] = {
+            "weight": jnp.ones((cfg.hidden_size,), dtype=self.dtype),
+            "bias": jnp.zeros((cfg.hidden_size,), dtype=self.dtype)}
+        head = self.lm_head.init()
+        head["bias"] = jnp.zeros((self.lm_head.num_embeddings_padded,),
+                                 dtype=self.dtype)
+        params["lm_head"] = head
+        return params
+
+    def param_specs(self):
+        specs = {"model.embed_tokens": self.embed_tokens.specs()}
+        for layer in self.layers:
+            specs.update(layer.specs())
+        specs["model.final_layernorm"] = {"weight": P(None),
+                                          "bias": P(None)}
+        head = self.lm_head.specs()
+        head["bias"] = P("tp")
+        specs["lm_head"] = head
+        return specs
+
+    def __call__(self, params, input_ids, positions, kv_caches,
+                 metadata: InputMetadata):
+        hidden = self.embed_tokens(params["model.embed_tokens"],
+                                   input_ids)
+        new_caches: List[KVCache] = []
+        for i, layer in enumerate(self.layers):
+            cache = kv_caches[i] if kv_caches is not None else None
+            hidden, new_cache = layer(params, positions, hidden, cache,
+                                      metadata)
+            if new_cache is not None:
+                new_caches.append(new_cache)
+        ln = params["model.final_layernorm"]
+        hidden = layer_norm(hidden, ln["weight"], ln["bias"],
+                            self.config.layer_norm_eps)
+        return hidden, (new_caches if kv_caches is not None else None)
+
+    def compute_logits(self, params, hidden):
+        logits = self.lm_head.compute_logits(params["lm_head"], hidden)
+        bias = params["lm_head"].get("bias")
+        if bias is not None:
+            logits = logits + bias[:self.lm_head.org_vocab_size]
+        return logits
+
+    _STACKED = [("q_proj", "qkv_proj", "q"), ("k_proj", "qkv_proj", "k"),
+                ("v_proj", "qkv_proj", "v")]
+
+    def load_weights(self, weights: Iterable[Tuple[str, np.ndarray]]):
+        loaders = {}
+        for layer in self.layers:
+            p = layer.prefix
+            loaders[f"{p}.self_attn.qkv_proj"] = layer.self_attn.qkv_proj
+            loaders[f"{p}.self_attn.dense"] = layer.self_attn.dense
+            loaders[f"{p}.mlp.fc1"] = layer.fc1
+            loaders[f"{p}.mlp.fc2"] = layer.fc2
+        params: Dict[str, Dict[str, np.ndarray]] = {}
+
+        def bucket(key):
+            return params.setdefault(key, {})
+
+        for name, tensor in weights:
+            if "rotary_emb" in name:
+                continue
+            if name == "model.embed_tokens.weight":
+                self.embed_tokens.weight_loader(
+                    bucket("model.embed_tokens"), "weight", tensor)
+                continue
+            if name == "lm_head.weight":
+                self.lm_head.weight_loader(bucket("lm_head"), "weight",
+                                           tensor)
+                continue
+            if name == "lm_head.bias":
+                padded = np.zeros((self.lm_head.num_embeddings_padded,),
+                                  dtype=tensor.dtype)
+                padded[:tensor.shape[0]] = tensor
+                bucket("lm_head")["bias"] = padded
+                continue
+            if "layernorm" in name:
+                key, pname = name.rsplit(".", 1)
+                bucket(key)[pname] = tensor
+                continue
+            for hf_frag, merged, shard_id in self._STACKED:
+                if f".{hf_frag}." in name:
+                    key = name.replace(hf_frag, merged)
+                    key, pname = key.rsplit(".", 1)
+                    loaders[key].weight_loader(bucket(key), pname, tensor,
+                                               shard_id)
+                    break
+            else:
+                if name.endswith((".weight", ".bias")):
+                    key, pname = name.rsplit(".", 1)
+                    if key in loaders:
+                        loaders[key].weight_loader(bucket(key), pname,
+                                                   tensor)
+        return params

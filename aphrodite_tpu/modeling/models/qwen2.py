@@ -1,0 +1,17 @@
+"""Qwen2 family: Llama architecture + biased QKV projections
+(HF Qwen2 ships q/k/v biases, no o_proj bias) and optional tied
+embeddings for the small checkpoints."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from aphrodite_tpu.modeling.layers.linear import LinearMethod
+from aphrodite_tpu.modeling.models.llama import LlamaForCausalLM
+
+
+class Qwen2ForCausalLM(LlamaForCausalLM):
+
+    def __init__(self, config, dtype: jnp.dtype = jnp.bfloat16,
+                 linear_method: LinearMethod = None) -> None:
+        config.qkv_bias = True
+        super().__init__(config, dtype=dtype, linear_method=linear_method)
