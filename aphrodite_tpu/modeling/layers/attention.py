@@ -120,9 +120,11 @@ class PagedAttention:
         # window and block tables wrap (reference model_runner.py:278-293),
         # so the kernels need no window logic in decode.
         # Mosaic tiling: DMA slice last dim must be 128-aligned, so small
-        # heads (e.g. 64) take the XLA gather path for now.
+        # heads (e.g. 64) take the XLA gather path for now; quantized
+        # (fp8) pages also use the XLA path pending a quantized kernel.
         if self.use_pallas and jax.default_backend() == "tpu" and \
-                self.alibi_slopes is None and self.head_size % 128 == 0:
+                self.alibi_slopes is None and self.head_size % 128 == 0 \
+                and k_pages.dtype in (jnp.bfloat16, jnp.float32):
             from aphrodite_tpu.ops.pallas.paged_attention import (
                 paged_decode_attention)
             out = paged_decode_attention(

@@ -70,10 +70,18 @@ class LinearMethod:
     def load_weight(self, params: ParamDict, name: str,
                     hf_tensor: np.ndarray) -> np.ndarray:
         """Convert one HF checkpoint tensor to this method's layout.
-        For dense weights: torch [out, in] -> [in, out]."""
+        For dense weights: torch [out, in] -> [in, out]. May set
+        self.pending_sidecar = {pname: array} for derived params
+        (e.g. int8 scales) placed alongside the converted tensor."""
         if name == "weight":
             return np.ascontiguousarray(hf_tensor.T)
         return hf_tensor
+
+    def out_scale(self, name: str) -> int:
+        """Divisor applied to output-dim offsets/sizes when placing this
+        param into a merged layer (packed quant formats pack several
+        output channels per int32)."""
+        return 1
 
 
 class LinearBase:
@@ -108,6 +116,10 @@ class LinearBase:
                       shard_id=None) -> None:
         params[name] = self.linear_method.load_weight(params, name,
                                                       hf_tensor)
+        sidecar = getattr(self.linear_method, "pending_sidecar", None)
+        if sidecar:
+            params.update(sidecar)
+            self.linear_method.pending_sidecar = None
 
 
 class ReplicatedLinear(LinearBase):
@@ -128,15 +140,44 @@ class RowParallelLinear(LinearBase):
 class _ShardedLoadMixin(LinearBase):
     """Shared placement of an HF shard into a slice of a merged param."""
 
+    # Param names whose last dim is the (packed) OUTPUT dim. Anything
+    # else ("bias", "scales", 1-D) also slices on its last dim; "g_idx"
+    # spans the input dim and is shard-invariant.
+    _OUT_DIM_2D = ("weight", "qweight", "qzeros", "scales",
+                   "lookup_table")
+
     def _write_shard(self, params: Dict[str, np.ndarray], name: str,
                      converted: np.ndarray, offset: int,
                      size: int) -> None:
+        if name == "g_idx":
+            params[name] = converted
+            return
+        div = self.linear_method.out_scale(name)
+        offset //= div
+        size //= div
+        if name == "lookup_table":
+            # [out, 16]: output dim is FIRST.
+            if name not in params:
+                params[name] = np.zeros(
+                    (self.out_features,) + converted.shape[1:],
+                    dtype=converted.dtype)
+            params[name][offset:offset + size] = converted
+            return
         if name not in params:
-            full_shape = (converted.shape[:-1] +
-                          (self.out_features,)) if name == "weight" else \
-                (self.out_features,)
+            full_shape = converted.shape[:-1] + \
+                (self.out_features // div,)
             params[name] = np.zeros(full_shape, dtype=converted.dtype)
         params[name][..., offset:offset + size] = converted
+
+    def _write_with_sidecar(self, params: Dict[str, np.ndarray],
+                            name: str, converted: np.ndarray, offset: int,
+                            size: int) -> None:
+        self._write_shard(params, name, converted, offset, size)
+        sidecar = getattr(self.linear_method, "pending_sidecar", None)
+        if sidecar:
+            for pname, arr in sidecar.items():
+                self._write_shard(params, pname, arr, offset, size)
+            self.linear_method.pending_sidecar = None
 
 
 class MergedColumnParallelLinear(_ShardedLoadMixin, ColumnParallelLinear):
@@ -155,8 +196,8 @@ class MergedColumnParallelLinear(_ShardedLoadMixin, ColumnParallelLinear):
             params[name] = converted
             return
         offset = sum(self.output_sizes[:shard_id])
-        self._write_shard(params, name, converted,
-                          offset, self.output_sizes[shard_id])
+        self._write_with_sidecar(params, name, converted,
+                                 offset, self.output_sizes[shard_id])
 
 
 class QKVParallelLinear(_ShardedLoadMixin, ColumnParallelLinear):
@@ -191,4 +232,4 @@ class QKVParallelLinear(_ShardedLoadMixin, ColumnParallelLinear):
             params[name] = converted
             return
         offset, size = self.shard_offsets()[shard_id]
-        self._write_shard(params, name, converted, offset, size)
+        self._write_with_sidecar(params, name, converted, offset, size)

@@ -1,0 +1,46 @@
+"""Quantization plug-in registry.
+
+Reference: `aphrodite/modeling/layers/quantization/__init__.py:10-16`
+({awq,gguf,gptq,quip,squeezellm} registry) and `base_config.py`.
+
+TPU-first: all int4/int8 methods run as unpack/dequant-to-bf16 in jnp
+feeding the MXU matmul (XLA fuses the dequant chain into the GEMM
+prologue); there is no CUDA bit-trick ecosystem to port
+(SURVEY.md §7 "dequant-to-bf16-then-matmul is the safe baseline").
+int8 is the TPU-native fast path (native int8 MXU matmuls).
+"""
+from __future__ import annotations
+
+from typing import Type
+
+from aphrodite_tpu.modeling.layers.quantization.awq import AWQConfig
+from aphrodite_tpu.modeling.layers.quantization.base_config import (
+    QuantizationConfig)
+from aphrodite_tpu.modeling.layers.quantization.gptq import GPTQConfig
+from aphrodite_tpu.modeling.layers.quantization.int8 import Int8Config
+from aphrodite_tpu.modeling.layers.quantization.squeezellm import (
+    SqueezeLLMConfig)
+
+_QUANTIZATION_CONFIG_REGISTRY = {
+    "awq": AWQConfig,
+    "gptq": GPTQConfig,
+    "squeezellm": SqueezeLLMConfig,
+    "int8": Int8Config,
+}
+
+
+def get_quantization_config_cls(name: str) -> Type[QuantizationConfig]:
+    if name not in _QUANTIZATION_CONFIG_REGISTRY:
+        raise ValueError(f"Invalid quantization method: {name}")
+    return _QUANTIZATION_CONFIG_REGISTRY[name]
+
+
+def get_quantization_config(model_config) -> QuantizationConfig:
+    """Build the quant config from the HF quantization_config dict
+    (reference `loader.py:43-62`)."""
+    cls = get_quantization_config_cls(model_config.quantization)
+    hf_quant_config = getattr(model_config.hf_config,
+                              "quantization_config", None)
+    if hf_quant_config is not None:
+        return cls.from_config(dict(hf_quant_config))
+    return cls.default()

@@ -1,0 +1,86 @@
+"""int8 weight-only quantization — the TPU-native fast path.
+
+Not in the reference (its int8 story is CUDA-specific); on TPU the MXU
+multiplies int8 natively, so per-channel absmax int8 weights halve HBM
+traffic vs bf16 with near-lossless accuracy. Quantization happens at
+load time from any fp checkpoint (no special checkpoint format needed).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from aphrodite_tpu.modeling.layers.linear import LinearMethod
+from aphrodite_tpu.modeling.layers.quantization.base_config import (
+    QuantizationConfig)
+
+
+class Int8Config(QuantizationConfig):
+
+    @classmethod
+    def get_name(cls) -> str:
+        return "int8"
+
+    @classmethod
+    def from_config(cls, config: Dict[str, Any]) -> "Int8Config":
+        return cls()
+
+    def get_linear_method(self) -> "Int8LinearMethod":
+        return Int8LinearMethod(self)
+
+
+class Int8LinearMethod(LinearMethod):
+
+    def __init__(self, config: Int8Config) -> None:
+        self.config = config
+
+    def create_weights(self, in_features, out_features, dtype, bias,
+                       out_axis, in_axis):
+        params = {
+            "weight": jnp.zeros((in_features, out_features),
+                                dtype=jnp.int8),
+            "scales": jnp.zeros((out_features,), dtype=jnp.float32),
+        }
+        if bias:
+            params["bias"] = jnp.zeros((out_features,), dtype=dtype)
+        return params
+
+    def create_specs(self, bias, out_axis, in_axis):
+        specs = {
+            "weight": P(in_axis, out_axis),
+            "scales": P(out_axis),
+        }
+        if bias:
+            specs["bias"] = P(out_axis)
+        return specs
+
+    def apply(self, params: Dict[str, jax.Array],
+              x: jax.Array) -> jax.Array:
+        # int8 weights upcast in the GEMM prologue; scales applied on the
+        # output channel.
+        w = params["weight"].astype(x.dtype)
+        y = (x @ w) * params["scales"].astype(x.dtype)
+        if "bias" in params:
+            y = y + params["bias"]
+        return y
+
+    def load_weight(self, params, name: str,
+                    hf_tensor: np.ndarray) -> np.ndarray:
+        """fp checkpoint tensor -> int8 + scales on the fly."""
+        if name != "weight":
+            return hf_tensor
+        w = np.ascontiguousarray(hf_tensor.T).astype(np.float32)
+        scales = np.abs(w).max(axis=0) / 127.0
+        scales = np.where(scales == 0, 1.0, scales)
+        q = np.clip(np.round(w / scales), -128, 127).astype(np.int8)
+        # Placed by the caller next to the weight (merged layers slice
+        # it with the same output offsets).
+        self.pending_sidecar = {"scales": scales.astype(np.float32)}
+        return q
+
+    def out_scale(self, name: str) -> int:
+        return 1

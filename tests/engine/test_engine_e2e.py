@@ -113,3 +113,17 @@ def test_detokenized_text_nonempty(tiny_llm):
     out = tiny_llm.generate(["the quick brown"], sp)
     assert isinstance(out[0].outputs[0].text, str)
     assert len(out[0].outputs[0].text) > 0
+
+
+def test_fp8_kv_cache(tiny_model_dir):
+    """fp8-e5m2 KV cache halves KV bytes; greedy output should stay
+    close to full-precision (same argmax on a short run here)."""
+    from aphrodite_tpu.endpoints.llm import LLM
+    llm = LLM(model=tiny_model_dir, load_format="dummy", dtype="float32",
+              kv_cache_dtype="fp8", block_size=16, max_model_len=256,
+              max_num_seqs=4, swap_space=0.01)
+    out = llm.generate(
+        ["the quick brown"],
+        SamplingParams(temperature=0.0, max_tokens=5, ignore_eos=True))
+    assert out[0].finished
+    assert len(out[0].outputs[0].token_ids) == 5

@@ -1,0 +1,228 @@
+"""Quantization linear-method tests: pack with the checkpoint
+conventions (AutoGPTQ / llm-awq / SqueezeLLM), dequantize through our
+methods, and check matmul accuracy vs the fp reference (reference
+strategy: `tests/kernels` vs pure-torch references)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from aphrodite_tpu.modeling.layers.quantization.awq import (AWQ_ORDER,
+                                                            AWQConfig)
+from aphrodite_tpu.modeling.layers.quantization.gptq import GPTQConfig
+from aphrodite_tpu.modeling.layers.quantization.int8 import Int8Config
+from aphrodite_tpu.modeling.layers.quantization.squeezellm import (
+    SqueezeLLMConfig)
+
+IN, OUT, GROUP = 64, 96, 32
+rng = np.random.RandomState(0)
+
+
+def quantize_ref(w, group_size, bits=4):
+    """Asymmetric per-group quantization of w [in, out] along input."""
+    qmax = (1 << bits) - 1
+    groups = IN // group_size
+    q = np.zeros_like(w, dtype=np.int32)
+    scales = np.zeros((groups, OUT), dtype=np.float32)
+    zeros = np.zeros((groups, OUT), dtype=np.int32)
+    for g in range(groups):
+        block = w[g * group_size:(g + 1) * group_size]
+        wmin, wmax = block.min(0), block.max(0)
+        s = np.maximum((wmax - wmin) / qmax, 1e-8)
+        z = np.clip(np.round(-wmin / s), 0, qmax).astype(np.int32)
+        q[g * group_size:(g + 1) * group_size] = np.clip(
+            np.round(block / s) + z, 0, qmax)
+        scales[g], zeros[g] = s, z
+    return q, scales, zeros
+
+
+def pack_rows(q, bits=4):
+    """AutoGPTQ qweight packing: 32//bits values per int32 along IN."""
+    pack = 32 // bits
+    out = np.zeros((q.shape[0] // pack, q.shape[1]), dtype=np.uint32)
+    for i in range(q.shape[0]):
+        out[i // pack] |= q[i].astype(np.uint32) << (bits * (i % pack))
+    return out.astype(np.int32)
+
+
+def pack_cols(q, bits=4):
+    pack = 32 // bits
+    out = np.zeros((q.shape[0], q.shape[1] // pack), dtype=np.uint32)
+    for j in range(q.shape[1]):
+        out[:, j // pack] |= q[:, j].astype(np.uint32) << (bits *
+                                                           (j % pack))
+    return out.astype(np.int32)
+
+
+def pack_awq(q):
+    """llm-awq packing: element e at nibble AWQ_ORDER[e], along OUT."""
+    out = np.zeros((q.shape[0], q.shape[1] // 8), dtype=np.uint32)
+    for j in range(q.shape[1]):
+        e = j % 8
+        out[:, j // 8] |= q[:, j].astype(np.uint32) << (4 * AWQ_ORDER[e])
+    return out.astype(np.int32)
+
+
+def test_gptq_dequant_matches_fp():
+    w = rng.randn(IN, OUT).astype(np.float32)
+    q, scales, zeros = quantize_ref(w, GROUP)
+    params = {
+        # AutoGPTQ stores zeros - 1.
+        "qweight": jnp.asarray(pack_rows(q)),
+        "qzeros": jnp.asarray(pack_cols(zeros - 1)),
+        "scales": jnp.asarray(scales),
+        "g_idx": jnp.asarray(np.arange(IN, dtype=np.int32) // GROUP),
+    }
+    method = GPTQConfig(4, GROUP).get_linear_method()
+    w_hat = np.asarray(method.dequantize(params, jnp.float32))
+    # Quantization error bound: half a step per group.
+    step = scales[np.arange(IN) // GROUP]
+    assert np.all(np.abs(w_hat - w) <= step * 0.75 + 1e-6)
+
+    x = rng.randn(4, IN).astype(np.float32)
+    y = np.asarray(method.apply(params, jnp.asarray(x)))
+    np.testing.assert_allclose(y, x @ w_hat, rtol=1e-4, atol=1e-4)
+
+
+def test_gptq_act_order_g_idx():
+    """Shuffled g_idx (act-order) must be honored."""
+    w = rng.randn(IN, OUT).astype(np.float32)
+    q, scales, zeros = quantize_ref(w, GROUP)
+    perm = rng.permutation(IN)
+    params = {
+        "qweight": jnp.asarray(pack_rows(q[perm])),
+        "qzeros": jnp.asarray(pack_cols(zeros - 1)),
+        "scales": jnp.asarray(scales),
+        "g_idx": jnp.asarray((perm // GROUP).astype(np.int32)),
+    }
+    method = GPTQConfig(4, GROUP, desc_act=True).get_linear_method()
+    w_hat = np.asarray(method.dequantize(params, jnp.float32))
+    step = scales[perm // GROUP]
+    assert np.all(np.abs(w_hat - w[perm]) <= step * 0.75 + 1e-6)
+
+
+def test_awq_dequant_matches_fp():
+    w = rng.randn(IN, OUT).astype(np.float32)
+    q, scales, zeros = quantize_ref(w, GROUP)
+    params = {
+        "qweight": jnp.asarray(pack_awq(q)),
+        "qzeros": jnp.asarray(pack_awq(zeros)),
+        "scales": jnp.asarray(scales),
+    }
+    method = AWQConfig(4, GROUP).get_linear_method()
+    w_hat = np.asarray(method.dequantize(params, jnp.float32))
+    step = scales[np.arange(IN) // GROUP]
+    assert np.all(np.abs(w_hat - w) <= step * 0.75 + 1e-6)
+
+
+def test_squeezellm_lut_dequant():
+    lut = rng.randn(OUT, 16).astype(np.float32)
+    q = rng.randint(0, 16, size=(IN, OUT))
+    params = {
+        "qweight": jnp.asarray(pack_rows(q)),
+        "lookup_table": jnp.asarray(lut),
+    }
+    method = SqueezeLLMConfig().get_linear_method()
+    w_hat = np.asarray(method.dequantize(params, jnp.float32))
+    expected = lut[np.arange(OUT)[None, :], q]
+    np.testing.assert_allclose(w_hat, expected, rtol=1e-6)
+
+
+def test_int8_load_and_apply():
+    method = Int8Config().get_linear_method()
+    w_hf = rng.randn(OUT, IN).astype(np.float32)   # HF layout [out, in]
+    params_np = {}
+    q = method.load_weight(params_np, "weight", w_hf)
+    params = {"weight": jnp.asarray(q),
+              "scales": jnp.asarray(method.pending_sidecar["scales"])}
+    x = rng.randn(4, IN).astype(np.float32)
+    y = np.asarray(method.apply(params, jnp.asarray(x)))
+    y_ref = x @ w_hf.T
+    # int8 per-channel: ~0.5% relative error on random gaussians.
+    rel = np.abs(y - y_ref) / (np.abs(y_ref) + 1.0)
+    assert rel.mean() < 0.01
+
+
+def test_quantized_llama_end_to_end():
+    """Full Llama with int8 linear method approximates the fp model."""
+    import jax
+    from aphrodite_tpu.modeling.input_metadata import InputMetadata
+    from aphrodite_tpu.modeling.models.llama import LlamaForCausalLM
+    from aphrodite_tpu.modeling.layers.quantization.int8 import (
+        Int8LinearMethod)
+
+    class Cfg:
+        architectures = ["LlamaForCausalLM"]
+        vocab_size = 128
+        hidden_size = 64
+        intermediate_size = 128
+        num_hidden_layers = 2
+        num_attention_heads = 4
+        num_key_value_heads = 2
+        rms_norm_eps = 1e-6
+        max_position_embeddings = 128
+        rope_theta = 10000.0
+        tie_word_embeddings = False
+
+    # Build an fp model, fabricate HF-style weights, load into both fp
+    # and int8 models via load_weights.
+    fp_model = LlamaForCausalLM(Cfg(), dtype=jnp.float32)
+    rs = np.random.RandomState(1)
+
+    def fake_hf_weights():
+        h, inter, v = 64, 128, 128
+        for i in range(2):
+            pre = f"model.layers.{i}"
+            yield f"{pre}.self_attn.q_proj.weight", \
+                rs.randn(h, h).astype(np.float32) * 0.05
+            yield f"{pre}.self_attn.k_proj.weight", \
+                rs.randn(h // 2, h).astype(np.float32) * 0.05
+            yield f"{pre}.self_attn.v_proj.weight", \
+                rs.randn(h // 2, h).astype(np.float32) * 0.05
+            yield f"{pre}.self_attn.o_proj.weight", \
+                rs.randn(h, h).astype(np.float32) * 0.05
+            yield f"{pre}.mlp.gate_proj.weight", \
+                rs.randn(inter, h).astype(np.float32) * 0.05
+            yield f"{pre}.mlp.up_proj.weight", \
+                rs.randn(inter, h).astype(np.float32) * 0.05
+            yield f"{pre}.mlp.down_proj.weight", \
+                rs.randn(h, inter).astype(np.float32) * 0.05
+            yield f"{pre}.input_layernorm.weight", \
+                np.ones(h, dtype=np.float32)
+            yield f"{pre}.post_attention_layernorm.weight", \
+                np.ones(h, dtype=np.float32)
+        yield "model.embed_tokens.weight", \
+            rs.randn(v, h).astype(np.float32) * 0.05
+        yield "model.norm.weight", np.ones(h, dtype=np.float32)
+        yield "lm_head.weight", rs.randn(v, h).astype(np.float32) * 0.05
+
+    weights = list(fake_hf_weights())
+    fp_params = fp_model.load_weights(iter(weights))
+    q_model = LlamaForCausalLM(Cfg(), dtype=jnp.float32,
+                               linear_method=Int8LinearMethod(
+                                   Int8Config()))
+    q_params = q_model.load_weights(iter(weights))
+
+    def to_jnp(tree):
+        return {k: {n: jnp.asarray(a) for n, a in b.items()}
+                for k, b in tree.items()}
+
+    ids = jnp.asarray([[3, 17, 42, 9]], dtype=jnp.int32)
+    pos = jnp.arange(4, dtype=jnp.int32)[None]
+    meta = InputMetadata(
+        slot_mapping=jnp.full((4,), 10**6, jnp.int32),
+        block_tables=jnp.full((1, 1), 10**4, jnp.int32),
+        context_lens=jnp.zeros((1,), jnp.int32),
+        prompt_lens=jnp.full((1,), 4, jnp.int32),
+        is_prompt=True)
+
+    fp_hidden, _ = fp_model(to_jnp(fp_params), ids, pos, None, meta)
+    fp_logits = np.asarray(fp_model.compute_logits(to_jnp(fp_params),
+                                                   fp_hidden))
+    q_hidden, _ = q_model(to_jnp(q_params), ids, pos, None, meta)
+    q_logits = np.asarray(q_model.compute_logits(to_jnp(q_params),
+                                                 q_hidden))
+    # int8 per-channel keeps logits close; argmax must agree.
+    assert np.abs(q_logits - fp_logits).mean() < 0.05
+    np.testing.assert_array_equal(q_logits.argmax(-1),
+                                  fp_logits.argmax(-1))
