@@ -98,10 +98,12 @@ class Sequence:
         prompt: str,
         prompt_token_ids: List[int],
         block_size: int,
+        lora_request=None,
     ) -> None:
         self.seq_id = seq_id
         self.prompt = prompt
         self.block_size = block_size
+        self.lora_request = lora_request
 
         self.data = SequenceData(prompt_token_ids)
         self.output_logprobs: SampleLogprobs = []
@@ -124,7 +126,7 @@ class Sequence:
 
     @property
     def lora_int_id(self) -> int:
-        return 0
+        return self.lora_request.lora_int_id if self.lora_request else 0
 
     def _append_logical_block(self) -> None:
         block = LogicalTokenBlock(
@@ -210,12 +212,14 @@ class SequenceGroup:
         sampling_params: SamplingParams,
         arrival_time: float,
         prefix: Optional[Prefix] = None,
+        lora_request=None,
     ) -> None:
         self.request_id = request_id
         self.seqs_dict = {seq.seq_id: seq for seq in seqs}
         self.sampling_params = sampling_params
         self.arrival_time = arrival_time
         self.prefix = prefix
+        self.lora_request = lora_request
         self.prompt_logprobs: Optional[PromptLogprobs] = None
 
     @property
@@ -228,7 +232,7 @@ class SequenceGroup:
 
     @property
     def lora_int_id(self) -> int:
-        return 0
+        return self.lora_request.lora_int_id if self.lora_request else 0
 
     def get_max_num_running_seqs(self) -> int:
         """Max number of sequences running in parallel, now or in future."""
@@ -300,6 +304,7 @@ class SequenceGroupMetadata:
         block_tables: Dict[int, List[int]],
         persistent_data: Dict[int, dict],
         prefix: Optional[Prefix] = None,
+        lora_request=None,
     ) -> None:
         self.request_id = request_id
         self.is_prompt = is_prompt
@@ -308,6 +313,11 @@ class SequenceGroupMetadata:
         self.block_tables = block_tables
         self.persistent_data = persistent_data
         self.prefix = prefix
+        self.lora_request = lora_request
+
+    @property
+    def lora_int_id(self) -> int:
+        return self.lora_request.lora_int_id if self.lora_request else 0
 
 
 class SequenceOutput:

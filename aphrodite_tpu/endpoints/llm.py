@@ -71,6 +71,7 @@ class LLM:
         prompt_token_ids: Optional[List[List[int]]] = None,
         prefix_pos: Optional[Union[int, List[int]]] = None,
         use_tqdm: bool = False,
+        lora_request=None,
     ) -> List[RequestOutput]:
         """Generate completions for the prompts, batched through the
         continuous-batching engine (reference generate :118-178)."""
@@ -94,14 +95,16 @@ class LLM:
                 prompt_token_ids[i]
             pos = prefix_pos[i] if isinstance(prefix_pos, list) else \
                 prefix_pos
-            self._add_request(prompt, sampling_params, token_ids, pos)
+            self._add_request(prompt, sampling_params, token_ids, pos,
+                              lora_request)
         return self._run_engine(use_tqdm)
 
     def _add_request(self, prompt, sampling_params, prompt_token_ids,
-                     prefix_pos) -> None:
+                     prefix_pos, lora_request=None) -> None:
         request_id = str(next(self.request_counter))
         self.engine.add_request(request_id, prompt, sampling_params,
-                                prompt_token_ids, prefix_pos=prefix_pos)
+                                prompt_token_ids, prefix_pos=prefix_pos,
+                                lora_request=lora_request)
 
     def _run_engine(self, use_tqdm: bool) -> List[RequestOutput]:
         pbar = None

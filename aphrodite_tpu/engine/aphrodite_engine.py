@@ -77,7 +77,7 @@ class AphroditeEngine:
 
         self.executor = TPUExecutor(model_config, cache_config,
                                     parallel_config, scheduler_config,
-                                    device_config)
+                                    device_config, lora_config)
         self.scheduler = Scheduler(scheduler_config, cache_config,
                                    lora_config)
         self.stat_logger = StatLogger(
@@ -115,9 +115,12 @@ class AphroditeEngine:
         prompt_token_ids: Optional[List[int]] = None,
         arrival_time: Optional[float] = None,
         prefix_pos: Optional[int] = None,
+        lora_request=None,
     ) -> None:
         """Tokenize, build the seq group, hand to the scheduler
         (reference add_request :387-469)."""
+        if lora_request is not None and not self.lora_config:
+            raise ValueError("LoRA is not enabled (set enable_lora).")
         if arrival_time is None:
             arrival_time = time.monotonic()
         if prompt_token_ids is None:
@@ -126,7 +129,8 @@ class AphroditeEngine:
 
         block_size = self.cache_config.block_size
         seq_id = next(self.seq_counter)
-        seq = Sequence(seq_id, prompt, prompt_token_ids, block_size)
+        seq = Sequence(seq_id, prompt, prompt_token_ids, block_size,
+                       lora_request=lora_request)
 
         prefix = None
         if prefix_pos is not None:
@@ -134,7 +138,8 @@ class AphroditeEngine:
                 prompt_token_ids[:prefix_pos])
 
         seq_group = SequenceGroup(request_id, [seq], sampling_params,
-                                  arrival_time, prefix=prefix)
+                                  arrival_time, prefix=prefix,
+                                  lora_request=lora_request)
         self.scheduler.add_seq_group(seq_group)
 
     def abort_request(self, request_id: Union[str, Iterable[str]]) -> None:

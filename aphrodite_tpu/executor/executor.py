@@ -65,15 +65,18 @@ class TPUExecutor:
         parallel_config: ParallelConfig,
         scheduler_config: SchedulerConfig,
         device_config: DeviceConfig,
+        lora_config=None,
     ) -> None:
         self.model_config = model_config
         self.cache_config = cache_config
         self.parallel_config = parallel_config
         self.scheduler_config = scheduler_config
+        self.lora_config = lora_config
 
         self.mesh = build_mesh(parallel_config, device_config)
         logger.info("Loading model %s ...", model_config.model)
-        self.model, self.params = get_model(model_config, self.mesh)
+        self.model, self.params = get_model(model_config, self.mesh,
+                                            lora_config)
 
         self._profile_and_size_cache()
         self.cache_engine = CacheEngine(cache_config, model_config,
@@ -83,6 +86,14 @@ class TPUExecutor:
             page_size=cache_config.block_size,
             num_slots=self.cache_engine.num_slots,
             mesh=self.mesh)
+
+        self.lora_manager = None
+        if lora_config is not None:
+            from aphrodite_tpu.lora.worker_manager import WorkerLoRAManager
+            self.lora_manager = WorkerLoRAManager(
+                lora_config,
+                write_slot_fn=self.model_runner.write_lora_slot,
+                clear_slot_fn=self.model_runner.clear_lora_slot)
 
     # -- sizing --
 
@@ -132,6 +143,11 @@ class TPUExecutor:
             self.cache_engine.swap_out(blocks_to_swap_out)
         if blocks_to_swap_in:
             self.cache_engine.swap_in(blocks_to_swap_in)
+
+        if self.lora_manager is not None and seq_group_metadata_list:
+            self.lora_manager.set_active_adapters(
+                [md.lora_request for md in seq_group_metadata_list])
+            self.model_runner.lora_slot_of = self.lora_manager.slot_of
 
         output, new_caches = self.model_runner.execute_model(
             seq_group_metadata_list, self.cache_engine.kv_caches,

@@ -81,6 +81,12 @@ class ModelRunner:
         self.mesh = mesh
         self.sampler = Sampler(model_config.get_vocab_size())
 
+        # LoRA: bucket keys carrying slot-stacked adapter tensors, and a
+        # slot resolver installed by the executor's WorkerLoRAManager.
+        from aphrodite_tpu.lora.layers import LORA_A
+        self.lora_buckets = [k for k, b in params.items() if LORA_A in b]
+        self.lora_slot_of = None
+
         # One jitted program per (is_prompt, use_prefix); shape buckets
         # land in XLA's compile cache keyed by array shapes.
         self._step_fn = jax.jit(
@@ -106,6 +112,52 @@ class ModelRunner:
         return [
             _copy_blocks_op(k, v, src, dst) for (k, v) in kv_caches
         ]
+
+    # ---- LoRA slot plumbing ----
+
+    def write_lora_slot(self, bucket_key: str, slot: int, a, b) -> None:
+        """Place one adapter's (A [in, r], B [r, out]) into slot
+        `slot` of the stacked arrays (rank-padded with zeros)."""
+        from aphrodite_tpu.lora.layers import LORA_A, LORA_B
+        import numpy as np
+        bucket = self.params[bucket_key]
+        sa, sb = bucket[LORA_A], bucket[LORA_B]
+        a_pad = np.zeros(sa.shape[1:], dtype=np.float32)
+        b_pad = np.zeros(sb.shape[1:], dtype=np.float32)
+        a_pad[:, :a.shape[1]] = a
+        b_pad[:b.shape[0], :] = b
+        bucket[LORA_A] = sa.at[slot].set(
+            jnp.asarray(a_pad, dtype=sa.dtype))
+        bucket[LORA_B] = sb.at[slot].set(
+            jnp.asarray(b_pad, dtype=sb.dtype))
+
+    def clear_lora_slot(self, bucket_key: str, slot: int) -> None:
+        from aphrodite_tpu.lora.layers import LORA_A, LORA_B
+        bucket = self.params[bucket_key]
+        bucket[LORA_A] = bucket[LORA_A].at[slot].set(0.0)
+        bucket[LORA_B] = bucket[LORA_B].at[slot].set(0.0)
+
+    def _params_with_lora(self, seq_group_metadata_list,
+                          padded_batch: int, rows_per_group):
+        """Inject this step's per-row adapter slot indices into every
+        LoRA bucket (shallow copies; stable pytree structure)."""
+        if not self.lora_buckets:
+            return self.params
+        import numpy as np
+        idx = np.full((padded_batch,), -1, dtype=np.int32)
+        row = 0
+        for md, n_rows in zip(seq_group_metadata_list, rows_per_group):
+            if md.lora_request is not None and \
+                    self.lora_slot_of is not None:
+                slot = self.lora_slot_of(md.lora_request.lora_int_id)
+                idx[row:row + n_rows] = slot
+            row += n_rows
+        from aphrodite_tpu.lora.layers import LORA_IDX
+        arr = jnp.asarray(idx)
+        params = dict(self.params)
+        for key in self.lora_buckets:
+            params[key] = {**self.params[key], LORA_IDX: arr}
+        return params
 
     # ---- host batch builders ----
 
@@ -310,11 +362,18 @@ class ModelRunner:
         is_prompt = seq_group_metadata_list[0].is_prompt
         if is_prompt:
             inputs, sampling = self._prepare_prompt(seq_group_metadata_list)
+            rows_per_group = [1] * len(seq_group_metadata_list)
         else:
             inputs, sampling = self._prepare_decode(seq_group_metadata_list)
+            rows_per_group = [
+                len(md.seq_data) for md in seq_group_metadata_list
+            ]
 
+        params = self._params_with_lora(
+            seq_group_metadata_list, inputs["input_ids"].shape[0],
+            rows_per_group)
         logits, kv_caches = self._step_fn(
-            self.params, inputs["input_ids"], inputs["positions"],
+            params, inputs["input_ids"], inputs["positions"],
             kv_caches, inputs["metadata"], inputs["sel"],
             is_prompt=inputs["is_prompt"],
             use_prefix=inputs["use_prefix"])

@@ -1,0 +1,17 @@
+"""Multi-LoRA serving (S-LoRA style).
+
+Reference: `aphrodite/lora/` — LoRARequest (`request.py:5`),
+LoRAModel/LoRAModelManager (`models.py:136,266`), per-worker manager
+(`worker_manager.py:188`), LoRA layers (`layers.py:147-657`), punica
+BGMV kernels (`punica.py`, `kernels/punica/`).
+
+TPU-native design: adapters live as SLOT-STACKED tensors
+A [slots, in, rank], B [slots, rank, out] inside each wrapped layer's
+parameter bucket; per-sequence slot indices ride the batch. The punica
+batched-gather matvec becomes a dense masked combine over slots (exact,
+static-shaped, MXU-friendly — same pattern as the MoE layer), and the
+per-layer CUDA kernel dispatch disappears into the jitted step.
+"""
+from aphrodite_tpu.lora.request import LoRARequest
+
+__all__ = ["LoRARequest"]

@@ -1,0 +1,88 @@
+"""LoRA-augmented linear execution.
+
+Reference: `aphrodite/lora/layers.py` (LoRA wrappers for every parallel
+layer) + `lora/punica.py` (bgmv dispatch).
+
+`LoRALinearMethod` wraps any base LinearMethod. When a layer's bucket
+contains slot-stacked lora params, the forward adds
+
+    y += sum_s mask_s(token) * (x @ A_s) @ B_s
+
+— a dense combine over adapter slots (alpha/rank scaling is folded into
+B at stacking time). Tokens with slot -1 match no mask and get the base
+output only. Buckets without lora params skip the extra math at trace
+time, so the non-LoRA fast path is unchanged.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from aphrodite_tpu.modeling.layers.linear import LinearMethod
+
+LORA_A = "lora_A_stacked"
+LORA_B = "lora_B_stacked"
+LORA_IDX = "lora_indices"
+
+
+class LoRALinearMethod(LinearMethod):
+    """Base method + slot-stacked LoRA delta."""
+
+    def __init__(self, base: LinearMethod, max_loras: int,
+                 max_rank: int) -> None:
+        self.base = base
+        self.max_loras = max_loras
+        self.max_rank = max_rank
+
+    def create_weights(self, in_features, out_features, dtype, bias,
+                       out_axis, in_axis):
+        self.base.packed_factor = getattr(self, "packed_factor", 1)
+        params = self.base.create_weights(in_features, out_features,
+                                          dtype, bias, out_axis, in_axis)
+        # Merged layers (qkv=3, gate_up=2) carry one block-diagonal LoRA
+        # of packed_factor * rank (see lora/models.py merge).
+        rank = self.max_rank * getattr(self, "packed_factor", 1)
+        params[LORA_A] = jnp.zeros(
+            (self.max_loras, in_features, rank), dtype=dtype)
+        params[LORA_B] = jnp.zeros(
+            (self.max_loras, rank, out_features), dtype=dtype)
+        return params
+
+    def create_specs(self, bias, out_axis, in_axis):
+        specs = self.base.create_specs(bias, out_axis, in_axis)
+        # A sharded on the input axis, B on the output axis (rank is
+        # tiny and replicated), matching the base layer's TP layout.
+        specs[LORA_A] = P(None, in_axis, None)
+        specs[LORA_B] = P(None, None, out_axis)
+        specs[LORA_IDX] = P()
+        return specs
+
+    def apply(self, params: Dict[str, jax.Array],
+              x: jax.Array) -> jax.Array:
+        y = self.base.apply(params, x)
+        if LORA_A not in params or LORA_IDX not in params:
+            return y
+        idx = params[LORA_IDX]                    # [batch] int32, -1=none
+        a = params[LORA_A]                        # [slots, in, r]
+        b = params[LORA_B]                        # [slots, r, out]
+        # Dense combine over slots: exact, static shapes.
+        # x: [batch, seq, in]
+        xa = jnp.einsum("bsh,lhr->lbsr", x, a)
+        delta = jnp.einsum("lbsr,lro->lbso", xa, b)
+        slots = jnp.arange(a.shape[0], dtype=idx.dtype)
+        mask = (idx[None, :] == slots[:, None])   # [slots, batch]
+        masked = delta * mask[:, :, None, None].astype(delta.dtype)
+        return y + jnp.sum(masked, axis=0)
+
+    def load_weight(self, params, name, hf_tensor):
+        converted = self.base.load_weight(params, name, hf_tensor)
+        # Forward any derived params (e.g. int8 scales) from the base.
+        self.pending_sidecar = getattr(self.base, "pending_sidecar", None)
+        self.base.pending_sidecar = None
+        return converted
+
+    def out_scale(self, name: str) -> int:
+        return self.base.out_scale(name)

@@ -90,6 +90,10 @@ class LinearBase:
     out_axis: Optional[str] = None
     in_axis: Optional[str] = None
 
+    # Number of stacked sub-projections sharing this layer's matmul
+    # (qkv = 3, gate_up = 2); LoRA sizes its merged rank by this.
+    packed_factor: int = 1
+
     def __init__(self, in_features: int, out_features: int, *,
                  bias: bool = False, dtype: jnp.dtype = jnp.bfloat16,
                  linear_method: Optional[LinearMethod] = None) -> None:
@@ -100,6 +104,7 @@ class LinearBase:
         self.linear_method = linear_method or LinearMethod()
 
     def init(self) -> ParamDict:
+        self.linear_method.packed_factor = self.packed_factor
         return self.linear_method.create_weights(
             self.in_features, self.out_features, self.dtype, self.bias,
             self.out_axis, self.in_axis)
@@ -187,6 +192,7 @@ class MergedColumnParallelLinear(_ShardedLoadMixin, ColumnParallelLinear):
 
     def __init__(self, in_features: int, output_sizes, **kw) -> None:
         self.output_sizes = list(output_sizes)
+        self.packed_factor = len(self.output_sizes)
         super().__init__(in_features, sum(self.output_sizes), **kw)
 
     def weight_loader(self, params: Dict[str, np.ndarray], name: str,
@@ -203,6 +209,8 @@ class MergedColumnParallelLinear(_ShardedLoadMixin, ColumnParallelLinear):
 class QKVParallelLinear(_ShardedLoadMixin, ColumnParallelLinear):
     """Fused QKV projection, column-sharded by attention head
     (reference `linear.py:324`). Loader slices by ('q'|'k'|'v')."""
+
+    packed_factor = 3
 
     def __init__(self, hidden_size: int, head_size: int, num_heads: int,
                  num_kv_heads: Optional[int] = None, **kw) -> None:

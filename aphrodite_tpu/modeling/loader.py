@@ -37,11 +37,14 @@ def _get_model_architecture(config) -> type:
 
 
 def get_model(model_config: ModelConfig,
-              mesh: Optional[Mesh] = None) -> Tuple[object, dict]:
+              mesh: Optional[Mesh] = None,
+              lora_config=None) -> Tuple[object, dict]:
     """Build the model and its (sharded) parameters.
 
     Returns (model, params). With a mesh, every parameter is device_put
-    with its NamedSharding; single-chip gets plain device arrays.
+    with its NamedSharding; single-chip gets plain device arrays. With a
+    lora_config, every linear layer is built through LoRALinearMethod so
+    its bucket carries slot-stacked adapter tensors.
     """
     model_cls = _get_model_architecture(model_config.hf_config)
     dtype = _DTYPES[model_config.dtype]
@@ -58,6 +61,14 @@ def get_model(model_config: ModelConfig,
         quant_config = get_quantization_config(model_config)
         linear_method = quant_config.get_linear_method()
 
+    if lora_config is not None:
+        from aphrodite_tpu.lora.layers import LoRALinearMethod
+        from aphrodite_tpu.modeling.layers.linear import LinearMethod
+        linear_method = LoRALinearMethod(
+            linear_method or LinearMethod(),
+            max_loras=lora_config.max_loras,
+            max_rank=lora_config.max_lora_rank)
+
     model = model_cls(model_config.hf_config, dtype=dtype,
                       linear_method=linear_method)
 
@@ -73,5 +84,20 @@ def get_model(model_config: ModelConfig,
     weights_iter = hf_model_weights_iterator(model_config.model,
                                              model_config.load_format)
     params_np = model.load_weights(weights_iter)
+    if lora_config is not None:
+        _add_empty_lora_params(model, params_np)
     params = shard_params(params_np, model.param_specs(), mesh, dtype)
     return model, params
+
+
+def _add_empty_lora_params(model, params_np) -> None:
+    """Checkpoints carry no adapter slots; add zeroed stacked LoRA params
+    so the param-tree structure is stable for jit."""
+    import numpy as np
+    from aphrodite_tpu.lora.layers import LORA_A, LORA_B
+    init = model.init_params()
+    for key, bucket in init.items():
+        for pname in (LORA_A, LORA_B):
+            if pname in bucket:
+                params_np.setdefault(key, {})[pname] = np.zeros(
+                    bucket[pname].shape, dtype=np.float32)

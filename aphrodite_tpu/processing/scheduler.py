@@ -338,6 +338,7 @@ class Scheduler:
                     block_tables=block_tables,
                     persistent_data=persistent_data,
                     prefix=seq_group.prefix,
+                    lora_request=seq_group.lora_request,
                 ))
         return seq_group_metadata_list, scheduler_outputs
 
