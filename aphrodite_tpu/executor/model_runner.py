@@ -170,17 +170,25 @@ class ModelRunner:
         prompt_lens: List[int] = []
         seq_groups, seq_data_map = [], {}
         use_prefix = False
+        newly_computed = []
         for md in seq_group_metadata_list:
             seq_id = next(iter(md.seq_data))
             data = md.seq_data[seq_id]
             # Chunk to compute = tokens not yet in cache (prefix cached).
             ctx = 0
-            if md.prefix is not None and md.prefix.computed:
-                ctx = md.prefix.get_length()
-                use_prefix = True
+            if md.prefix is not None:
+                if md.prefix.computed:
+                    ctx = md.prefix.get_length()
+                    use_prefix = True
+                else:
+                    # This prefill writes the prefix KV; later requests
+                    # sharing it skip recompute (reference prefix_pos).
+                    newly_computed.append(md.prefix)
             prompt_lens.append(data.get_len() - ctx)
             seq_groups.append(([seq_id], md.sampling_params))
             seq_data_map[seq_id] = data
+        for prefix in newly_computed:
+            prefix.computed = True
 
         max_len = max(prompt_lens)
         padded_len = _pow2_bucket(max_len)
