@@ -298,6 +298,7 @@ class SchedulerConfig:
         max_num_seqs: int,
         max_model_len: int,
         max_paddings: int,
+        multi_step: int = 1,
     ) -> None:
         if max_num_batched_tokens is not None:
             self.max_num_batched_tokens = max_num_batched_tokens
@@ -307,6 +308,9 @@ class SchedulerConfig:
         self.max_num_seqs = max_num_seqs
         self.max_model_len = max_model_len
         self.max_paddings = max_paddings
+        # Decode steps per scheduling round (>1 = device-side multi-step
+        # decode with token feedback; eligibility checked per batch).
+        self.multi_step = max(1, multi_step)
         self._verify_args()
 
     def _verify_args(self) -> None:

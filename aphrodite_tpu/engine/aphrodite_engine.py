@@ -157,21 +157,90 @@ class AphroditeEngine:
     # -- the step --
 
     def step(self) -> List[RequestOutput]:
-        """One engine iteration = (usually) one new token per running seq
-        (reference step :754-828)."""
+        """One engine iteration: one new token per running seq, or — for
+        eligible decode batches with multi_step>1 — a device-side burst of
+        K tokens per seq with one host sync total (reference step
+        :754-828; the burst is the TPU answer to per-step launch/transfer
+        latency)."""
         seq_group_metadata_list, scheduler_outputs = \
             self.scheduler.schedule()
 
-        if not scheduler_outputs.is_empty():
-            output = self.executor.execute_model(
+        if scheduler_outputs.is_empty():
+            return self._process_model_outputs([], scheduler_outputs)
+
+        burst = self._burst_steps(seq_group_metadata_list,
+                                  scheduler_outputs)
+        if burst > 1:
+            outputs_list = self.executor.execute_decode_burst(
                 seq_group_metadata_list,
                 scheduler_outputs.blocks_to_swap_in,
                 scheduler_outputs.blocks_to_swap_out,
-                scheduler_outputs.blocks_to_copy)
-        else:
-            output = []
+                scheduler_outputs.blocks_to_copy,
+                num_steps=burst)
+            return self._process_burst_outputs(outputs_list,
+                                               scheduler_outputs)
 
+        output = self.executor.execute_model(
+            seq_group_metadata_list,
+            scheduler_outputs.blocks_to_swap_in,
+            scheduler_outputs.blocks_to_swap_out,
+            scheduler_outputs.blocks_to_copy)
         return self._process_model_outputs(output, scheduler_outputs)
+
+    def _burst_steps(self, seq_group_metadata_list,
+                     scheduler_outputs) -> int:
+        """How many decode steps to run device-side this round.
+
+        Eligible: decode round, no sliding window, and every group is a
+        single-sequence greedy/random group without history-dependent
+        sampling stages (penalties, mirostat), custom processors, or
+        full-logprob needs — everything the device loop can't feed back.
+        """
+        max_steps = self.scheduler_config.multi_step
+        if max_steps <= 1 or scheduler_outputs.prompt_run:
+            return 1
+        if self.model_config.get_sliding_window() is not None:
+            return 1
+        remaining = []
+        for md in seq_group_metadata_list:
+            p = md.sampling_params
+            if (len(md.seq_data) != 1 or p.use_beam_search
+                    or p.logits_processors or p.mirostat_mode == 2
+                    or p.prompt_logprobs is not None
+                    or abs(p.presence_penalty) >= 1e-5
+                    or abs(p.frequency_penalty) >= 1e-5
+                    or abs(p.repetition_penalty - 1.0) >= 1e-5):
+                return 1
+            data = next(iter(md.seq_data.values()))
+            remaining.append(p.max_tokens - data.get_output_len())
+            remaining.append(self.scheduler_config.max_model_len -
+                             data.get_len())
+        want = max(1, min([max_steps] + remaining))
+        if want <= 1:
+            return 1
+        granted = self.scheduler.reserve_decode_burst(
+            seq_group_metadata_list, want - 1)
+        return 1 + granted
+
+    def _process_burst_outputs(
+            self, outputs_list: List[SamplerOutput],
+            scheduler_outputs: SchedulerOutputs) -> List[RequestOutput]:
+        scheduled_seq_groups = scheduler_outputs.scheduled_seq_groups
+        for output in outputs_list:
+            for seq_group, outputs in zip(scheduled_seq_groups, output):
+                if seq_group.is_finished():
+                    continue        # burst overran this group's stop
+                self._process_sequence_group_outputs(seq_group, outputs)
+        self.scheduler.free_finished_seq_groups()
+
+        request_outputs = [
+            RequestOutput.from_seq_group(g) for g in scheduled_seq_groups
+        ]
+        for seq_group in scheduler_outputs.ignored_seq_groups:
+            request_outputs.append(RequestOutput.from_seq_group(seq_group))
+        if self.stat_logger is not None:
+            self.stat_logger.log(self._get_stats(scheduler_outputs))
+        return request_outputs
 
     # -- output processing (reference :550-752) --
 
@@ -262,6 +331,11 @@ class AphroditeEngine:
                 seq_group.add(seq)
                 if not seq.is_finished():
                     self.scheduler.fork_seq(parent, seq)
+            elif parent is not None and seq.is_finished():
+                # Selected finished parent: keep its data in the group but
+                # release its KV blocks (reference frees finished parents
+                # after selection; holding them leaks the pool).
+                self.scheduler.free_seq(seq)
         for seq, parent in all_finished[beam_width:]:
             if parent is None:
                 seq_group.remove(seq.seq_id)      # existing, now pruned
@@ -278,6 +352,14 @@ class AphroditeEngine:
             reverse=True)
         stop = self._check_beam_search_early_stopping(
             params.early_stopping, params, all_finished, running)
+        if stop:
+            # Beam search is done: no running beam can beat the selected
+            # finished set (reference aphrodite_engine.py:682-698).
+            for seq, parent in running:
+                if seq is parent:
+                    seq_group.remove(seq.seq_id)
+                    self.scheduler.free_seq(seq)
+            return
 
         for seq, parent in running[:beam_width]:
             if seq is not parent:
@@ -290,11 +372,33 @@ class AphroditeEngine:
 
     def _check_beam_search_early_stopping(self, early_stopping, params,
                                           finished, running) -> bool:
-        if not finished or not running:
+        """True when no running beam can still enter the finished top-k
+        (reference `_check_beam_search_early_stopping`,
+        aphrodite_engine.py:622-660)."""
+        if len(finished) < params.best_of or not running:
             return False
         if early_stopping is True:
-            return len(finished) >= params.best_of
-        return False
+            return True
+        length_penalty = params.length_penalty
+        worst_finished = min(
+            s.get_beam_search_score(length_penalty)
+            for s, _ in finished[:params.best_of])
+        best_running = running[0][0]
+        if early_stopping is False:
+            # Compare against the running beam's CURRENT score: logprobs
+            # only decrease, so with length_penalty<=1 it cannot improve.
+            attainable = best_running.get_beam_search_score(length_penalty)
+        else:   # "never": assume the best case over all future lengths
+            if length_penalty > 0.0:
+                max_possible = max(
+                    best_running.get_prompt_len() + params.max_tokens,
+                    self.scheduler_config.max_model_len)
+                attainable = best_running.get_beam_search_score(
+                    length_penalty, seq_len=max_possible)
+            else:
+                attainable = best_running.get_beam_search_score(
+                    length_penalty)
+        return worst_finished >= attainable
 
     def _decode_sequence(self, seq: Sequence,
                          params: SamplingParams) -> None:

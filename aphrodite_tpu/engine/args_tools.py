@@ -45,6 +45,7 @@ class EngineArgs:
     max_num_batched_tokens: Optional[int] = None
     max_num_seqs: int = 256
     max_paddings: int = 256
+    multi_step: int = 1
     disable_log_stats: bool = False
     revision: Optional[str] = None
     tokenizer_revision: Optional[str] = None
@@ -105,6 +106,9 @@ class EngineArgs:
                             default=None)
         parser.add_argument("--max-num-seqs", type=int, default=256)
         parser.add_argument("--max-paddings", type=int, default=256)
+        parser.add_argument("--multi-step", type=int, default=1,
+                            help="decode steps per scheduling round "
+                                 "(device-side token feedback)")
         parser.add_argument("--disable-log-stats", action="store_true")
         parser.add_argument("--revision", type=str, default=None)
         parser.add_argument("--tokenizer-revision", type=str, default=None)
@@ -150,7 +154,8 @@ class EngineArgs:
             self.disable_custom_all_reduce)
         scheduler_config = SchedulerConfig(
             self.max_num_batched_tokens, self.max_num_seqs,
-            model_config.max_model_len, self.max_paddings)
+            model_config.max_model_len, self.max_paddings,
+            multi_step=self.multi_step)
         device_config = DeviceConfig(self.device)
         lora_config = None
         if self.enable_lora:

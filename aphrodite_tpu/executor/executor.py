@@ -97,29 +97,66 @@ class TPUExecutor:
 
     # -- sizing --
 
+    # Per-chip USABLE HBM for TPU generations whose runtime reports no
+    # memory stats (device_kind substring -> bytes). Values are ~90% of
+    # nominal: the runtime/firmware reserves the rest (measured on v5e:
+    # ~14.5 GiB of 16 materialize before ResourceExhausted).
+    _HBM_BY_KIND = (
+        ("v5 lite", int(14.5 * _GB)),
+        ("v5e", int(14.5 * _GB)),
+        ("v5p", 90 * _GB),
+        ("v6", 29 * _GB),
+        ("v4", 29 * _GB),
+        ("v3", int(14.5 * _GB)),
+    )
+
     def _device_free_memory(self) -> int:
+        dev = jax.devices()[0]
         try:
-            stats = jax.devices()[0].memory_stats()
+            stats = dev.memory_stats()
             limit = stats.get("bytes_limit")
             in_use = stats.get("bytes_in_use", 0)
             if limit:
                 return int(limit - in_use)
-        except Exception:      # CPU backend has no memory_stats
+        except Exception:      # CPU backend / axon: no memory_stats
             pass
+        kind = getattr(dev, "device_kind", "").lower()
+        for marker, total in self._HBM_BY_KIND:
+            if marker in kind:
+                weights = sum(
+                    leaf.nbytes for leaf in jax.tree_util.tree_leaves(
+                        self.params))
+                n_chips = max(1, self.parallel_config.world_size)
+                return max(int(total - weights / n_chips), 0)
         return 0
 
     def _profile_and_size_cache(self) -> None:
-        if self.cache_config.num_gpu_blocks is not None:
-            return                       # explicitly sized (tests)
         block_bytes = CacheEngine.get_cache_block_size(
             self.cache_config, self.model_config, self.parallel_config)
+        if self.cache_config.num_gpu_blocks is not None:
+            # Device pool explicitly sized (tests); still derive the host
+            # swap pool if unset.
+            if self.cache_config.num_cpu_blocks is None:
+                self.cache_config.num_cpu_blocks = int(
+                    self.cache_config.swap_space_bytes // block_bytes)
+            return
         free = self._device_free_memory()
         if free <= 0:
             budget = _FALLBACK_CACHE_BYTES
         else:
-            # Weights are already resident; give the cache the configured
-            # fraction of what remains (leaving headroom for activations).
-            budget = int(free * self.cache_config.gpu_memory_utilization)
+            # Weights are already resident; reserve headroom for compiled
+            # programs + transient activations (at least 512 MB — prefill
+            # scratch at 7B scale needs it), then give the cache the
+            # configured fraction of the rest.
+            headroom = min(free // 2, 512 << 20)
+            budget = int((free - headroom) *
+                         self.cache_config.gpu_memory_utilization)
+            # The in-place KV scatter keeps a temp copy of one layer's
+            # (k, v) pair live during the update; cap the pool so
+            # budget * (1 + 1/layers) still fits.
+            layers = max(1, self.model_config.get_num_layers(
+                self.parallel_config))
+            budget = int(budget * layers / (layers + 1))
         num_pages = max(budget // block_bytes, 16)
         self.cache_config.num_gpu_blocks = int(num_pages)
         if self.cache_config.num_cpu_blocks is None:
@@ -154,3 +191,27 @@ class TPUExecutor:
             blocks_to_copy)
         self.cache_engine.kv_caches = new_caches
         return output
+
+    def execute_decode_burst(
+        self,
+        seq_group_metadata_list: List[SequenceGroupMetadata],
+        blocks_to_swap_in: Dict[int, int],
+        blocks_to_swap_out: Dict[int, int],
+        blocks_to_copy: Dict[int, List[int]],
+        num_steps: int,
+    ) -> List[SamplerOutput]:
+        """Multi-step decode: one scheduling round drives `num_steps`
+        device iterations (see ModelRunner.execute_decode_burst)."""
+        if blocks_to_swap_out:
+            self.cache_engine.swap_out(blocks_to_swap_out)
+        if blocks_to_swap_in:
+            self.cache_engine.swap_in(blocks_to_swap_in)
+        if self.lora_manager is not None and seq_group_metadata_list:
+            self.lora_manager.set_active_adapters(
+                [md.lora_request for md in seq_group_metadata_list])
+            self.model_runner.lora_slot_of = self.lora_manager.slot_of
+        outputs, new_caches = self.model_runner.execute_decode_burst(
+            seq_group_metadata_list, self.cache_engine.kv_caches,
+            num_steps, blocks_to_copy)
+        self.cache_engine.kv_caches = new_caches
+        return outputs

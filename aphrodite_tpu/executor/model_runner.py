@@ -28,10 +28,12 @@ import jax.numpy as jnp
 from aphrodite_tpu.common.config import (ModelConfig, ParallelConfig,
                                          SchedulerConfig)
 from aphrodite_tpu.common.logger import init_logger
+from aphrodite_tpu.common.sampling_params import SamplingType
 from aphrodite_tpu.common.sequence import (SamplerOutput,
                                            SequenceGroupMetadata)
 from aphrodite_tpu.modeling.input_metadata import InputMetadata
-from aphrodite_tpu.modeling.layers.sampler import Sampler
+from aphrodite_tpu.modeling.layers.sampler import (Sampler, fused_sample,
+                                                   _fused_sample_jit)
 from aphrodite_tpu.modeling.sampling_metadata import (OutputMetadata,
                                                       PersistentMetadata,
                                                       SamplingMetadata)
@@ -94,6 +96,11 @@ class ModelRunner:
             static_argnames=("is_prompt", "use_prefix"),
             donate_argnums=(3,),      # kv_caches
         )
+        self._burst_step_fn = jax.jit(
+            self._burst_step,
+            static_argnames=("max_best_of", "num_topk"),
+            donate_argnums=(3,),      # kv_caches
+        )
         self._copy_fn = jax.jit(self._copy_blocks, donate_argnums=(0,))
 
     # ---- jitted bodies ----
@@ -107,6 +114,35 @@ class ModelRunner:
         rows = jnp.take(flat, sel_indices, axis=0)
         logits = self.model.compute_logits(params, rows)
         return logits, new_caches
+
+    def _burst_step(self, params, input_ids, positions, kv_caches,
+                    metadata, tensors, bases, salt1, salt2, greedy_mask,
+                    step_salt, *, max_best_of: int, num_topk: int):
+        """One multi-step-decode iteration, fully on device: model step,
+        fused sampling, and next-step input computation (token feedback,
+        advanced positions/slots from the block table) — so K iterations
+        chain with zero host syncs between them."""
+        hidden, new_caches = self.model(params, input_ids, positions,
+                                        kv_caches, metadata)
+        flat = hidden.reshape(-1, hidden.shape[-1])
+        logits = self.model.compute_logits(params, flat)
+        packed, _ = fused_sample(
+            logits, tensors, bases, salt1 + step_salt, salt2,
+            max_best_of=max_best_of, num_topk=num_topk,
+            need_logprobs=False)
+        next_tok = jnp.where(greedy_mask, packed[:, 0], packed[:, 1])
+        next_ids = next_tok[:, None].astype(jnp.int32)
+        next_pos = positions + 1
+        p = next_pos[:, 0]
+        page = jnp.take_along_axis(metadata.block_tables,
+                                   (p // self.page_size)[:, None],
+                                   axis=1)[:, 0]
+        next_slots = jnp.minimum(
+            page * self.page_size + p % self.page_size, self.num_slots)
+        next_meta = metadata.replace(
+            slot_mapping=next_slots,
+            context_lens=metadata.context_lens + 1)
+        return packed, next_ids, next_pos, next_meta, new_caches
 
     def _copy_blocks(self, kv_caches, src, dst):
         return [
@@ -386,5 +422,85 @@ class ModelRunner:
             is_prompt=inputs["is_prompt"],
             use_prefix=inputs["use_prefix"])
 
-        output = self.sampler(logits[:inputs["num_rows"]], sampling)
+        has_processors = any(
+            p.logits_processors for _, p in sampling.seq_groups)
+        if has_processors:
+            # Host logits-processor path: needs the logits on the host
+            # mid-pipeline; pays extra syncs but only when a request
+            # installs custom processors.
+            output = self.sampler(logits[:inputs["num_rows"]], sampling)
+            return output, kv_caches
+
+        # Fast path: sampling runs as a second async device program over
+        # the padded row bucket; the ONLY blocking transfer per step is
+        # the packed result pull in the middle here.
+        plan = self.sampler.plan(sampling, pad_to=logits.shape[0])
+        packed, logprobs_dev = _fused_sample_jit(
+            logits, plan.tensors, jnp.asarray(plan.bases),
+            jnp.asarray(plan.salt1), jnp.asarray(plan.salt2),
+            max_best_of=plan.max_best_of, num_topk=plan.num_topk,
+            need_logprobs=plan.need_logprobs)
+        output = self.sampler.finalize(sampling, plan, np.asarray(packed),
+                                       logprobs_dev)
         return output, kv_caches
+
+    def execute_decode_burst(
+        self,
+        seq_group_metadata_list: List[SequenceGroupMetadata],
+        kv_caches: List[Tuple[jax.Array, jax.Array]],
+        num_steps: int,
+        blocks_to_copy: Optional[Dict[int, List[int]]] = None,
+    ) -> Tuple[List[SamplerOutput], List[Tuple[jax.Array, jax.Array]]]:
+        """Run `num_steps` decode iterations with device-side token
+        feedback: 2*num_steps async dispatches, ONE host sync at the end
+        (the stacked packed results). Eligibility (single-seq greedy/
+        random groups, no history-dependent sampling stages) is enforced
+        by the engine."""
+        if blocks_to_copy:
+            src, dst = [], []
+            for s, ds in blocks_to_copy.items():
+                for d in ds:
+                    src.append(s)
+                    dst.append(d)
+            kv_caches = self._copy_fn(kv_caches,
+                                      jnp.asarray(src, dtype=jnp.int32),
+                                      jnp.asarray(dst, dtype=jnp.int32))
+
+        inputs, sampling = self._prepare_decode(seq_group_metadata_list)
+        padded = inputs["input_ids"].shape[0]
+        rows_per_group = [
+            len(md.seq_data) for md in seq_group_metadata_list
+        ]
+        params = self._params_with_lora(seq_group_metadata_list, padded,
+                                        rows_per_group)
+        plan = self.sampler.plan(sampling, pad_to=padded)
+
+        greedy = np.zeros((padded,), dtype=bool)
+        row = 0
+        for md in seq_group_metadata_list:
+            n = len(md.seq_data)
+            if md.sampling_params.sampling_type == SamplingType.GREEDY:
+                greedy[row:row + n] = True
+            row += n
+        greedy_mask = jnp.asarray(greedy)
+        tensors = plan.tensors
+        bases = jnp.asarray(plan.bases)
+        salt1 = jnp.asarray(plan.salt1)
+        salt2 = jnp.asarray(plan.salt2)
+
+        ids, pos, meta = (inputs["input_ids"], inputs["positions"],
+                          inputs["metadata"])
+        packed_steps = []
+        for t in range(num_steps):
+            packed, ids, pos, meta, kv_caches = self._burst_step_fn(
+                params, ids, pos, kv_caches, meta, tensors, bases, salt1,
+                salt2, greedy_mask, np.int32(t),
+                max_best_of=plan.max_best_of, num_topk=plan.num_topk)
+            packed_steps.append(packed)
+
+        all_packed = np.asarray(jnp.stack(packed_steps))   # ONE sync
+        outputs = [
+            self.sampler.finalize(sampling, plan, all_packed[t], None)
+            for t in range(num_steps)
+        ]
+        return outputs, kv_caches

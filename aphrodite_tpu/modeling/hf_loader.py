@@ -136,8 +136,10 @@ def initialize_dummy_params(model, seed: int = 0,
                             scale: float = 1e-3) -> Dict:
     """Small random weights for profiling/benchmarks without a checkpoint
     (reference `--load-format dummy`, `hf_downloader.py:377-391`)."""
-    params = model.init_params()
-    flat, treedef = jax.tree_util.tree_flatten(params)
+    # eval_shape: never materialize the zero-init tree — at 7B+ scale a
+    # concrete init_params() plus the dummy tree is 2x weights in HBM.
+    shapes = jax.eval_shape(model.init_params)
+    flat, treedef = jax.tree_util.tree_flatten(shapes)
     key = jax.random.PRNGKey(seed)
     keys = jax.random.split(key, len(flat))
     out = []
@@ -146,7 +148,7 @@ def initialize_dummy_params(model, seed: int = 0,
             out.append(jax.random.uniform(k, leaf.shape, leaf.dtype,
                                           minval=-scale, maxval=scale))
         else:
-            out.append(leaf)
+            out.append(jnp.zeros(leaf.shape, leaf.dtype))
     return jax.tree_util.tree_unflatten(treedef, out)
 
 

@@ -77,6 +77,11 @@ class PagedAttention:
             flat_v = v.reshape(-1, self.num_kv_heads, self.head_size)
             k_pages, v_pages = write_to_kv_cache(
                 flat_k, flat_v, k_pages, v_pages, metadata.slot_mapping)
+            # Keep the scatter un-fused from its readers: fusing the
+            # in-place page update into the attention gather forces XLA to
+            # materialize a full temp copy of the cache (multi-GB/step).
+            k_pages, v_pages = jax.lax.optimization_barrier(
+                (k_pages, v_pages))
 
         if metadata.is_prompt:
             out = self._prefill(q, k, v, k_pages, v_pages, metadata)
@@ -127,8 +132,13 @@ class PagedAttention:
                 and k_pages.dtype in (jnp.bfloat16, jnp.float32):
             from aphrodite_tpu.ops.pallas.paged_attention import (
                 paged_decode_attention)
+            # Padded table entries hold an out-of-range page id (the XLA
+            # gather's fill convention); the kernel DMAs pages raw, so
+            # clamp pads to a valid page — masked off by context_lens.
+            tables = jnp.minimum(metadata.block_tables,
+                                 k_pages.shape[1] - 1)
             out = paged_decode_attention(
-                q3, k_pages, v_pages, metadata.block_tables,
+                q3, k_pages, v_pages, tables,
                 metadata.context_lens, scale=self.scale)
         else:
             out = paged_decode_attention_ref(

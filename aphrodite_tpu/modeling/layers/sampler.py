@@ -282,7 +282,72 @@ def _make_row_keys(bases: jax.Array, salt1: jax.Array,
     return make(bases, salt1, salt2)
 
 
+def fused_sample(logits: jax.Array, t: SamplingTensors, bases: jax.Array,
+                 salt1: jax.Array, salt2: jax.Array, *, max_best_of: int,
+                 num_topk: int, need_logprobs: bool):
+    """The whole device-side sampling step — key building, the logits
+    pipeline, and token selection — packed into ONE int32 result array so
+    the host needs exactly one blocking transfer per engine step (the
+    dominant cost on a high-latency device link; floats ride along
+    bitcast to int32). Columns:
+
+      [0]                greedy token
+      [1 : 1+B]          multinomial draws (B = max_best_of)
+      [1+B : 1+B+K]      top-k logprob token ids (K = num_topk)
+      [W : W+1]          lp(greedy)      } float32 bitcast
+      [W+1 : W+1+B]      lp(draws)       }
+      [W+1+B : W+1+B+K]  top-k logprob values }
+      [-1]               updated mirostat mu  }
+
+    with W = 1+B+K. Full [rows, vocab] logprobs are returned only when
+    `need_logprobs` (beam search / prompt_logprobs), and stay on device.
+    Callable inside an outer jit or via `_fused_sample_jit`.
+    """
+    keys = _make_row_keys(bases, salt1, salt2)
+    processed, new_mus = _process_logits(logits, t, keys)
+    greedy, random, lp_greedy, lp_random, topk_vals, topk_idx, logprobs = \
+        _sample_tokens(processed, keys, max_best_of, num_topk)
+    ints = jnp.concatenate([
+        greedy[:, None].astype(jnp.int32),
+        random.astype(jnp.int32),
+        topk_idx.astype(jnp.int32),
+    ], axis=1)
+    floats = jnp.concatenate([
+        lp_greedy[:, None], lp_random, topk_vals, new_mus[:, None]
+    ], axis=1).astype(jnp.float32)
+    packed = jnp.concatenate(
+        [ints, jax.lax.bitcast_convert_type(floats, jnp.int32)], axis=1)
+    return packed, (logprobs if need_logprobs else None)
+
+
+_fused_sample_jit = jax.jit(
+    fused_sample,
+    static_argnames=("max_best_of", "num_topk", "need_logprobs"))
+
+
 # ------------------------------------------------------------- host side --
+
+class SamplePlan:
+    """Host-side bookkeeping for one sampling step, shared between the
+    device dispatch (`fused_sample` args) and `finalize`."""
+
+    __slots__ = ("tensors", "bases", "salt1", "salt2", "max_best_of",
+                 "num_topk", "need_logprobs", "num_rows", "row_to_seq",
+                 "group_of")
+
+    def __init__(self, tensors, bases, salt1, salt2, max_best_of,
+                 num_topk, need_logprobs, num_rows, row_to_seq, group_of):
+        self.tensors = tensors
+        self.bases = bases
+        self.salt1 = salt1
+        self.salt2 = salt2
+        self.max_best_of = max_best_of
+        self.num_topk = num_topk
+        self.need_logprobs = need_logprobs
+        self.num_rows = num_rows
+        self.row_to_seq = row_to_seq
+        self.group_of = group_of
+
 
 class Sampler:
     """Host orchestrator: tensorize knobs, run the jitted pipeline, and
@@ -303,22 +368,26 @@ class Sampler:
                  metadata: SamplingMetadata) -> SamplerOutput:
         assert logits.ndim == 2
         logits = self._apply_logits_processors(logits, metadata)
-        tensors, row_to_seq = build_sampling_tensors(metadata,
-                                                     self.vocab_size)
-        rows = logits.shape[0]
+        plan = self.plan(metadata)
+        packed, logprobs = _fused_sample_jit(
+            logits, plan.tensors, jnp.asarray(plan.bases),
+            jnp.asarray(plan.salt1), jnp.asarray(plan.salt2),
+            max_best_of=plan.max_best_of, num_topk=plan.num_topk,
+            need_logprobs=plan.need_logprobs)
+        return self.finalize(metadata, plan, np.asarray(packed), logprobs)
+
+    def plan(self, metadata: SamplingMetadata,
+             pad_to: Optional[int] = None) -> SamplePlan:
+        """Build the host-side step plan: device knob tensors (padded to
+        the program's row bucket), PRNG key parts, and static shapes."""
+        tensors, row_to_seq = build_sampling_tensors(
+            metadata, self.vocab_size, pad_to=pad_to)
+        num_rows = len(row_to_seq)
+        rows = tensors.temperatures.shape[0]
         self._step += 1
         group_of = self._seq_to_group(metadata)
-        keys = self._make_keys(metadata, rows, row_to_seq, group_of)
-
-        processed, new_mus = _process_logits(logits, tensors, keys)
-        if tensors.do_mirostat:
-            mus = np.asarray(new_mus)
-            for row, seq_id in row_to_seq.items():
-                _, params = group_of.get(seq_id, (None, None))
-                if params is not None and params.mirostat_mode == 2:
-                    metadata.output_metadata.add(seq_id, "miro_mu",
-                                                 float(mus[row]))
-
+        bases, salt1, salt2 = self._key_parts(metadata, rows, row_to_seq,
+                                              group_of)
         max_best_of = max([1] + [
             p.best_of for (_, p) in metadata.seq_groups
             if p.sampling_type == SamplingType.RANDOM
@@ -330,13 +399,41 @@ class Sampler:
             min(p.prompt_logprobs or 0, self.vocab_size - 1)
             for (_, p) in metadata.seq_groups
         ])
-        greedy, random, lp_greedy, lp_random, topk_vals, topk_idx, \
-            logprobs = _sample_tokens(processed, keys, max_best_of,
-                                      max_logprobs)
-        return self._assemble(
-            metadata, np.asarray(greedy), np.asarray(random),
-            np.asarray(lp_greedy), np.asarray(lp_random),
-            np.asarray(topk_vals), np.asarray(topk_idx), logprobs)
+        need_logprobs = any(
+            p.sampling_type == SamplingType.BEAM or
+            (p.prompt_logprobs is not None and
+             metadata.prompt_lens)
+            for (_, p) in metadata.seq_groups)
+        return SamplePlan(tensors, bases, salt1, salt2, max_best_of,
+                          max_logprobs, need_logprobs, num_rows,
+                          row_to_seq, group_of)
+
+    def finalize(self, metadata: SamplingMetadata, plan: SamplePlan,
+                 packed: np.ndarray,
+                 logprobs_dev: Optional[jax.Array]) -> SamplerOutput:
+        """Unpack the single transferred result array and assemble
+        per-group outputs; device logprobs are touched only by the rare
+        beam / prompt-logprobs paths."""
+        B, K = plan.max_best_of, plan.num_topk
+        w_int = 1 + B + K
+        packed = packed[:plan.num_rows]
+        ints = packed[:, :w_int]
+        floats = packed[:, w_int:].view(np.float32)
+        greedy = ints[:, 0]
+        random = ints[:, 1:1 + B]
+        topk_idx = ints[:, 1 + B:w_int]
+        lp_greedy = floats[:, 0]
+        lp_random = floats[:, 1:1 + B]
+        topk_vals = floats[:, 1 + B:1 + B + K]
+        if plan.tensors.do_mirostat:
+            new_mus = floats[:, 1 + B + K]
+            for row, seq_id in plan.row_to_seq.items():
+                _, params = plan.group_of.get(seq_id, (None, None))
+                if params is not None and params.mirostat_mode == 2:
+                    metadata.output_metadata.add(seq_id, "miro_mu",
+                                                 float(new_mus[row]))
+        return self._assemble(metadata, greedy, random, lp_greedy,
+                              lp_random, topk_vals, topk_idx, logprobs_dev)
 
     # -- helpers --
 
@@ -349,19 +446,22 @@ class Sampler:
             for seq_id in seq_ids
         }
 
-    def _make_keys(self, metadata: SamplingMetadata, rows: int,
+    def _key_parts(self, metadata: SamplingMetadata, rows: int,
                    row_to_seq: Dict[int, int],
-                   group_of: Dict[int, tuple]) -> jax.Array:
-        """Per-row PRNG keys, computed in ONE vectorized dispatch.
+                   group_of: Dict[int, tuple]):
+        """Per-row PRNG key ingredients (folded together on device).
 
         Seeded rows: base=request seed, salts=(output_len, sibling index)
         — reproducible regardless of batch composition or restarts.
-        Unseeded rows: base=process entropy ^ step, salt=row.
+        Unseeded rows: base mixes process entropy, step, and row so that
+        the per-step salt1 offset added by decode bursts (+t) never
+        collides across (row, step) diagonals.
         """
         bases = np.empty((rows,), dtype=np.int64)
         salt1 = np.empty((rows,), dtype=np.int32)
         salt2 = np.empty((rows,), dtype=np.int32)
-        unseeded_base = (self._base_seed ^ self._step) & 0x7FFFFFFF
+        step_mix = (self._base_seed ^ (self._step * 0x9E3779B1)) \
+            & 0x7FFFFFFF
         for row in range(rows):
             seq_id = row_to_seq.get(row)
             entry = group_of.get(seq_id) if seq_id is not None else None
@@ -372,11 +472,10 @@ class Sampler:
                     metadata.seq_data[seq_id].output_token_ids)
                 salt2[row] = seq_ids.index(seq_id)
             else:
-                bases[row] = unseeded_base
-                salt1[row] = row
+                bases[row] = (step_mix ^ (row * 0x85EBCA77)) & 0x7FFFFFFF
+                salt1[row] = 0
                 salt2[row] = 0
-        return _make_row_keys(jnp.asarray(bases), jnp.asarray(salt1),
-                              jnp.asarray(salt2))
+        return bases, salt1, salt2
 
     def _apply_logits_processors(self, logits, metadata):
         """Host-side per-request callables (logit_bias, grammar, min-tokens
@@ -388,23 +487,19 @@ class Sampler:
         arr = np.array(logits, dtype=np.float32)  # writable copy
         offset = 0
         for i, (seq_ids, params) in enumerate(metadata.seq_groups):
-            size = len(seq_ids)
-            output_tokens: List[List[int]] = []
+            # Prompt-logprob rows are never processed (reference
+            # `_apply_logits_processors` advances past them).
             if i < len(metadata.prompt_lens) and \
                     params.prompt_logprobs is not None:
-                n_prompt_rows = metadata.prompt_lens[i] - 1
-                size += n_prompt_rows
-                output_tokens.extend([[]] * n_prompt_rows)
+                offset += metadata.prompt_lens[i] - 1
             if params.logits_processors:
-                output_tokens.extend(
-                    metadata.seq_data[sid].output_token_ids
-                    for sid in seq_ids)
-                for j, toks in enumerate(output_tokens):
+                for j, sid in enumerate(seq_ids):
+                    toks = metadata.seq_data[sid].output_token_ids
                     row = arr[offset + j]
                     for proc in params.logits_processors:
                         row = proc(toks, row)
                     arr[offset + j] = row
-            offset += size
+            offset += len(seq_ids)
         return jnp.asarray(arr)
 
     def _assemble(self, metadata: SamplingMetadata, greedy: np.ndarray,

@@ -121,18 +121,32 @@ class SamplingTensors:
     do_token_bans: bool = struct.field(pytree_node=False, default=False)
 
 
-def _pad_2d(rows: List[List[int]], pad_value: int) -> np.ndarray:
-    width = max(1, max((len(r) for r in rows), default=1))
+def _pad_2d(rows: List[List[int]], pad_value: int,
+            width: Optional[int] = None) -> np.ndarray:
+    if width is None:
+        width = max(1, max((len(r) for r in rows), default=1))
     out = np.full((len(rows), width), pad_value, dtype=np.int32)
     for i, r in enumerate(rows):
-        out[i, :len(r)] = r
+        n = min(len(r), width)
+        out[i, :n] = r[:n]
     return out
+
+
+def _pow2_width(rows: List[List[int]], lo: int) -> int:
+    """Bucket the ragged width to a power of two so the compiled sampler
+    program's shape is stable as histories grow step to step."""
+    need = max((len(r) for r in rows), default=1)
+    w = lo
+    while w < need:
+        w *= 2
+    return w
 
 
 def build_sampling_tensors(
     metadata: SamplingMetadata,
     vocab_size: int,
     dtype=jnp.float32,
+    pad_to: Optional[int] = None,
 ) -> Tuple[SamplingTensors, Dict[int, int]]:
     """Flatten SamplingMetadata into SamplingTensors.
 
@@ -235,6 +249,41 @@ def build_sampling_tensors(
             banned_tokens.append(list(p.custom_token_bans))
             row_to_seq[len(temperatures) - 1] = seq_id
 
+    # Pad to the jitted program's row bucket with neutral knob rows
+    # (sampled results for pad rows are sliced off host-side).
+    num_rows = len(temperatures)
+    n_pad = max(0, (pad_to or 0) - num_rows)
+    if n_pad:
+        temperatures += [1.0] * n_pad
+        dynatemp_mins += [0.0] * n_pad
+        dynatemp_maxs += [0.0] * n_pad
+        dynatemp_exps += [1.0] * n_pad
+        top_ps += [1.0] * n_pad
+        top_ks += [vocab_size] * n_pad
+        top_as += [0.0] * n_pad
+        min_ps += [0.0] * n_pad
+        tfss += [1.0] * n_pad
+        eta += [0.0] * n_pad
+        eps += [0.0] * n_pad
+        typical += [1.0] * n_pad
+        smoothing += [0.0] * n_pad
+        miro_taus += [0.0] * n_pad
+        miro_etas += [0.0] * n_pad
+        miro_mus += [0.0] * n_pad
+        pres_pen += [0.0] * n_pad
+        freq_pen += [0.0] * n_pad
+        rep_pen += [1.0] * n_pad
+        prompt_tokens += [[]] * n_pad
+        output_tokens += [[]] * n_pad
+        banned_tokens += [[]] * n_pad
+
+    # Token-history tensors only exist when a stage reads them: a
+    # zero-width array otherwise, a pow2-bucketed width when used, so
+    # growing output histories don't recompile the sampler every step.
+    hist_width = _pow2_width(prompt_tokens + output_tokens, 32) \
+        if do["penalties"] else 0
+    bans_width = _pow2_width(banned_tokens, 8) if do["bans"] else 0
+
     f = lambda x: jnp.asarray(np.asarray(x, dtype=np.float32), dtype=dtype)
     tensors = SamplingTensors(
         temperatures=f(temperatures),
@@ -256,9 +305,12 @@ def build_sampling_tensors(
         presence_penalties=f(pres_pen),
         frequency_penalties=f(freq_pen),
         repetition_penalties=f(rep_pen),
-        prompt_tokens=jnp.asarray(_pad_2d(prompt_tokens, vocab_size)),
-        output_tokens=jnp.asarray(_pad_2d(output_tokens, vocab_size)),
-        banned_tokens=jnp.asarray(_pad_2d(banned_tokens, vocab_size)),
+        prompt_tokens=jnp.asarray(
+            _pad_2d(prompt_tokens, vocab_size, hist_width)),
+        output_tokens=jnp.asarray(
+            _pad_2d(output_tokens, vocab_size, hist_width)),
+        banned_tokens=jnp.asarray(
+            _pad_2d(banned_tokens, vocab_size, bans_width)),
         do_penalties=do["penalties"],
         do_temperatures=do["temperatures"],
         do_top_p_top_k=do["top_p_top_k"],

@@ -44,6 +44,19 @@ def write_to_kv_cache(
     >= num_pages*page_size so mode='drop' discards them.
     """
     num_kv_heads, num_pages, page_size, head_dim = k_pages.shape
+
+    # TPU: Pallas kernel with input_output_aliases — guaranteed in-place
+    # HBM update. The XLA scatter below is semantically identical but XLA
+    # wraps it in full-cache layout-conversion copies when the scattered
+    # values arrive late in the program (the transformer chain), costing
+    # tens of ms/step on multi-GB caches.
+    if jax.default_backend() == "tpu":
+        from aphrodite_tpu.ops.pallas.kv_write import (
+            can_use_pallas_writer, write_kv_pages)
+        if can_use_pallas_writer(k_pages.dtype, page_size, head_dim):
+            return write_kv_pages(key, value, k_pages, v_pages,
+                                  slot_mapping)
+
     k_flat = k_pages.reshape(num_kv_heads, num_pages * page_size, head_dim)
     v_flat = v_pages.reshape(num_kv_heads, num_pages * page_size, head_dim)
 

@@ -190,6 +190,29 @@ class BlockSpaceManager:
         self.gpu_allocator.free(last_block)
         return last_block.block_number, new_block.block_number
 
+    def burst_blocks_needed(self, seq: Sequence, num_ahead: int) -> int:
+        """Blocks to allocate so the table covers positions up to
+        seq.get_len()-1+num_ahead (multi-step decode pre-reservation)."""
+        table = self.block_tables[seq.seq_id]
+        needed = (seq.get_len() - 1 + num_ahead) // self.block_size + 1
+        return max(0, needed - len(table))
+
+    def has_unshared_tail(self, seq: Sequence) -> bool:
+        table = self.block_tables.get(seq.seq_id)
+        return bool(table) and table[-1].ref_count == 1
+
+    def reserve_slots(self, seq: Sequence, num_ahead: int) -> None:
+        """Append enough fresh blocks for `num_ahead` future tokens.
+
+        Only valid for unshared-tail sequences (no CoW can arise); the
+        device computes each burst step's slot from the block table, so
+        the pages must exist before the burst launches.
+        """
+        table = self.block_tables[seq.seq_id]
+        needed = (seq.get_len() - 1 + num_ahead) // self.block_size + 1
+        while len(table) < needed:
+            table.append(self.gpu_allocator.allocate())
+
     def fork(self, parent_seq: Sequence, child_seq: Sequence) -> None:
         src_block_table = self.block_tables[parent_seq.seq_id]
         self.block_tables[child_seq.seq_id] = src_block_table.copy()

@@ -342,6 +342,46 @@ class Scheduler:
                 ))
         return seq_group_metadata_list, scheduler_outputs
 
+    def reserve_decode_burst(self, seq_group_metadata_list,
+                             max_extra: int) -> int:
+        """Reserve KV pages so the next `1 + returned` decode steps can
+        run device-side without host scheduling (multi-step decode).
+
+        Grants the largest t <= max_extra for which every running
+        sequence's future slots fit in the free pool, allocates them, and
+        refreshes the metadata's block-table snapshots. Returns 0 (plain
+        single-step decode) when a shared tail makes slot positions
+        CoW-dependent.
+        """
+        seqs = [
+            seq for g in self.running
+            for seq in g.get_seqs(status=SequenceStatus.RUNNING)
+        ]
+        if not seqs:
+            return 0
+        for seq in seqs:
+            if not self.block_manager.has_unshared_tail(seq):
+                return 0
+        free = self.block_manager.gpu_allocator.get_num_free_blocks()
+        granted = 0
+        for t in range(1, max_extra + 1):
+            needed = sum(
+                self.block_manager.burst_blocks_needed(seq, t)
+                for seq in seqs)
+            if needed > free:
+                break
+            granted = t
+        if granted:
+            for seq in seqs:
+                self.block_manager.reserve_slots(seq, granted)
+            for md in seq_group_metadata_list:
+                for seq_id in md.block_tables:
+                    md.block_tables[seq_id] = [
+                        b.block_number
+                        for b in self.block_manager.block_tables[seq_id]
+                    ]
+        return granted
+
     def fork_seq(self, parent_seq: Sequence, child_seq: Sequence) -> None:
         self.block_manager.fork(parent_seq, child_seq)
 

@@ -6,32 +6,51 @@ Prints ONE JSON line:
 Baseline: the reference's peak batched output throughput for Mistral-7B
 fp16 on RTX 4090 is 5489.3 out-tok/s (reference README.md:59; BASELINE.md).
 This harness measures aggregate output tokens/s through the full engine
-(scheduler + paged cache + jitted model + sampler) on whatever device jax
-exposes. Until a 7B checkpoint runs on real TPU hardware the number is a
-same-methodology proxy (dummy-weight model sized by BENCH_MODEL env:
-tiny|7b), so vs_baseline is only meaningful for the 7b config.
+(scheduler + paged cache + jitted model + fused sampler) on whatever
+device jax exposes.
+
+Model selection (BENCH_MODEL env): "7b" = Mistral-7B-shaped dummy-weight
+model (default on TPU — same matmul/KV shapes and dtype as the baseline
+row, so vs_baseline is apples-to-apples methodology-wise), "tiny" =
+20M-param debug model (default on CPU; vs_baseline not meaningful).
+
+Warmup policy: the warmup run uses the SAME batch size and prompt length
+as the timed run so every compile bucket the timed region hits (prefill
+batch/seq bucket, decode batch bucket) is already cached — compile time
+never leaks into the measurement.
 """
 from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 BASELINE_TOKS = 5489.3     # reference README.md:59 (Mistral-7B fp16)
 
 
+def _log(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
 def main() -> None:
-    size = os.environ.get("BENCH_MODEL", "tiny")
     import jax
+    on_accel = jax.default_backend() not in ("cpu",)
+    size = os.environ.get("BENCH_MODEL", "7b" if on_accel else "tiny")
 
     if size == "7b":
+        # Mistral-7B geometry (reference baseline row).
         hidden, layers, heads, kv_heads, inter = 4096, 32, 32, 8, 14336
         vocab = 32000
-        batch, steps, prompt_len = 64, 64, 128
+        batch = int(os.environ.get("BENCH_BATCH", "112"))
+        steps = int(os.environ.get("BENCH_STEPS", "96"))
+        prompt_len = int(os.environ.get("BENCH_PROMPT", "32"))
     else:
         hidden, layers, heads, kv_heads, inter = 512, 4, 8, 4, 1024
         vocab = 2048
-        batch, steps, prompt_len = 32, 32, 64
+        batch = int(os.environ.get("BENCH_BATCH", "32"))
+        steps = int(os.environ.get("BENCH_STEPS", "32"))
+        prompt_len = int(os.environ.get("BENCH_PROMPT", "64"))
 
     import json as _json
     import tempfile
@@ -59,21 +78,33 @@ def main() -> None:
     from aphrodite_tpu.engine.aphrodite_engine import AphroditeEngine
     from aphrodite_tpu.engine.args_tools import EngineArgs
 
+    t0 = time.perf_counter()
+    multi_step = int(os.environ.get("BENCH_MULTI_STEP", "16"))
     engine = AphroditeEngine.from_engine_args(EngineArgs(
         model=tmp, tokenizer=tmp, load_format="dummy", dtype="bfloat16",
         max_model_len=2048, max_num_seqs=batch, disable_log_stats=True,
-        skip_tokenizer_init=True))
+        skip_tokenizer_init=True, multi_step=multi_step))
+    _log(f"engine up in {time.perf_counter() - t0:.1f}s "
+         f"(model={size}, batch={batch}, steps={steps}, "
+         f"prompt={prompt_len})")
 
     sp = SamplingParams(temperature=0.0, max_tokens=steps,
                         ignore_eos=True)
     rng_tokens = [[(7 * i + j) % (vocab - 10) + 5
                    for j in range(prompt_len)] for i in range(batch)]
 
-    # Warmup: compile prefill+decode buckets.
-    _run(engine, sp, rng_tokens[:2], steps)
+    # Warmup: full batch for a few steps — compiles the exact prefill and
+    # decode buckets the timed run uses.
+    warm_sp = SamplingParams(temperature=0.0, max_tokens=min(8, steps),
+                             ignore_eos=True)
+    t0 = time.perf_counter()
+    _run(engine, warm_sp, rng_tokens, min(8, steps))
+    _log(f"warmup done in {time.perf_counter() - t0:.1f}s")
+
     t0 = time.perf_counter()
     total_out = _run(engine, sp, rng_tokens, steps)
     dt = time.perf_counter() - t0
+    _log(f"timed run: {total_out} tokens in {dt:.1f}s")
 
     toks = total_out / dt
     print(json.dumps({

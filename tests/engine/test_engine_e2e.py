@@ -129,6 +129,32 @@ def test_fp8_kv_cache(tiny_model_dir):
     assert len(out[0].outputs[0].token_ids) == 5
 
 
+def test_multi_step_decode_matches_single_step(tiny_model_dir):
+    """Device-side K-step decode bursts must produce exactly the tokens
+    a step-at-a-time engine produces (greedy + seeded random), including
+    mid-burst stop handling (max_tokens not a multiple of K)."""
+    from aphrodite_tpu.endpoints.llm import LLM
+    prompts = ["the quick brown fox", "hello", "paged attention kernels",
+               "tensor parallel meshes"]
+    sps = [
+        SamplingParams(temperature=0.0, max_tokens=13, ignore_eos=True),
+        SamplingParams(temperature=1.0, seed=7, top_p=0.9, max_tokens=13,
+                       ignore_eos=True),
+    ]
+
+    def run(multi_step):
+        llm = LLM(model=tiny_model_dir, load_format="dummy",
+                  dtype="float32", block_size=16, max_model_len=256,
+                  max_num_seqs=8, swap_space=0.01, multi_step=multi_step)
+        results = []
+        for sp in sps:
+            out = llm.generate(prompts, sp)
+            results.append([tuple(o.outputs[0].token_ids) for o in out])
+        return results
+
+    assert run(1) == run(4)
+
+
 def test_prefix_caching_reuse(tiny_model_dir):
     """Second request sharing a prefix must produce identical greedy
     output while recomputing only the suffix (prefix KV reused)."""
@@ -136,18 +162,23 @@ def test_prefix_caching_reuse(tiny_model_dir):
     llm = LLM(model=tiny_model_dir, load_format="dummy", dtype="float32",
               block_size=16, max_model_len=256, max_num_seqs=8,
               swap_space=0.01)
-    prompt = " ".join(["the quick brown fox jumps"] * 6)
-    tok = llm.get_tokenizer()
-    n_prompt = len(tok.encode(prompt))
-    prefix_pos = (n_prompt // 2) // 16 * 16
+    # Explicit token ids: the tiny BPE tokenizer compresses repeated text
+    # too well for a string prompt to guarantee a >=16-token prefix.
+    vocab = llm.get_tokenizer().vocab_size
+    prompt_ids = [(13 * i + 7) % (vocab - 10) + 5 for i in range(64)]
+    prefix_pos = 32
     assert prefix_pos >= 16
 
     sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
-    no_prefix = llm.generate([prompt], sp)[0].outputs[0].token_ids
-    first = llm.generate([prompt], sp,
-                         prefix_pos=prefix_pos)[0].outputs[0].token_ids
-    second = llm.generate([prompt], sp,
-                          prefix_pos=prefix_pos)[0].outputs[0].token_ids
+
+    def run(prefix=None):
+        out = llm.generate(prompt_token_ids=[list(prompt_ids)],
+                           sampling_params=sp, prefix_pos=prefix)
+        return out[0].outputs[0].token_ids
+
+    no_prefix = run()
+    first = run(prefix_pos)
+    second = run(prefix_pos)
     assert first == no_prefix       # computing the prefix: same result
     assert second == no_prefix      # reusing cached prefix KV: same
 
