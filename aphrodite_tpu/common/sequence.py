@@ -221,6 +221,11 @@ class SequenceGroup:
         self.prefix = prefix
         self.lora_request = lora_request
         self.prompt_logprobs: Optional[PromptLogprobs] = None
+        # Latency bookkeeping (reference sequence.py RequestMetrics):
+        # stamped by the engine as tokens arrive, read by _get_stats.
+        self.first_token_time: Optional[float] = None
+        self.last_token_time: float = arrival_time
+        self.finished_time: Optional[float] = None
 
     @property
     def prompt(self) -> str:

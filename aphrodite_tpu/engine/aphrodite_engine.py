@@ -83,6 +83,10 @@ class AphroditeEngine:
         self.stat_logger = StatLogger(
             labels=dict(model_name=model_config.model)) if log_stats \
             else None
+        # Latency samples accumulated between stat-logger flushes.
+        self._ttft_samples: List[float] = []
+        self._tpot_samples: List[float] = []
+        self._e2e_samples: List[float] = []
 
     # -- construction --
 
@@ -212,7 +216,8 @@ class AphroditeEngine:
                     or abs(p.repetition_penalty - 1.0) >= 1e-5):
                 return 1
             data = next(iter(md.seq_data.values()))
-            remaining.append(p.max_tokens - data.get_output_len())
+            if p.max_tokens is not None:
+                remaining.append(p.max_tokens - data.get_output_len())
             remaining.append(self.scheduler_config.max_model_len -
                              data.get_len())
         want = max(1, min([max_steps] + remaining))
@@ -231,6 +236,8 @@ class AphroditeEngine:
                 if seq_group.is_finished():
                     continue        # burst overran this group's stop
                 self._process_sequence_group_outputs(seq_group, outputs)
+        self._record_latencies(scheduled_seq_groups,
+                               num_steps=len(outputs_list))
         self.scheduler.free_finished_seq_groups()
 
         request_outputs = [
@@ -239,7 +246,8 @@ class AphroditeEngine:
         for seq_group in scheduler_outputs.ignored_seq_groups:
             request_outputs.append(RequestOutput.from_seq_group(seq_group))
         if self.stat_logger is not None:
-            self.stat_logger.log(self._get_stats(scheduler_outputs))
+            self.stat_logger.log(self._get_stats(
+                scheduler_outputs, num_steps=len(outputs_list)))
         return request_outputs
 
     # -- output processing (reference :550-752) --
@@ -250,6 +258,7 @@ class AphroditeEngine:
         scheduled_seq_groups = scheduler_outputs.scheduled_seq_groups
         for seq_group, outputs in zip(scheduled_seq_groups, output):
             self._process_sequence_group_outputs(seq_group, outputs)
+        self._record_latencies(scheduled_seq_groups, num_steps=1)
 
         self.scheduler.free_finished_seq_groups()
 
@@ -263,6 +272,27 @@ class AphroditeEngine:
             self.stat_logger.log(
                 self._get_stats(scheduler_outputs))
         return request_outputs
+
+    def _record_latencies(self, scheduled_seq_groups,
+                          num_steps: int) -> None:
+        """Stamp per-request TTFT / per-token / e2e latency samples
+        (reference _get_stats aphrodite_engine.py:830-891; the reference
+        stamps inside RequestMetrics, we batch per processed round). A
+        burst of K tokens records K amortized per-token samples."""
+        if self.stat_logger is None:
+            return          # samples are only drained by the stat logger
+        now = time.monotonic()
+        for group in scheduled_seq_groups:
+            if group.first_token_time is None:
+                group.first_token_time = now
+                self._ttft_samples.append(now - group.arrival_time)
+            else:
+                dt = (now - group.last_token_time) / max(1, num_steps)
+                self._tpot_samples.extend([dt] * num_steps)
+            group.last_token_time = now
+            if group.is_finished() and group.finished_time is None:
+                group.finished_time = now
+                self._e2e_samples.append(now - group.arrival_time)
 
     def _process_sequence_group_outputs(
             self, seq_group: SequenceGroup,
@@ -390,9 +420,11 @@ class AphroditeEngine:
             attainable = best_running.get_beam_search_score(length_penalty)
         else:   # "never": assume the best case over all future lengths
             if length_penalty > 0.0:
-                max_possible = max(
-                    best_running.get_prompt_len() + params.max_tokens,
-                    self.scheduler_config.max_model_len)
+                horizon = self.scheduler_config.max_model_len \
+                    if params.max_tokens is None \
+                    else best_running.get_prompt_len() + params.max_tokens
+                max_possible = max(horizon,
+                                   self.scheduler_config.max_model_len)
                 attainable = best_running.get_beam_search_score(
                     length_penalty, seq_len=max_possible)
             else:
@@ -452,7 +484,8 @@ class AphroditeEngine:
     # -- stats (reference _get_stats :830-891) --
 
     def _get_stats(self,
-                   scheduler_outputs: Optional[SchedulerOutputs]) -> Stats:
+                   scheduler_outputs: Optional[SchedulerOutputs],
+                   num_steps: int = 1) -> Stats:
         now = time.monotonic()
         num_total_gpu = self.cache_config.num_gpu_blocks or 1
         num_free_gpu = \
@@ -471,9 +504,14 @@ class AphroditeEngine:
             if scheduler_outputs.prompt_run:
                 num_prompt_tokens = scheduler_outputs.num_batched_tokens
             else:
+                # A multi-step burst produces num_steps tokens per seq in
+                # one scheduling round.
                 num_generation_tokens = \
-                    scheduler_outputs.num_batched_tokens
+                    scheduler_outputs.num_batched_tokens * num_steps
 
+        ttfts, self._ttft_samples = self._ttft_samples, []
+        tpots, self._tpot_samples = self._tpot_samples, []
+        e2es, self._e2e_samples = self._e2e_samples, []
         return Stats(
             now=now,
             num_running=len(self.scheduler.running),
@@ -483,6 +521,6 @@ class AphroditeEngine:
             cpu_cache_usage=cpu_cache_usage,
             num_prompt_tokens=num_prompt_tokens,
             num_generation_tokens=num_generation_tokens,
-            time_to_first_tokens=[],
-            time_per_output_tokens=[],
-            time_e2e_requests=[])
+            time_to_first_tokens=ttfts,
+            time_per_output_tokens=tpots,
+            time_e2e_requests=e2es)

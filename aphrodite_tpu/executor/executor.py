@@ -89,11 +89,13 @@ class TPUExecutor:
 
         self.lora_manager = None
         if lora_config is not None:
+            from aphrodite_tpu.lora.models import layouts_from_model
             from aphrodite_tpu.lora.worker_manager import WorkerLoRAManager
             self.lora_manager = WorkerLoRAManager(
                 lora_config,
                 write_slot_fn=self.model_runner.write_lora_slot,
-                clear_slot_fn=self.model_runner.clear_lora_slot)
+                clear_slot_fn=self.model_runner.clear_lora_slot,
+                module_layouts=layouts_from_model(self.model))
 
     # -- sizing --
 
@@ -169,6 +171,18 @@ class TPUExecutor:
 
     # -- step execution --
 
+    def _pre_step(self, seq_group_metadata_list, blocks_to_swap_in,
+                  blocks_to_swap_out) -> None:
+        """Swaps + LoRA activation shared by single-step and burst."""
+        if blocks_to_swap_out:
+            self.cache_engine.swap_out(blocks_to_swap_out)
+        if blocks_to_swap_in:
+            self.cache_engine.swap_in(blocks_to_swap_in)
+        if self.lora_manager is not None and seq_group_metadata_list:
+            self.lora_manager.set_active_adapters(
+                [md.lora_request for md in seq_group_metadata_list])
+            self.model_runner.lora_slot_of = self.lora_manager.slot_of
+
     def execute_model(
         self,
         seq_group_metadata_list: List[SequenceGroupMetadata],
@@ -176,16 +190,8 @@ class TPUExecutor:
         blocks_to_swap_out: Dict[int, int],
         blocks_to_copy: Dict[int, List[int]],
     ) -> SamplerOutput:
-        if blocks_to_swap_out:
-            self.cache_engine.swap_out(blocks_to_swap_out)
-        if blocks_to_swap_in:
-            self.cache_engine.swap_in(blocks_to_swap_in)
-
-        if self.lora_manager is not None and seq_group_metadata_list:
-            self.lora_manager.set_active_adapters(
-                [md.lora_request for md in seq_group_metadata_list])
-            self.model_runner.lora_slot_of = self.lora_manager.slot_of
-
+        self._pre_step(seq_group_metadata_list, blocks_to_swap_in,
+                       blocks_to_swap_out)
         output, new_caches = self.model_runner.execute_model(
             seq_group_metadata_list, self.cache_engine.kv_caches,
             blocks_to_copy)
@@ -202,14 +208,8 @@ class TPUExecutor:
     ) -> List[SamplerOutput]:
         """Multi-step decode: one scheduling round drives `num_steps`
         device iterations (see ModelRunner.execute_decode_burst)."""
-        if blocks_to_swap_out:
-            self.cache_engine.swap_out(blocks_to_swap_out)
-        if blocks_to_swap_in:
-            self.cache_engine.swap_in(blocks_to_swap_in)
-        if self.lora_manager is not None and seq_group_metadata_list:
-            self.lora_manager.set_active_adapters(
-                [md.lora_request for md in seq_group_metadata_list])
-            self.model_runner.lora_slot_of = self.lora_manager.slot_of
+        self._pre_step(seq_group_metadata_list, blocks_to_swap_in,
+                       blocks_to_swap_out)
         outputs, new_caches = self.model_runner.execute_decode_burst(
             seq_group_metadata_list, self.cache_engine.kv_caches,
             num_steps, blocks_to_copy)

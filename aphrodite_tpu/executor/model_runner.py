@@ -384,21 +384,26 @@ class ModelRunner:
 
     # ---- public API ----
 
+    def _apply_block_copies(self, kv_caches, blocks_to_copy):
+        """CoW copies scheduled this round, applied before the step."""
+        if not blocks_to_copy:
+            return kv_caches
+        src, dst = [], []
+        for s, ds in blocks_to_copy.items():
+            for d in ds:
+                src.append(s)
+                dst.append(d)
+        return self._copy_fn(kv_caches,
+                             jnp.asarray(src, dtype=jnp.int32),
+                             jnp.asarray(dst, dtype=jnp.int32))
+
     def execute_model(
         self,
         seq_group_metadata_list: List[SequenceGroupMetadata],
         kv_caches: List[Tuple[jax.Array, jax.Array]],
         blocks_to_copy: Optional[Dict[int, List[int]]] = None,
     ) -> Tuple[SamplerOutput, List[Tuple[jax.Array, jax.Array]]]:
-        if blocks_to_copy:
-            src, dst = [], []
-            for s, ds in blocks_to_copy.items():
-                for d in ds:
-                    src.append(s)
-                    dst.append(d)
-            kv_caches = self._copy_fn(kv_caches,
-                                      jnp.asarray(src, dtype=jnp.int32),
-                                      jnp.asarray(dst, dtype=jnp.int32))
+        kv_caches = self._apply_block_copies(kv_caches, blocks_to_copy)
 
         if not seq_group_metadata_list:
             return [], kv_caches
@@ -456,15 +461,7 @@ class ModelRunner:
         (the stacked packed results). Eligibility (single-seq greedy/
         random groups, no history-dependent sampling stages) is enforced
         by the engine."""
-        if blocks_to_copy:
-            src, dst = [], []
-            for s, ds in blocks_to_copy.items():
-                for d in ds:
-                    src.append(s)
-                    dst.append(d)
-            kv_caches = self._copy_fn(kv_caches,
-                                      jnp.asarray(src, dtype=jnp.int32),
-                                      jnp.asarray(dst, dtype=jnp.int32))
+        kv_caches = self._apply_block_copies(kv_caches, blocks_to_copy)
 
         inputs, sampling = self._prepare_decode(seq_group_metadata_list)
         padded = inputs["input_ids"].shape[0]

@@ -62,10 +62,17 @@ class LoRAModel:
         self.loras = loras
 
     @classmethod
-    def from_local_checkpoint(cls, path: str,
-                              lora_id: int) -> "LoRAModel":
+    def from_local_checkpoint(cls, path: str, lora_id: int,
+                              module_layouts: Optional[Dict[str, Dict]]
+                              = None) -> "LoRAModel":
         """Load a peft-format adapter dir (adapter_config.json +
-        adapter_model.{safetensors,bin}); reference `models.py:220`."""
+        adapter_model.{safetensors,bin}); reference `models.py:220`.
+
+        `module_layouts` maps merged-module key -> {shard_id: (offset,
+        size)} from the target layer's true layout (reference
+        PackedLoRALayerWeights.pack keeps None placeholders for absent
+        sub-modules so slices stay aligned; we place each B block at the
+        layer-derived offset instead)."""
         with open(os.path.join(path, "adapter_config.json")) as f:
             config = json.load(f)
         rank = config["r"]
@@ -129,38 +136,105 @@ class LoRAModel:
                 merged.setdefault(key, []).append((shard_id, a, b))
 
             for key, pieces in merged.items():
-                loras[key] = _merge_block_diagonal(key, pieces)
+                layout = (module_layouts or {}).get(key)
+                loras[key] = _merge_block_diagonal(key, pieces, layout)
         return cls(lora_id, rank, loras)
 
 
-def _merge_block_diagonal(key: str, pieces) -> LoRALayerWeights:
-    """Merge per-projection (A, B) into block-diagonal merged-layer
-    (A [in, R], B [R, out_total]) where R = sum of piece ranks.
+def layouts_from_model(model) -> Dict[str, Dict]:
+    """Build {merged key -> {shard_id: (offset, size)}} from the live
+    model's packed linear layers (QKVParallelLinear.shard_offsets /
+    MergedColumnParallelLinear.output_sizes), so adapters that target a
+    SUBSET of a packed layer still place each B block at its true output
+    slice."""
+    layouts: Dict[str, Dict] = {}
+    for layer in getattr(model, "layers", []):
+        prefix = getattr(layer, "prefix", None)
+        if prefix is None:
+            continue
+        attn = getattr(layer, "self_attn", None)
+        qkv = getattr(attn, "qkv_proj", None) if attn is not None else None
+        if qkv is not None and hasattr(qkv, "shard_offsets"):
+            layouts[f"{prefix}.self_attn.qkv_proj"] = dict(
+                qkv.shard_offsets())
+        mlp = getattr(layer, "mlp", None)
+        gu = getattr(mlp, "gate_up_proj", None) if mlp is not None else None
+        sizes = getattr(gu, "output_sizes", None)
+        if sizes:
+            d, off = {}, 0
+            for i, s in enumerate(sizes):
+                d[i] = (off, s)
+                off += s
+            layouts[f"{prefix}.mlp.gate_up_proj"] = d
+    return layouts
 
-    Output slice offsets follow the merged layout: q|k|v in checkpoint
-    order for qkv, gate|up for gate_up (matching QKVParallelLinear /
-    MergedColumnParallelLinear shard placement).
-    """
+
+def _merge_block_diagonal(key: str, pieces,
+                          layout: Optional[Dict] = None
+                          ) -> LoRALayerWeights:
+    """Merge per-projection (A, B) into block-diagonal merged-layer
+    (A [in, R], B [R, out_total]) where R = sum of PRESENT piece ranks.
+
+    Output offsets come from `layout` (the target layer's true shard
+    placement) when available, so an adapter targeting only q+v still
+    writes the v delta at offset q_out + k_out — absent projections
+    simply contribute no rank columns and leave their slice zero. With
+    no layout we fall back to inferring the gap sizes for the known
+    packed families (qkv: a missing k/v shard is the same width as its
+    sibling; gate/up: equal widths)."""
     order = {"q": 0, "k": 1, "v": 2, 0: 0, 1: 1, None: 0}
     pieces = sorted(pieces, key=lambda p: order[p[0]])
     if len(pieces) == 1 and pieces[0][0] is None:
         _, a, b = pieces[0]
         return LoRALayerWeights(a, b)
 
+    sizes_of = {sid: b.shape[1] for sid, _, b in pieces}
+    if layout is not None:
+        offsets = {sid: off for sid, (off, _) in layout.items()}
+        total_out = max(off + size for off, size in layout.values())
+        for sid, _, b in pieces:
+            if sid not in layout:
+                raise ValueError(
+                    f"LoRA shard {sid!r} not in layout of {key}")
+            if b.shape[1] != layout[sid][1]:
+                raise ValueError(
+                    f"LoRA B width {b.shape[1]} != layer shard width "
+                    f"{layout[sid][1]} for {key}.{sid}")
+    else:
+        expected = ["q", "k", "v"] if any(
+            s in sizes_of for s in ("q", "k", "v")) else \
+            list(range(max(sizes_of) + 1))
+        full_sizes = []
+        for sid in expected:
+            if sid in sizes_of:
+                full_sizes.append(sizes_of[sid])
+            elif sid in ("k", "v"):
+                sib = sizes_of.get("v" if sid == "k" else "k")
+                if sib is None:
+                    raise ValueError(
+                        f"Cannot infer width of absent shard {sid!r} in "
+                        f"{key}; pass module_layouts")
+                full_sizes.append(sib)
+            else:
+                sib = next(iter(sizes_of.values()))
+                full_sizes.append(sib)
+        offsets, off = {}, 0
+        for sid, s in zip(expected, full_sizes):
+            offsets[sid] = off
+            off += s
+        total_out = off
+
     total_rank = sum(p[1].shape[1] for p in pieces)
     in_features = pieces[0][1].shape[0]
-    out_sizes = [p[2].shape[1] for p in pieces]
-    total_out = sum(out_sizes)
     a_merged = np.zeros((in_features, total_rank), dtype=np.float32)
     b_merged = np.zeros((total_rank, total_out), dtype=np.float32)
     r_off = 0
-    o_off = 0
-    for (_, a, b), out_size in zip(pieces, out_sizes):
+    for sid, a, b in pieces:
         r = a.shape[1]
         a_merged[:, r_off:r_off + r] = a
-        b_merged[r_off:r_off + r, o_off:o_off + out_size] = b
+        b_merged[r_off:r_off + r,
+                 offsets[sid]:offsets[sid] + b.shape[1]] = b
         r_off += r
-        o_off += out_size
     return LoRALayerWeights(a_merged, b_merged)
 
 

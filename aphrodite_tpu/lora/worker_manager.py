@@ -19,8 +19,9 @@ class WorkerLoRAManager:
     batch's set active in device slots."""
 
     def __init__(self, lora_config: LoRAConfig, write_slot_fn,
-                 clear_slot_fn) -> None:
+                 clear_slot_fn, module_layouts=None) -> None:
         self.lora_config = lora_config
+        self.module_layouts = module_layouts
         self.manager = LRUCacheLoRAModelManager(lora_config,
                                                 write_slot_fn,
                                                 clear_slot_fn)
@@ -30,7 +31,8 @@ class WorkerLoRAManager:
             self.manager.touch(lora_request.lora_int_id)
             return False
         lora = LoRAModel.from_local_checkpoint(
-            lora_request.lora_local_path, lora_request.lora_int_id)
+            lora_request.lora_local_path, lora_request.lora_int_id,
+            module_layouts=self.module_layouts)
         return self.manager.add_lora(lora)
 
     def remove_lora(self, lora_id: int) -> bool:

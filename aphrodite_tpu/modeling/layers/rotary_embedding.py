@@ -114,11 +114,16 @@ class LinearScalingRotaryEmbedding(RotaryEmbedding):
 
 
 class DynamicNTKScalingRotaryEmbedding(RotaryEmbedding):
-    """NTK-aware base rescaling for the extended range (reference `:187`).
+    """NTK-aware base rescaling for the extended range (reference `:186-223`).
 
-    The reference recomputes base per-seq-len dynamically; here the cache is
-    built once for the full extended window using the max-length base, which
-    is equivalent for serving at a fixed max_model_len.
+    Matches the reference exactly: one static cache for the full extended
+    window built with the max-length base (reference `_compute_cos_sin_cache`
+    `:205-215` does the same). Note this diverges from HF transformers'
+    truly-dynamic variant, which recomputes the base from the running
+    seq_len and so uses the ORIGINAL base while seq_len <= original
+    max_position_embeddings; serving with a paged KV cache can't re-rotate
+    cached keys when the base changes, so the static choice is the only
+    coherent one (and is what the reference ships).
     """
 
     def __init__(self, head_size, rotary_dim, max_position_embeddings, base,

@@ -362,7 +362,12 @@ class Scheduler:
         for seq in seqs:
             if not self.block_manager.has_unshared_tail(seq):
                 return 0
-        free = self.block_manager.gpu_allocator.get_num_free_blocks()
+        # Leave the allocator watermark untouched so speculative burst
+        # reservations never starve prompt admission (can_allocate) or
+        # peer decode groups (can_append_slot); also keep waiting work
+        # from stalling behind long bursts.
+        free = (self.block_manager.gpu_allocator.get_num_free_blocks() -
+                self.block_manager.watermark_blocks)
         granted = 0
         for t in range(1, max_extra + 1):
             needed = sum(

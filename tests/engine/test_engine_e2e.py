@@ -155,6 +155,59 @@ def test_multi_step_decode_matches_single_step(tiny_model_dir):
     assert run(1) == run(4)
 
 
+def test_latency_stats_recorded(tiny_model_dir):
+    """TTFT / per-token / e2e latency samples must reach the stat logger
+    (reference _get_stats aphrodite_engine.py:830-891 feeds the three
+    Prometheus histograms)."""
+    from aphrodite_tpu.endpoints.llm import LLM
+    llm = LLM(model=tiny_model_dir, load_format="dummy", dtype="float32",
+              block_size=16, max_model_len=256, max_num_seqs=4,
+              swap_space=0.01, disable_log_stats=False)
+    engine = llm.engine
+    seen = {"ttft": [], "tpot": [], "e2e": []}
+    orig_log = engine.stat_logger.log
+
+    def spy(stats):
+        seen["ttft"] += stats.time_to_first_tokens
+        seen["tpot"] += stats.time_per_output_tokens
+        seen["e2e"] += stats.time_e2e_requests
+        return orig_log(stats)
+
+    engine.stat_logger.log = spy
+    llm.generate(["the quick brown", "hello"],
+                 SamplingParams(temperature=0.0, max_tokens=6,
+                                ignore_eos=True))
+    assert len(seen["ttft"]) == 2          # one per request
+    assert len(seen["e2e"]) == 2
+    assert len(seen["tpot"]) >= 2 * 4      # >= (max_tokens-1 rounds) x 2
+    assert all(t >= 0 for t in seen["ttft"] + seen["tpot"] + seen["e2e"])
+    assert all(t < 60 for t in seen["e2e"])
+
+
+def test_multi_step_latency_stats(tiny_model_dir):
+    """Burst rounds record K amortized per-token samples and K-scaled
+    generation-token counts."""
+    from aphrodite_tpu.endpoints.llm import LLM
+    llm = LLM(model=tiny_model_dir, load_format="dummy", dtype="float32",
+              block_size=16, max_model_len=256, max_num_seqs=4,
+              swap_space=0.01, disable_log_stats=False, multi_step=4)
+    engine = llm.engine
+    seen = {"tpot": [], "gen": 0}
+    orig_log = engine.stat_logger.log
+
+    def spy(stats):
+        seen["tpot"] += stats.time_per_output_tokens
+        seen["gen"] += stats.num_generation_tokens
+        return orig_log(stats)
+
+    engine.stat_logger.log = spy
+    llm.generate(["the quick brown"],
+                 SamplingParams(temperature=0.0, max_tokens=9,
+                                ignore_eos=True))
+    assert len(seen["tpot"]) == 8          # 9 tokens - 1 first
+    assert seen["gen"] == 8                # decode tokens counted K-wise
+
+
 def test_prefix_caching_reuse(tiny_model_dir):
     """Second request sharing a prefix must produce identical greedy
     output while recomputing only the suffix (prefix KV reused)."""

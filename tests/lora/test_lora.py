@@ -63,6 +63,69 @@ def test_merge_block_diagonal():
                                rtol=1e-4, atol=1e-5)
 
 
+def test_merge_subset_uses_layout_offsets():
+    """An adapter targeting only q+v (no k) must place the v delta at
+    q_out + k_out, not at q_out (ADVICE r1: subset-packed corruption)."""
+    a_q = rng.randn(IN, 4).astype(np.float32)
+    b_q = rng.randn(4, 16).astype(np.float32)
+    a_v = rng.randn(IN, 4).astype(np.float32)
+    b_v = rng.randn(4, 8).astype(np.float32)
+    layout = {"q": (0, 16), "k": (16, 8), "v": (24, 8)}
+    x = rng.randn(5, IN).astype(np.float32)
+    for use_layout in (True, False):   # False exercises gap inference
+        merged = _merge_block_diagonal(
+            "x.qkv_proj", [("q", a_q, b_q), ("v", a_v, b_v)],
+            layout if use_layout else None)
+        assert merged.b.shape == (8, 32)
+        delta = (x @ merged.a) @ merged.b
+        np.testing.assert_allclose(delta[:, :16], (x @ a_q) @ b_q,
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(delta[:, 16:24], 0.0, atol=1e-7)
+        np.testing.assert_allclose(delta[:, 24:], (x @ a_v) @ b_v,
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_merge_gate_up_subset():
+    """up-only adapter lands in the up slice (index 1), gate slice zero."""
+    a_u = rng.randn(IN, 4).astype(np.float32)
+    b_u = rng.randn(4, 24).astype(np.float32)
+    merged = _merge_block_diagonal("x.gate_up_proj", [(1, a_u, b_u)], None)
+    assert merged.b.shape == (4, 48)
+    x = rng.randn(3, IN).astype(np.float32)
+    delta = (x @ merged.a) @ merged.b
+    np.testing.assert_allclose(delta[:, :24], 0.0, atol=1e-7)
+    np.testing.assert_allclose(delta[:, 24:], (x @ a_u) @ b_u,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_layouts_from_model():
+    import jax.numpy as jnp
+    from aphrodite_tpu.lora.models import layouts_from_model
+    from aphrodite_tpu.modeling.models.llama import LlamaForCausalLM
+
+    class Cfg:
+        architectures = ["LlamaForCausalLM"]
+        vocab_size = 128
+        hidden_size = 64
+        intermediate_size = 128
+        num_hidden_layers = 2
+        num_attention_heads = 4
+        num_key_value_heads = 2
+        rms_norm_eps = 1e-6
+        max_position_embeddings = 256
+        rope_theta = 10000.0
+        tie_word_embeddings = False
+
+    model = LlamaForCausalLM(Cfg(), dtype=jnp.float32)
+    layouts = layouts_from_model(model)
+    key = "model.layers.0.self_attn.qkv_proj"
+    assert key in layouts
+    q_off, q_size = layouts[key]["q"]
+    k_off, k_size = layouts[key]["k"]
+    v_off, v_size = layouts[key]["v"]
+    assert q_off == 0 and k_off == q_size and v_off == q_size + k_size
+
+
 def make_adapter_dir(tmp_path, name, scale, hidden=64, kv=32, inter=128,
                      rank=8, num_layers=2):
     """Write a peft-format adapter dir for the tiny Llama fixture."""
