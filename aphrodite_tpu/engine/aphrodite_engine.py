@@ -231,13 +231,15 @@ class AphroditeEngine:
             self, outputs_list: List[SamplerOutput],
             scheduler_outputs: SchedulerOutputs) -> List[RequestOutput]:
         scheduled_seq_groups = scheduler_outputs.scheduled_seq_groups
+        tokens_of = {id(g): 0 for g in scheduled_seq_groups}
         for output in outputs_list:
             for seq_group, outputs in zip(scheduled_seq_groups, output):
                 if seq_group.is_finished():
                     continue        # burst overran this group's stop
                 self._process_sequence_group_outputs(seq_group, outputs)
+                tokens_of[id(seq_group)] += 1
         self._record_latencies(scheduled_seq_groups,
-                               num_steps=len(outputs_list))
+                               tokens_of=tokens_of)
         self.scheduler.free_finished_seq_groups()
 
         request_outputs = [
@@ -247,7 +249,8 @@ class AphroditeEngine:
             request_outputs.append(RequestOutput.from_seq_group(seq_group))
         if self.stat_logger is not None:
             self.stat_logger.log(self._get_stats(
-                scheduler_outputs, num_steps=len(outputs_list)))
+                scheduler_outputs,
+                generation_tokens=sum(tokens_of.values())))
         return request_outputs
 
     # -- output processing (reference :550-752) --
@@ -258,7 +261,7 @@ class AphroditeEngine:
         scheduled_seq_groups = scheduler_outputs.scheduled_seq_groups
         for seq_group, outputs in zip(scheduled_seq_groups, output):
             self._process_sequence_group_outputs(seq_group, outputs)
-        self._record_latencies(scheduled_seq_groups, num_steps=1)
+        self._record_latencies(scheduled_seq_groups)
 
         self.scheduler.free_finished_seq_groups()
 
@@ -274,21 +277,24 @@ class AphroditeEngine:
         return request_outputs
 
     def _record_latencies(self, scheduled_seq_groups,
-                          num_steps: int) -> None:
+                          tokens_of=None) -> None:
         """Stamp per-request TTFT / per-token / e2e latency samples
         (reference _get_stats aphrodite_engine.py:830-891; the reference
         stamps inside RequestMetrics, we batch per processed round). A
-        burst of K tokens records K amortized per-token samples."""
+        burst that produced K tokens for a group records K amortized
+        per-token samples — `tokens_of` maps id(group) to the count the
+        group ACTUALLY got (stops mid-burst produce fewer)."""
         if self.stat_logger is None:
             return          # samples are only drained by the stat logger
         now = time.monotonic()
         for group in scheduled_seq_groups:
+            k = 1 if tokens_of is None else tokens_of.get(id(group), 0)
             if group.first_token_time is None:
                 group.first_token_time = now
                 self._ttft_samples.append(now - group.arrival_time)
-            else:
-                dt = (now - group.last_token_time) / max(1, num_steps)
-                self._tpot_samples.extend([dt] * num_steps)
+            elif k > 0:
+                dt = (now - group.last_token_time) / k
+                self._tpot_samples.extend([dt] * k)
             group.last_token_time = now
             if group.is_finished() and group.finished_time is None:
                 group.finished_time = now
@@ -485,7 +491,7 @@ class AphroditeEngine:
 
     def _get_stats(self,
                    scheduler_outputs: Optional[SchedulerOutputs],
-                   num_steps: int = 1) -> Stats:
+                   generation_tokens: Optional[int] = None) -> Stats:
         now = time.monotonic()
         num_total_gpu = self.cache_config.num_gpu_blocks or 1
         num_free_gpu = \
@@ -504,10 +510,10 @@ class AphroditeEngine:
             if scheduler_outputs.prompt_run:
                 num_prompt_tokens = scheduler_outputs.num_batched_tokens
             else:
-                # A multi-step burst produces num_steps tokens per seq in
-                # one scheduling round.
-                num_generation_tokens = \
-                    scheduler_outputs.num_batched_tokens * num_steps
+                # A multi-step burst passes the exact count it produced.
+                num_generation_tokens = generation_tokens \
+                    if generation_tokens is not None \
+                    else scheduler_outputs.num_batched_tokens
 
         ttfts, self._ttft_samples = self._ttft_samples, []
         tpots, self._tpot_samples = self._tpot_samples, []

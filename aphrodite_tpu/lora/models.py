@@ -209,15 +209,22 @@ def _merge_block_diagonal(key: str, pieces,
             if sid in sizes_of:
                 full_sizes.append(sizes_of[sid])
             elif sid in ("k", "v"):
+                # k and v always share a width.
                 sib = sizes_of.get("v" if sid == "k" else "k")
                 if sib is None:
                     raise ValueError(
                         f"Cannot infer width of absent shard {sid!r} in "
                         f"{key}; pass module_layouts")
                 full_sizes.append(sib)
+            elif sid == "q":
+                # Under GQA q's width differs from k/v — refusing beats
+                # silently shifting every downstream slice.
+                raise ValueError(
+                    f"Cannot infer width of absent q shard in {key}; "
+                    "pass module_layouts")
             else:
-                sib = next(iter(sizes_of.values()))
-                full_sizes.append(sib)
+                # gate/up (and other same-width packs).
+                full_sizes.append(next(iter(sizes_of.values())))
         offsets, off = {}, 0
         for sid, s in zip(expected, full_sizes):
             offsets[sid] = off

@@ -105,6 +105,12 @@ def hf_model_weights_iterator(
     """Yield (name, numpy array) for every checkpoint tensor
     (reference `hf_downloader.py:285-352`, minus hub download — the model
     path must be local or already cached)."""
+    if model_path.endswith(".gguf") and os.path.isfile(model_path):
+        # GGUF single-file checkpoint: dequantize blocks at load
+        # (reference `hf_downloader.py:293-295`).
+        from aphrodite_tpu.modeling.gguf import gguf_weights_iterator
+        yield from gguf_weights_iterator(model_path)
+        return
     if not os.path.isdir(model_path):
         # Resolve via HF cache/download (requires network for new repos).
         from huggingface_hub import snapshot_download

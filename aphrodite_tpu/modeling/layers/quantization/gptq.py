@@ -125,11 +125,37 @@ class GPTQLinearMethod(LinearMethod):
 
     def apply(self, params: Dict[str, jax.Array],
               x: jax.Array) -> jax.Array:
-        w = self.dequantize(params, x.dtype)
-        y = x @ w
+        cfg = self.config
+        in_features = params["g_idx"].shape[0]
+        out_features = params["scales"].shape[1]
+        if self._use_pallas(in_features, out_features):
+            from aphrodite_tpu.ops.pallas.quant_matmul import gptq_matmul
+            lead = x.shape[:-1]
+            y = gptq_matmul(
+                x.reshape(-1, in_features), params["qweight"],
+                params["qzeros"], params["scales"],
+                bits=cfg.weight_bits, group_size=cfg.group_size)
+            y = y.reshape(*lead, out_features)
+        else:
+            w = self.dequantize(params, x.dtype)
+            y = x @ w
         if "bias" in params:
             y = y + params["bias"]
         return y
+
+    def _use_pallas(self, in_features: int, out_features: int) -> bool:
+        """Fused dequant-matmul kernel on TPU; the XLA dequantize-then-dot
+        fallback everywhere else (it materializes the full bf16 weight in
+        HBM every call — ~9x the traffic at int4 7B scale)."""
+        import os
+        if os.environ.get("APHRODITE_DISABLE_PALLAS_QUANT"):
+            return False
+        from aphrodite_tpu.ops.pallas.quant_matmul import gptq_supported
+        return (jax.default_backend() == "tpu" and
+                gptq_supported(in_features, out_features,
+                               self.config.weight_bits,
+                               self.config.group_size,
+                               self.config.desc_act))
 
     def load_weight(self, params, name: str,
                     hf_tensor: np.ndarray) -> np.ndarray:

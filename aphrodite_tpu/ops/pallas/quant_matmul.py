@@ -1,0 +1,187 @@
+"""Fused weight-dequant matmul Pallas kernel (GPTQ int4/int8 layout).
+
+Reference equivalents: `kernels/quantization/gptq/q_gemm.cu` (exllama
+reconstruct+gemm) — the CUDA side fuses int4 dequant into the GEMM so
+the full-precision weight matrix never exists in global memory. The XLA
+fallback (`quantization/gptq.py` dequantize-then-dot) materializes the
+dequantized [in, out] bf16 matrix in HBM every step, which turns a
+3.6 GB int4 weight read into ~32 GB of HBM traffic at 7B scale. This
+kernel reads the PACKED weights once per tile, unpacks and scales them
+in VMEM registers, and feeds the MXU directly.
+
+Layout (AutoGPTQ v1, matching `quantization/gptq.py`):
+  qweight [K//pack, N] int32 — pack = 32//bits values along K (rows)
+  qzeros  [G, N//pack] int32 — packed along N (cols), stores z-1
+  scales  [G, N]       f16/bf16/f32
+with G = K // group_size. Dequant: w = (q - (z+1)) * s.
+
+Grid: (m_tiles, n_tiles, k_tiles), k innermost accumulating into a VMEM
+f32 scratch; block_k == group_size so each k-step sees exactly one
+quantization group (z and s are single rows — a broadcast, no gather).
+desc_act (g_idx shuffles) stays on the XLA path.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _unpack_planes(q: jax.Array, bits: int) -> jax.Array:
+    """[r, c] int32, pack along rows -> [r*pack, c] int32, PLANE order.
+
+    Row j of the result is original (unpacked) row (j % r) * pack + j // r
+    — i.e. planes of equal bit-shift stacked along sublanes. A sublane
+    concatenation is layout-friendly on TPU; the natural-order reshape
+    ([r, pack, c] -> [r*pack, c]) interleaves across sublanes and Mosaic
+    lowers it to per-element shuffles (~100x slower, measured). The
+    matmul wrapper compensates by permuting x's columns once in XLA.
+    """
+    pack = 32 // bits
+    mask = (1 << bits) - 1
+    planes = [
+        jax.lax.bitwise_and(
+            jax.lax.shift_right_logical(q, p * bits), mask)
+        for p in range(pack)
+    ]
+    return jax.lax.concatenate(planes, 0)
+
+
+def plane_permutation(K: int, block_k: int, bits: int) -> np.ndarray:
+    """Column permutation of x matching `_unpack_planes` row order:
+    within each block_k-span, position j holds original column
+    (j % r) * pack + j // r with r = block_k // pack."""
+    pack = 32 // bits
+    r = block_k // pack
+    j = np.arange(block_k)
+    within = (j % r) * pack + j // r
+    blocks = np.arange(0, K, block_k)[:, None]
+    return (blocks + within[None, :]).reshape(-1)
+
+
+def _kernel(x_ref, qw_ref, z_ref, s_ref, o_ref, acc_ref, *,
+            bits: int, k_tiles: int, group_size: int):
+    """One (m, n, k) grid step: dequant a [block_k, block_n] weight tile
+    from packed int words and accumulate x-tile @ w-tile.
+
+    block_k may span several quantization groups; each group's 128-row
+    (= group_size-row) chunk is unpacked plane-wise and scaled with its
+    own (z, s) row, then the chunks concatenate along sublanes into the
+    full tile — all layout-friendly ops (no cross-sublane reshapes)."""
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    pack = 32 // bits
+    rows_per_group = group_size // pack
+    n_groups = z_ref.shape[0]
+    chunks = []
+    for g in range(n_groups):
+        q = _unpack_planes(
+            qw_ref[g * rows_per_group:(g + 1) * rows_per_group], bits)
+        z = z_ref[g]                                   # [1, bn] int32
+        s = s_ref[g].astype(jnp.float32)               # [1, bn]
+        chunks.append(
+            ((q - z).astype(jnp.float32) * s).astype(x_ref.dtype))
+    w = chunks[0] if n_groups == 1 else jax.lax.concatenate(chunks, 0)
+    acc_ref[...] += jnp.dot(x_ref[...], w,
+                            preferred_element_type=jnp.float32)
+
+    @pl.when(k == k_tiles - 1)
+    def _flush():
+        o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+
+def gptq_supported(in_features: int, out_features: int, bits: int,
+                   group_size: int, desc_act: bool) -> bool:
+    """Shapes this kernel handles; everything else uses the XLA path."""
+    if desc_act or bits not in (4, 8):
+        return False
+    gs = group_size if group_size != -1 else in_features
+    pack = 32 // bits
+    return (in_features % gs == 0 and gs % pack == 0 and gs >= 128 and
+            gs <= 1024 and out_features % 128 == 0)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("bits", "group_size", "interpret"))
+def gptq_matmul(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
+                scales: jax.Array, *, bits: int, group_size: int,
+                interpret: bool = False) -> jax.Array:
+    """y[m, N] = dequant(qweight, qzeros, scales) matmul for 2-D x[m, K].
+
+    block_k == group_size; m is padded to the dtype sublane multiple and
+    tiled at <=512 rows; N tiled at 512 lanes (or N if smaller).
+    """
+    m, K = x.shape
+    N = qweight.shape[1]
+    gs = group_size if group_size != -1 else K
+    pack = 32 // bits
+
+    # Tile sizes: per-grid-step overhead (~5us) dominates when tiles are
+    # small, so spend VMEM on big tiles — block_k spans several quant
+    # groups (the kernel dequants each group chunk separately) and
+    # block_n goes up to 2048 lanes.
+    block_k = gs
+    while block_k < 512 and K % (block_k * 2) == 0:
+        block_k *= 2
+    block_n = max(
+        (bn for bn in (2048, 1024, 512, 256, 128) if N % bn == 0),
+        key=lambda bn: bn)
+    sublane = 16 if x.dtype == jnp.bfloat16 else 8
+    block_m = min(512, -(-m // sublane) * sublane)
+    if block_m >= 512 and block_n > 1024:
+        block_n = 1024          # keep acc + tiles within VMEM
+    padded_m = -(-m // block_m) * block_m
+    # Plane-order unpack (see _unpack_planes): permute x's columns to
+    # match — per GROUP, since the kernel unpacks each group chunk
+    # separately. The permutation is exactly a blockwise [R, pack]
+    # transpose, which XLA lowers natively (an explicit index gather is
+    # ~100x slower here).
+    R = gs // pack
+    x = x.reshape(m, K // gs, R, pack).swapaxes(2, 3).reshape(m, K)
+    if padded_m != m:
+        x = jnp.pad(x, ((0, padded_m - m), (0, 0)))
+
+    k_tiles = K // block_k
+    groups_per_tile = block_k // gs
+    grid = (padded_m // block_m, N // block_n, k_tiles)
+
+    # Zeros are unpacked once in the XLA prologue ([G, N] is ~weights/gs
+    # — trivial traffic) so the kernel's z block is a plain lane slice;
+    # the [G, 1, N] shape keeps the per-group row block legal (a block
+    # dim of 1 must equal the array dim).
+    shifts = (jnp.arange(pack, dtype=jnp.int32) * bits)[None, None, :]
+    z_all = jax.lax.bitwise_and(
+        jax.lax.shift_right_logical(qzeros[:, :, None], shifts),
+        (1 << bits) - 1).reshape(qzeros.shape[0], 1, N) + 1
+    scales3 = scales[:, None, :]
+
+    out = pl.pallas_call(
+        functools.partial(_kernel, bits=bits, k_tiles=k_tiles,
+                          group_size=gs),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_m, block_k), lambda i, n, k: (i, k)),
+            pl.BlockSpec((block_k // pack, block_n),
+                         lambda i, n, k: (k, n)),
+            pl.BlockSpec((groups_per_tile, 1, block_n),
+                         lambda i, n, k: (k, 0, n)),
+            pl.BlockSpec((groups_per_tile, 1, block_n),
+                         lambda i, n, k: (k, 0, n)),
+        ],
+        out_specs=pl.BlockSpec((block_m, block_n),
+                               lambda i, n, k: (i, n)),
+        out_shape=jax.ShapeDtypeStruct((padded_m, N), x.dtype),
+        scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(x, qweight, z_all, scales3)
+    return out[:m] if padded_m != m else out

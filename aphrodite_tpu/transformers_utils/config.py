@@ -9,6 +9,11 @@ from transformers import AutoConfig, PretrainedConfig
 def get_config(model: str,
                trust_remote_code: bool = False,
                revision: Optional[str] = None) -> PretrainedConfig:
+    if model.endswith(".gguf"):
+        # Single-file GGUF checkpoint: config comes from its metadata
+        # (reference `transformers_utils/config.py:77-78`).
+        from aphrodite_tpu.modeling.gguf import extract_gguf_config
+        return extract_gguf_config(model)
     try:
         config = AutoConfig.from_pretrained(
             model, trust_remote_code=trust_remote_code, revision=revision)

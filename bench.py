@@ -28,6 +28,16 @@ import time
 
 BASELINE_TOKS = 5489.3     # reference README.md:59 (Mistral-7B fp16)
 
+# Matching reference baseline row per quant method (README.md:59-67) so
+# vs_baseline stays apples-to-apples when BENCH_QUANT is set.
+BASELINE_BY_QUANT = {
+    None: 5489.3,          # fp16
+    "gptq": 7850.4,        # GPTQ 4-bit
+    "awq": 4078.8,         # AWQ 4-bit
+    "int8": 7658.0,        # GPTQ 8-bit is the closest 8-bit row
+    "squeezellm": 549.5,
+}
+
 
 def _log(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
@@ -80,13 +90,29 @@ def main() -> None:
 
     t0 = time.perf_counter()
     multi_step = int(os.environ.get("BENCH_MULTI_STEP", "16"))
+    quant = os.environ.get("BENCH_QUANT") or None
+    kv_dtype = os.environ.get("BENCH_KV_DTYPE", "auto")
     engine = AphroditeEngine.from_engine_args(EngineArgs(
         model=tmp, tokenizer=tmp, load_format="dummy", dtype="bfloat16",
         max_model_len=2048, max_num_seqs=batch, disable_log_stats=True,
-        skip_tokenizer_init=True, multi_step=multi_step))
+        skip_tokenizer_init=True, multi_step=multi_step,
+        quantization=quant, kv_cache_dtype=kv_dtype))
+
+    # Fit the batch to KV capacity: a batch whose total footprint
+    # exceeds the device pool just thrashes swap/preemption and measures
+    # the scheduler, not the model. Leave the watermark + one burst of
+    # headroom.
+    page = engine.cache_config.block_size
+    pages_per_seq = -(-(prompt_len + steps) // page)
+    device_pages = engine.cache_config.num_gpu_blocks
+    fit = max(1, int(device_pages * 0.98) // pages_per_seq)
+    if fit < batch:
+        _log(f"batch {batch} -> {fit} (KV capacity: {device_pages} pages"
+             f", {pages_per_seq}/seq)")
+        batch = fit
     _log(f"engine up in {time.perf_counter() - t0:.1f}s "
          f"(model={size}, batch={batch}, steps={steps}, "
-         f"prompt={prompt_len})")
+         f"prompt={prompt_len}, quant={quant}, kv={kv_dtype})")
 
     sp = SamplingParams(temperature=0.0, max_tokens=steps,
                         ignore_eos=True)
@@ -107,11 +133,13 @@ def main() -> None:
     _log(f"timed run: {total_out} tokens in {dt:.1f}s")
 
     toks = total_out / dt
+    baseline = BASELINE_BY_QUANT.get(quant, BASELINE_TOKS)
+    tag = f"_{quant}" if quant else ""
     print(json.dumps({
-        "metric": f"offline_throughput_{size}",
+        "metric": f"offline_throughput_{size}{tag}",
         "value": round(toks, 1),
         "unit": "out_tok/s",
-        "vs_baseline": round(toks / BASELINE_TOKS, 4),
+        "vs_baseline": round(toks / baseline, 4),
     }))
 
 

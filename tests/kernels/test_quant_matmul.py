@@ -1,0 +1,78 @@
+"""Fused GPTQ dequant-matmul Pallas kernel vs the XLA dequantize path
+(reference CUDA equivalent: `kernels/quantization/gptq/q_gemm.cu`
+reconstruct+gemm; correctness oracle here is `GPTQLinearMethod.dequantize`
+which is itself tested against AutoGPTQ layout in
+tests/quantization/test_quant_methods.py)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from aphrodite_tpu.modeling.layers.quantization.gptq import (
+    GPTQConfig, GPTQLinearMethod)
+from aphrodite_tpu.ops.pallas.quant_matmul import (gptq_matmul,
+                                                   gptq_supported,
+                                                   plane_permutation)
+
+rs = np.random.RandomState(7)
+
+
+def make_inputs(bits, group_size, K, N, m, dtype=np.float32):
+    pack = 32 // bits
+    G = K // (group_size if group_size != -1 else K)
+    qweight = rs.randint(-2**31, 2**31, (K // pack, N), dtype=np.int32)
+    qzeros = rs.randint(-2**31, 2**31, (G, N // pack), dtype=np.int32)
+    scales = (rs.rand(G, N).astype(dtype) * 0.1 + 0.01)
+    x = rs.randn(m, K).astype(dtype)
+    g_idx = (np.arange(K) // (group_size if group_size != -1 else K)
+             ).astype(np.int32)
+    params = {"qweight": jnp.asarray(qweight),
+              "qzeros": jnp.asarray(qzeros),
+              "scales": jnp.asarray(scales),
+              "g_idx": jnp.asarray(g_idx)}
+    return params, jnp.asarray(x)
+
+
+@pytest.mark.parametrize("bits,group_size,K,N,m", [
+    (4, 128, 512, 256, 5),      # unpadded m
+    (4, 128, 256, 512, 64),
+    (8, 128, 256, 128, 33),
+    (4, -1, 256, 384, 16),      # single group
+    (8, 256, 512, 128, 8),      # multi-row group
+])
+def test_matches_xla_dequant(bits, group_size, K, N, m):
+    params, x = make_inputs(bits, group_size, K, N, m)
+    method = GPTQLinearMethod(GPTQConfig(bits, group_size))
+    ref = np.asarray(x @ method.dequantize(params, jnp.float32))
+    got = np.asarray(gptq_matmul(
+        x, params["qweight"], params["qzeros"], params["scales"],
+        bits=bits, group_size=group_size, interpret=True))
+    rel = np.abs(ref - got).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 2e-5, rel
+
+
+def test_plane_permutation_is_permutation():
+    perm = plane_permutation(512, 128, 4)
+    assert sorted(perm.tolist()) == list(range(512))
+    # Row j of the plane-unpacked tile is original row
+    # (j % R) * pack + j // R within each 128-block.
+    assert perm[0] == 0 and perm[1] == 8 and perm[16] == 1
+
+
+def test_supported_gate():
+    assert gptq_supported(4096, 14336, 4, 128, False)
+    assert gptq_supported(4096, 4096, 8, 128, False)
+    assert not gptq_supported(4096, 14336, 4, 128, True)    # desc_act
+    assert not gptq_supported(4096, 14336, 2, 128, False)   # 2-bit
+    assert not gptq_supported(4000, 14336, 4, 128, False)   # K % gs
+    assert not gptq_supported(4096, 14300, 4, 128, False)   # N % 128
+
+
+def test_apply_uses_fallback_on_cpu():
+    """On CPU the linear method must route to the XLA path (the kernel
+    gate checks the backend), and produce the same results."""
+    params, x = make_inputs(4, 128, 256, 256, 4)
+    method = GPTQLinearMethod(GPTQConfig(4, 128))
+    y = np.asarray(method.apply(params, x))
+    ref = np.asarray(x @ method.dequantize(params, jnp.float32))
+    np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5)

@@ -1,0 +1,418 @@
+"""GGUF support tests: vectorized dequant vs scalar ggml-C oracles over
+random block bytes, reader/writer roundtrip, quantize->dequantize
+accuracy, config/tokenizer extraction, and end-to-end engine generation
+from a .gguf file (reference: `kernels/quantization/gguf/gguf_kernel.cu`
+dequant semantics; `aphrodite/modeling/hf_downloader.py:210`)."""
+import json
+
+import numpy as np
+import pytest
+
+from aphrodite_tpu.modeling import gguf as G
+
+rs = np.random.RandomState(42)
+
+
+def f16(lo, hi):
+    return np.frombuffer(bytes([lo, hi]), dtype=np.float16)[0].astype(
+        np.float32)
+
+
+# ---- scalar oracles: direct transcriptions of ggml dequantize_row_* ----
+
+def oracle_q4_0(b):
+    d = f16(b[0], b[1])
+    qs = b[2:18]
+    out = np.empty(32, np.float32)
+    for j in range(16):
+        out[j] = (int(qs[j] & 0xF) - 8) * d
+        out[j + 16] = (int(qs[j] >> 4) - 8) * d
+    return out
+
+
+def oracle_q5_0(b):
+    d = f16(b[0], b[1])
+    qh = int.from_bytes(bytes(b[2:6]), "little")
+    qs = b[6:22]
+    out = np.empty(32, np.float32)
+    for j in range(16):
+        x0 = int(qs[j] & 0xF) | (((qh >> j) & 1) << 4)
+        x1 = int(qs[j] >> 4) | (((qh >> (j + 16)) & 1) << 4)
+        out[j] = (x0 - 16) * d
+        out[j + 16] = (x1 - 16) * d
+    return out
+
+
+def oracle_q8_0(b):
+    d = f16(b[0], b[1])
+    return np.frombuffer(bytes(b[2:34]), dtype=np.int8).astype(
+        np.float32) * d
+
+
+def _scale_min(sc, j):
+    if j < 4:
+        return sc[j] & 63, sc[j + 4] & 63
+    return ((sc[j + 4] & 0xF) | ((sc[j - 4] >> 6) << 4),
+            (sc[j + 4] >> 4) | ((sc[j] >> 6) << 4))
+
+
+def oracle_q4_k(b):
+    d = f16(b[0], b[1])
+    dmin = f16(b[2], b[3])
+    scales = b[4:16]
+    qs = b[16:144]
+    out = np.empty(256, np.float32)
+    y = 0
+    for c in range(4):
+        ql = qs[32 * c:32 * (c + 1)]
+        sc, m = _scale_min(scales, 2 * c)
+        for j in range(32):
+            out[y + j] = d * sc * (ql[j] & 0xF) - dmin * m
+        sc, m = _scale_min(scales, 2 * c + 1)
+        for j in range(32):
+            out[y + 32 + j] = d * sc * (ql[j] >> 4) - dmin * m
+        y += 64
+    return out
+
+
+def oracle_q5_k(b):
+    d = f16(b[0], b[1])
+    dmin = f16(b[2], b[3])
+    scales = b[4:16]
+    qh = b[16:48]
+    qs = b[48:176]
+    out = np.empty(256, np.float32)
+    y = 0
+    u1, u2 = 1, 2
+    for c in range(4):
+        ql = qs[32 * c:32 * (c + 1)]
+        sc, m = _scale_min(scales, 2 * c)
+        for j in range(32):
+            out[y + j] = d * sc * ((ql[j] & 0xF) +
+                                   (16 if qh[j] & u1 else 0)) - dmin * m
+        sc, m = _scale_min(scales, 2 * c + 1)
+        for j in range(32):
+            out[y + 32 + j] = d * sc * ((ql[j] >> 4) +
+                                        (16 if qh[j] & u2 else 0)) \
+                - dmin * m
+        y += 64
+        u1 <<= 2
+        u2 <<= 2
+    return out
+
+
+def oracle_q6_k(b):
+    ql = b[:128]
+    qh = b[128:192]
+    sc = np.frombuffer(bytes(b[192:208]), dtype=np.int8)
+    d = f16(b[208], b[209])
+    out = np.empty(256, np.float32)
+    y = 0
+    for half in range(2):
+        qlh = ql[64 * half:64 * half + 64]
+        qhh = qh[32 * half:32 * half + 32]
+        s = sc[8 * half:8 * half + 8]
+        for l in range(32):
+            is_ = l // 16
+            q1 = (int(qlh[l] & 0xF) | ((int(qhh[l] >> 0) & 3) << 4)) - 32
+            q2 = (int(qlh[l + 32] & 0xF) |
+                  ((int(qhh[l] >> 2) & 3) << 4)) - 32
+            q3 = (int(qlh[l] >> 4) | ((int(qhh[l] >> 4) & 3) << 4)) - 32
+            q4 = (int(qlh[l + 32] >> 4) |
+                  ((int(qhh[l] >> 6) & 3) << 4)) - 32
+            out[y + l] = d * s[is_] * q1
+            out[y + l + 32] = d * s[is_ + 2] * q2
+            out[y + l + 64] = d * s[is_ + 4] * q3
+            out[y + l + 96] = d * s[is_ + 6] * q4
+        y += 128
+    return out
+
+
+def oracle_q2_k(b):
+    scales = b[:16]
+    qs = b[16:80]
+    d = f16(b[80], b[81])
+    dmin = f16(b[82], b[83])
+    out = np.empty(256, np.float32)
+    y = 0
+    is_ = 0
+    for n in range(2):
+        q = qs[32 * n:32 * n + 32]
+        shift = 0
+        for j in range(4):
+            sc = scales[is_]
+            is_ += 1
+            dl, ml = d * (sc & 0xF), dmin * (sc >> 4)
+            for l in range(16):
+                out[y] = dl * ((q[l] >> shift) & 3) - ml
+                y += 1
+            sc = scales[is_]
+            is_ += 1
+            dl, ml = d * (sc & 0xF), dmin * (sc >> 4)
+            for l in range(16):
+                out[y] = dl * ((q[l + 16] >> shift) & 3) - ml
+                y += 1
+            shift += 2
+    return out
+
+
+def oracle_q3_k(b):
+    hmask = b[:32]
+    qs = b[32:96]
+    raw = b[96:108]
+    d_all = f16(b[108], b[109])
+    aux = np.frombuffer(bytes(raw), dtype=np.uint32).copy()
+    km1, km2 = 0x03030303, 0x0F0F0F0F
+    tmp = int(aux[2])
+    a = np.empty(4, np.uint32)
+    a[0] = (int(aux[0]) & km2) | (((tmp >> 0) & km1) << 4)
+    a[1] = (int(aux[1]) & km2) | (((tmp >> 2) & km1) << 4)
+    a[2] = ((int(aux[0]) >> 4) & km2) | (((tmp >> 4) & km1) << 4)
+    a[3] = ((int(aux[1]) >> 4) & km2) | (((tmp >> 6) & km1) << 4)
+    scales = a.view(np.int8).astype(np.int32) - 32
+    out = np.empty(256, np.float32)
+    y = 0
+    is_ = 0
+    m = 1
+    for n in range(2):
+        q = qs[32 * n:32 * n + 32]
+        shift = 0
+        for j in range(4):
+            dl = d_all * scales[is_]
+            is_ += 1
+            for l in range(16):
+                v = int((q[l] >> shift) & 3) - \
+                    (0 if hmask[l] & m else 4)
+                out[y] = dl * v
+                y += 1
+            dl = d_all * scales[is_]
+            is_ += 1
+            for l in range(16):
+                v = int((q[l + 16] >> shift) & 3) - \
+                    (0 if hmask[l + 16] & m else 4)
+                out[y] = dl * v
+                y += 1
+            shift += 2
+            m <<= 1
+    return out
+
+
+_ORACLES = {
+    "Q4_0": (oracle_q4_0, 18), "Q5_0": (oracle_q5_0, 22),
+    "Q8_0": (oracle_q8_0, 34), "Q2_K": (oracle_q2_k, 84),
+    "Q3_K": (oracle_q3_k, 110), "Q4_K": (oracle_q4_k, 144),
+    "Q5_K": (oracle_q5_k, 176), "Q6_K": (oracle_q6_k, 210),
+}
+
+
+@pytest.mark.parametrize("tname", sorted(_ORACLES))
+def test_dequant_matches_scalar_oracle(tname):
+    oracle, bpb = _ORACLES[tname]
+    tid = {v[0]: k for k, v in G.GGML_TYPES.items()}[tname]
+    block = G.GGML_TYPES[tid][1]
+    n_blocks = 7
+    raw = rs.randint(0, 256, (n_blocks, bpb), dtype=np.uint8)
+    # Clamp the f16 scale bytes' exponents so d is finite and sane.
+    raw[:, 1] &= 0x3F
+    if tname in ("Q4_K", "Q5_K", "Q2_K"):
+        raw[:, 3] &= 0x3F
+    if tname == "Q6_K":
+        raw[:, 209] &= 0x3F
+    if tname == "Q3_K":
+        raw[:, 109] &= 0x3F
+    got = G.dequantize(raw.tobytes(), tid, (n_blocks, block))
+    want = np.stack([oracle(raw[i]) for i in range(n_blocks)])
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_quantize_roundtrip_accuracy():
+    w = rs.randn(8, 256).astype(np.float32)
+    # Expected max error is half a quantization step: d/2 = amax/254
+    # (Q8_0) or amax/16 (Q4_0); amax ~ 3.5 for randn blocks.
+    for tname, tol in (("Q8_0", 0.025), ("Q4_0", 0.35)):
+        tid = {v[0]: k for k, v in G.GGML_TYPES.items()}[tname]
+        raw = G._QUANTIZERS[tname][0](w)
+        back = G.dequantize(raw, tid, w.shape)
+        err = np.abs(back - w).max()
+        assert err < tol, (tname, err)
+
+
+def make_tiny_gguf(path, vocab_size=64, hidden=32, layers=2, heads=4,
+                   kv_heads=2, inter=64, wtype="Q8_0", seed=3):
+    r = np.random.RandomState(seed)
+    head_dim = hidden // heads
+    kv_dim = head_dim * kv_heads
+    meta = {
+        "general.architecture": "llama",
+        "general.alignment": 32,
+        "llama.context_length": 256,
+        "llama.embedding_length": hidden,
+        "llama.feed_forward_length": inter,
+        "llama.block_count": layers,
+        "llama.attention.head_count": heads,
+        "llama.attention.head_count_kv": kv_heads,
+        "llama.attention.layer_norm_rms_epsilon": 1e-5,
+        "llama.rope.freq_base": 10000.0,
+        "tokenizer.ggml.tokens": [f"<t{i}>" for i in range(vocab_size)],
+        "tokenizer.ggml.scores": [-float(i) for i in range(vocab_size)],
+        "tokenizer.ggml.token_type": [1] * vocab_size,
+        "tokenizer.ggml.bos_token_id": 1,
+        "tokenizer.ggml.eos_token_id": 2,
+    }
+    def llamacpp_permute(w_, n_head):
+        """What llama.cpp's convert script does to HF q/k weights."""
+        rows, cols = w_.shape
+        return (w_.reshape(n_head, 2, rows // n_head // 2, cols)
+                .swapaxes(1, 2).reshape(rows, cols))
+
+    w = lambda *s: (r.randn(*s) * 0.05).astype(np.float32)
+    hf = {}                 # HF-layout ground truth
+    tensors = {}            # what goes into the file (q/k permuted)
+
+    def add(gname, hname, arr, ttype, permute_heads=None):
+        hf[hname] = arr
+        stored = llamacpp_permute(arr, permute_heads) \
+            if permute_heads else arr
+        tensors[gname] = (stored, ttype)
+
+    add("token_embd.weight", "model.embed_tokens.weight",
+        w(vocab_size, hidden), wtype)
+    add("output.weight", "lm_head.weight", w(vocab_size, hidden), wtype)
+    add("output_norm.weight", "model.norm.weight",
+        np.ones(hidden, np.float32), "F32")
+    for i in range(layers):
+        p, h = f"blk.{i}", f"model.layers.{i}"
+        add(f"{p}.attn_norm.weight", f"{h}.input_layernorm.weight",
+            np.ones(hidden, np.float32), "F32")
+        add(f"{p}.ffn_norm.weight",
+            f"{h}.post_attention_layernorm.weight",
+            np.ones(hidden, np.float32), "F32")
+        add(f"{p}.attn_q.weight", f"{h}.self_attn.q_proj.weight",
+            w(hidden, hidden), wtype, permute_heads=heads)
+        add(f"{p}.attn_k.weight", f"{h}.self_attn.k_proj.weight",
+            w(kv_dim, hidden), wtype, permute_heads=kv_heads)
+        add(f"{p}.attn_v.weight", f"{h}.self_attn.v_proj.weight",
+            w(kv_dim, hidden), wtype)
+        add(f"{p}.attn_output.weight", f"{h}.self_attn.o_proj.weight",
+            w(hidden, hidden), wtype)
+        add(f"{p}.ffn_gate.weight", f"{h}.mlp.gate_proj.weight",
+            w(inter, hidden), wtype)
+        add(f"{p}.ffn_up.weight", f"{h}.mlp.up_proj.weight",
+            w(inter, hidden), wtype)
+        add(f"{p}.ffn_down.weight", f"{h}.mlp.down_proj.weight",
+            w(hidden, inter), wtype)
+    # Aux tensor real llama.cpp files carry; the loader must skip it.
+    tensors["rope_freqs.weight"] = (
+        np.ones(hidden // heads // 2, np.float32), "F32")
+    G.write_gguf(str(path), meta, tensors)
+    return hf
+
+
+def test_reader_roundtrip(tmp_path):
+    path = tmp_path / "tiny.gguf"
+    hf = make_tiny_gguf(path)
+    reader = G.GGUFReader(str(path))
+    assert reader.fields["general.architecture"] == "llama"
+    assert reader.fields["llama.embedding_length"] == 32
+    assert len(reader.fields["tokenizer.ggml.tokens"]) == 64
+    by_name = {t.name: t for t in reader.tensors}
+    norm = reader.load(by_name["output_norm.weight"])
+    np.testing.assert_allclose(norm, np.ones(32), atol=0)
+    emb = reader.load(by_name["token_embd.weight"])
+    np.testing.assert_allclose(emb, hf["model.embed_tokens.weight"],
+                               atol=0.01)
+
+
+def test_qk_reverse_permute_roundtrip(tmp_path):
+    """q/k tensors are stored llama.cpp-permuted; the iterator must
+    return exact HF layout (F32 so no quantization noise)."""
+    path = tmp_path / "tiny.gguf"
+    hf = make_tiny_gguf(path, wtype="F32")
+    loaded = dict(G.gguf_weights_iterator(str(path)))
+    for name in ("model.layers.0.self_attn.q_proj.weight",
+                 "model.layers.1.self_attn.k_proj.weight",
+                 "model.layers.0.self_attn.v_proj.weight"):
+        np.testing.assert_array_equal(loaded[name], hf[name])
+    assert "rope_freqs.weight" not in loaded    # aux tensor skipped
+
+
+def test_extract_config_and_state_dict(tmp_path):
+    path = tmp_path / "tiny.gguf"
+    make_tiny_gguf(path)
+    cfg = G.extract_gguf_config(str(path))
+    assert cfg.hidden_size == 32
+    assert cfg.num_key_value_heads == 2
+    assert cfg.architectures == ["LlamaForCausalLM"]
+    assert not cfg.tie_word_embeddings
+    names = {n for n, _ in G.gguf_weights_iterator(str(path))}
+    assert "model.embed_tokens.weight" in names
+    assert "model.layers.1.mlp.down_proj.weight" in names
+    assert "lm_head.weight" in names
+
+
+def test_gguf_tokenizer(tmp_path):
+    path = tmp_path / "tiny.gguf"
+    make_tiny_gguf(path)
+    from aphrodite_tpu.transformers_utils.tokenizer import (
+        convert_gguf_to_tokenizer)
+    tok = convert_gguf_to_tokenizer(str(path))
+    assert tok.bos_token == "<t1>"
+    assert tok.eos_token == "<t2>"
+    assert tok.vocab_size == 64
+
+
+def test_engine_generates_from_gguf(tmp_path):
+    """End-to-end: the same tiny model through (a) float weights and
+    (b) a Q8_0 GGUF file must produce identical greedy tokens."""
+    import jax.numpy as jnp
+
+    from aphrodite_tpu.common.sampling_params import SamplingParams
+    from aphrodite_tpu.endpoints.llm import LLM
+
+    path = tmp_path / "model.gguf"
+    hf_truth = make_tiny_gguf(path, wtype="Q8_0")
+
+    llm = LLM(model=str(path), load_format="auto", dtype="float32",
+              block_size=16, max_model_len=128, max_num_seqs=4,
+              swap_space=0.01, skip_tokenizer_init=True)
+    from aphrodite_tpu.common.sequence import Sequence, SequenceGroup
+    engine = llm.engine
+    sp = SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True)
+    seq = Sequence(next(engine.seq_counter), None, [5, 9, 11],
+                   engine.cache_config.block_size)
+    engine.scheduler.add_seq_group(
+        SequenceGroup("g1", [seq], sp, 0.0))
+    result = None
+    while engine.has_unfinished_requests():
+        for out in engine.step():
+            if out.finished:
+                result = out.outputs[0].token_ids
+    assert result is not None and len(result) == 8
+
+    # Float-weight reference: the gguf path must match the engine fed
+    # the ORIGINAL HF-layout weights quantize->dequantized the same way
+    # (validates the q/k reverse-permutation against ground truth).
+    import safetensors.numpy as st
+    d = tmp_path / "ref"
+    d.mkdir()
+    cfg = G.extract_gguf_config(str(path))
+    (d / "config.json").write_text(cfg.to_json_string())
+    state = {}
+    for k, v in hf_truth.items():
+        if v.ndim == 2 and v.size % 32 == 0:
+            v = G.dequantize(G.quantize_q8_0(v), 8, v.shape)
+        state[k] = v.astype(np.float32)
+    st.save_file(state, str(d / "model.safetensors"))
+    llm2 = LLM(model=str(d), load_format="safetensors", dtype="float32",
+               block_size=16, max_model_len=128, max_num_seqs=4,
+               swap_space=0.01, skip_tokenizer_init=True)
+    engine2 = llm2.engine
+    seq2 = Sequence(next(engine2.seq_counter), None, [5, 9, 11],
+                    engine2.cache_config.block_size)
+    engine2.scheduler.add_seq_group(SequenceGroup("g2", [seq2], sp, 0.0))
+    result2 = None
+    while engine2.has_unfinished_requests():
+        for out in engine2.step():
+            if out.finished:
+                result2 = out.outputs[0].token_ids
+    assert result == result2
