@@ -1,0 +1,330 @@
+"""Grammar-constrained decoding: lark-LALR incremental parsing + a
+character-trie token validator + a logits processor.
+
+Reference: `aphrodite/common/grammar.py:41-470` (FastInteractiveParser,
+IncrementalParserState, TokenVocab, NextTokenValidator,
+GrammarLogitsProcessor). Same capability, different machinery:
+
+- Parser states are lark's IMMUTABLE interactive parsers (value stacks
+  never matter — tokens are fed with empty values), so advancing a
+  state is cheap and states intern by their LALR state stack; the
+  reference deep-copies mutable parser states instead.
+- Token validation walks a CHARACTER TRIE of the vocabulary: shared
+  token prefixes are parsed once and invalid subtrees are pruned —
+  the reference's per-token validation re-parses every token string.
+- The processor masks numpy logits rows (our sampler applies host
+  logits processors on numpy), no torch/ray.
+
+A terminal may match a candidate completely (advance the LALR state),
+partially (stay, remember the partial text), or not at all (prune).
+Ignored terminals (whitespace) pass through without LALR stepping.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+import numpy as np
+
+try:
+    import regex as _regex
+except ImportError:                                    # pragma: no cover
+    import re as _regex
+
+from aphrodite_tpu.common.logger import init_logger
+
+logger = init_logger(__name__)
+
+END = "$END"
+
+
+def _matcher_for(pattern):
+    """Build seq -> (processed, remainder, extendable) for one lark
+    terminal pattern: (None, None, False) = no match; processed/remainder
+    describe the longest complete match (remainder may be "");
+    `extendable` = seq could still be a strict prefix of a LONGER match
+    of this terminal (maximal munch must not commit yet)."""
+    from lark.lexer import PatternRE, PatternStr
+
+    if isinstance(pattern, PatternStr):
+        literal = pattern.value
+
+        @functools.lru_cache(maxsize=200_000)
+        def match_str(seq: str):
+            if seq.startswith(literal):
+                return literal, seq[len(literal):], False
+            if literal.startswith(seq):
+                return None, None, True
+            return None, None, False
+
+        return match_str
+
+    if isinstance(pattern, PatternRE):
+        compiled = _regex.compile(pattern.value)
+
+        @functools.lru_cache(maxsize=200_000)
+        def match_re(seq: str):
+            processed = remainder = None
+            m = compiled.match(seq)
+            if m is not None and m.start() == 0 and m.end() > 0:
+                processed, remainder = seq[:m.end()], seq[m.end():]
+            extendable = False
+            try:
+                # A partial fullmatch that consumes the WHOLE seq means
+                # a longer match may still complete.
+                pm = compiled.fullmatch(seq, partial=True)
+                extendable = pm is not None and \
+                    (m is None or m.end() == len(seq))
+            except TypeError:                          # stdlib re
+                pass
+            return processed, remainder, extendable
+
+        return match_re
+
+    raise TypeError(f"Unsupported lark pattern {type(pattern)}")
+
+
+class GrammarMatcher:
+    """Incremental membership oracle for a lark grammar.
+
+    A state is `(parser_key, partial)`: an interned immutable LALR
+    parser plus the text of the current incomplete terminal. `advance`
+    consumes more text and returns the next state or None.
+    """
+
+    def __init__(self, grammar: str, start: str = "start") -> None:
+        from lark import Lark
+
+        self.lark = Lark(grammar, parser="lalr", start=start,
+                         regex=True)
+        self._validators = {
+            t.name: _matcher_for(t.pattern) for t in self.lark.terminals
+        }
+        self._ignored = set(self.lark.lexer_conf.ignore)
+        base = self.lark.parse_interactive().as_immutable()
+        self._parsers: Dict[int, object] = {}
+        self.root = (self._intern(base), "")
+        self._advance_memo: Dict[Tuple[int, str, str], object] = {}
+        self._accepts_memo: Dict[int, Set[str]] = {}
+
+    # -- parser interning --
+
+    def _intern(self, parser) -> int:
+        key = hash(tuple(parser.parser_state.state_stack))
+        self._parsers.setdefault(key, parser)
+        return key
+
+    def _accepts(self, parser_key: int) -> Set[str]:
+        got = self._accepts_memo.get(parser_key)
+        if got is None:
+            got = set(self._parsers[parser_key].accepts()) | self._ignored
+            self._accepts_memo[parser_key] = got
+        return got
+
+    # -- state transitions --
+
+    def advance(self, state, text: str):
+        """Consume `text` from `state`; None if no continuation exists."""
+        parser_key, partial = state
+        memo_key = (parser_key, partial, text)
+        if memo_key in self._advance_memo:
+            return self._advance_memo[memo_key]
+        result = self._advance(parser_key, partial + text)
+        self._advance_memo[memo_key] = result
+        return result
+
+    def _advance(self, parser_key: int, candidate: str):
+        if candidate == "":
+            return (parser_key, "")
+        best = None
+        for terminal in sorted(self._accepts(parser_key)):
+            if terminal == END:
+                continue
+            processed, remainder, extendable = \
+                self._validators[terminal](candidate)
+            if extendable:
+                # Maximal munch: some terminal may still grow — don't
+                # commit; the complete-match option is recoverable later
+                # because the full candidate text is retained.
+                return (parser_key, candidate)
+            if processed is not None and (
+                    best is None or len(processed) > len(best[1])):
+                # Longest complete match wins (standard lexer semantics
+                # — sort order must not decide between overlapping
+                # terminals).
+                best = (terminal, processed, remainder)
+        if best is None:
+            return None
+        terminal, processed, remainder = best
+        next_key = self._feed(parser_key, terminal, processed)
+        if remainder == "":
+            return (next_key, "")
+        return self._advance(next_key, remainder)
+
+    def _feed(self, parser_key: int, terminal: str,
+              text: str) -> int:
+        if terminal in self._ignored:
+            return parser_key
+        from lark.lexer import Token
+        parser = self._parsers[parser_key]
+        return self._intern(parser.feed_token(Token(terminal, text)))
+
+    def _commit_partial(self, state):
+        """If the pending partial text is itself a complete terminal,
+        the state after committing it; else None."""
+        parser_key, partial = state
+        if partial == "":
+            return state
+        for terminal in sorted(self._accepts(parser_key)):
+            if terminal == END:
+                continue
+            processed, remainder, _ = self._validators[terminal](partial)
+            if processed == partial and remainder == "":
+                return (self._feed(parser_key, terminal, partial), "")
+        return None
+
+    def can_end(self, state) -> bool:
+        committed = self._commit_partial(state)
+        return committed is not None and \
+            END in self._accepts(committed[0])
+
+
+class TokenTrie:
+    """Character trie over the normalized vocabulary: token ids sit on
+    the node that spells their decoded text."""
+
+    __slots__ = ("children", "token_ids")
+
+    def __init__(self):
+        self.children: Dict[str, TokenTrie] = {}
+        self.token_ids: List[int] = []
+
+    def insert(self, text: str, token_id: int) -> None:
+        node = self
+        for ch in text:
+            nxt = node.children.get(ch)
+            if nxt is None:
+                nxt = node.children[ch] = TokenTrie()
+            node = nxt
+        node.token_ids.append(token_id)
+
+
+class NextTokenValidator:
+    """Valid-next-token-id oracle for (tokenizer, grammar)
+    (reference `grammar.py:391-428`)."""
+
+    # Tries are grammar-independent; share per (tokenizer, charset).
+    _trie_cache: Dict[Tuple[int, Optional[frozenset]], TokenTrie] = {}
+
+    def __init__(self, tokenizer, grammar: str,
+                 grammar_start: str = "start",
+                 legal_chars: Optional[Set[str]] = None) -> None:
+        self.tokenizer = tokenizer
+        self.matcher = GrammarMatcher(grammar, grammar_start)
+        self.eos_token_id = tokenizer.eos_token_id
+        chars_key = frozenset(legal_chars) if legal_chars else None
+        cache_key = (id(tokenizer), chars_key)
+        trie = self._trie_cache.get(cache_key)
+        if trie is None:
+            trie = self._build_trie(tokenizer, legal_chars)
+            self._trie_cache[cache_key] = trie
+        self.trie = trie
+        # Decoded-prefix -> parser state for incremental stepping.
+        self._text_states: Dict[str, object] = {"": self.matcher.root}
+
+    @staticmethod
+    def _build_trie(tokenizer,
+                    legal_chars: Optional[Set[str]]) -> TokenTrie:
+        trie = TokenTrie()
+        bos = tokenizer.bos_token_id
+        special = set(getattr(tokenizer, "all_special_ids", []) or [])
+        for token_id in sorted(tokenizer.vocab.values()):
+            if token_id == tokenizer.eos_token_id or token_id in special:
+                continue
+            if bos is not None:
+                text = tokenizer.decode([bos, token_id])
+                text = text[len(tokenizer.bos_token):]
+            else:
+                text = tokenizer.decode([token_id])
+            if not text:
+                continue
+            if legal_chars is not None and \
+                    not all(c in legal_chars for c in text):
+                continue
+            trie.insert(text, token_id)
+        return trie
+
+    def state_for_text(self, text: str):
+        """Parser state after consuming `text` (None = text has left the
+        grammar; sampling should not have allowed it)."""
+        got = self._text_states.get(text)
+        if got is not None:
+            return got
+        # Find the longest cached prefix and advance the delta.
+        for cut in range(len(text) - 1, -1, -1):
+            prev = self._text_states.get(text[:cut])
+            if prev is not None:
+                nxt = self.matcher.advance(prev, text[cut:])
+                if nxt is not None:
+                    self._text_states[text] = nxt
+                return nxt
+        return None
+
+    def valid_token_ids(self, text: str) -> Tuple[List[int], bool]:
+        """(valid generated-token ids, eos_allowed) after `text`."""
+        state = self.state_for_text(text)
+        if state is None:
+            return [], True        # out of grammar: only stopping left
+        valid: List[int] = []
+        stack = [(self.trie, state)]
+        while stack:
+            node, st = stack.pop()
+            if node.token_ids:
+                valid.extend(node.token_ids)
+            for ch, child in node.children.items():
+                nxt = self.matcher.advance(st, ch)
+                if nxt is not None:
+                    stack.append((child, nxt))
+        return valid, self.matcher.can_end(state)
+
+
+# Validators are expensive to build (full-vocab trie + LALR compile) and
+# fully shareable: key by (tokenizer identity, grammar). Keeps the async
+# server handler from re-doing ~vocab_size decode calls per request.
+_VALIDATOR_CACHE: Dict[Tuple[int, str, str], NextTokenValidator] = {}
+
+
+def get_validator(tokenizer, grammar: str,
+                  grammar_start: str = "start") -> NextTokenValidator:
+    key = (id(tokenizer), grammar, grammar_start)
+    got = _VALIDATOR_CACHE.get(key)
+    if got is None:
+        got = NextTokenValidator(tokenizer, grammar, grammar_start)
+        if len(_VALIDATOR_CACHE) > 64:
+            _VALIDATOR_CACHE.clear()
+        _VALIDATOR_CACHE[key] = got
+    return got
+
+
+class GrammarLogitsProcessor:
+    """Host logits processor: -inf everything the grammar forbids
+    (reference `grammar.py:430-445`). Called per sampler row with the
+    request's output token ids and the numpy logits row."""
+
+    def __init__(self, tokenizer, grammar: str,
+                 grammar_start: str = "start") -> None:
+        self.validator = get_validator(tokenizer, grammar, grammar_start)
+        self.tokenizer = tokenizer
+
+    def __call__(self, token_ids: List[int],
+                 logits: np.ndarray) -> np.ndarray:
+        text = self.tokenizer.decode(token_ids) if token_ids else ""
+        valid, eos_ok = self.validator.valid_token_ids(text)
+        mask = np.zeros(logits.shape[-1], dtype=bool)
+        if valid:
+            mask[np.asarray(valid, dtype=np.int64)] = True
+        eos = self.validator.eos_token_id
+        if eos_ok and eos is not None and eos < logits.shape[-1]:
+            mask[eos] = True
+        out = np.where(mask, logits, np.float32("-inf"))
+        return out.astype(logits.dtype, copy=False)

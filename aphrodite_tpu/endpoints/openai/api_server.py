@@ -167,6 +167,14 @@ class OpenAIServer:
                         f"logit_bias token id {token_id} out of vocab "
                         f"range [0, {self.vocab_size})")
             processors.append(BiasLogitsProcessor(biases))
+        if getattr(req, "grammar", None):
+            from aphrodite_tpu.common.grammar import (
+                GrammarLogitsProcessor)
+            try:
+                processors.append(GrammarLogitsProcessor(
+                    self.tokenizer, req.grammar))
+            except Exception as e:
+                raise ValueError(f"Invalid grammar: {e}") from e
         return processors or None
 
     async def create_completion(self,

@@ -147,6 +147,7 @@ class ChatCompletionRequest(_SamplerFields):
     add_generation_prompt: Optional[bool] = True
     echo: Optional[bool] = False
     temperature: Optional[float] = 0.7
+    grammar: Optional[str] = None
 
 
 class CompletionRequest(_SamplerFields):

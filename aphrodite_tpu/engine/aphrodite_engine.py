@@ -237,7 +237,9 @@ class AphroditeEngine:
                 if seq_group.is_finished():
                     continue        # burst overran this group's stop
                 self._process_sequence_group_outputs(seq_group, outputs)
-                tokens_of[id(seq_group)] += 1
+                # Burst eligibility currently means single-seq groups,
+                # but count per sample so widening it keeps stats right.
+                tokens_of[id(seq_group)] += len(outputs.samples)
         self._record_latencies(scheduled_seq_groups,
                                tokens_of=tokens_of)
         self.scheduler.free_finished_seq_groups()

@@ -219,3 +219,30 @@ def test_metrics_endpoint(server_ctx):
         text = await r.text()
         assert "aphrodite" in text
     run(server_ctx, go)
+
+
+def test_grammar_constrained_completion(server_ctx):
+    """The `grammar` field must constrain output (reference accepts it
+    in the protocol and feeds GrammarLogitsProcessor); invalid grammars
+    must 400 instead of being silently dropped."""
+    grammar = '\nstart: "(" NUMBER ")"\nNUMBER: /[0-9]+/\n'
+
+    async def go(client):
+        r = await client.post("/v1/completions", json={
+            "model": MODEL_KEY, "prompt": "the", "max_tokens": 8,
+            "temperature": 0.0, "grammar": grammar})
+        assert r.status == 200, await r.text()
+        body = await r.json()
+        text = body["choices"][0]["text"]
+        from aphrodite_tpu.common.grammar import GrammarMatcher
+        m = GrammarMatcher(grammar)
+        state = m.root
+        for ch in text:
+            state = m.advance(state, ch)
+            assert state is not None, f"output {text!r} broke grammar"
+
+        r = await client.post("/v1/completions", json={
+            "model": MODEL_KEY, "prompt": "the", "max_tokens": 4,
+            "grammar": "start: !!not a grammar"})
+        assert r.status == 400
+    run(server_ctx, go)

@@ -1,0 +1,154 @@
+"""Grammar-constrained decoding tests (reference
+`aphrodite/common/grammar.py` + its test intent: constrained generation
+must emit only grammar-valid text)."""
+import numpy as np
+import pytest
+
+from aphrodite_tpu.common.grammar import (GrammarLogitsProcessor,
+                                          GrammarMatcher,
+                                          NextTokenValidator)
+
+JSON_ISH = r"""
+start: value
+value: dict | list | STRING | NUMBER
+dict: "{" [pair ("," pair)*] "}"
+pair: STRING ":" value
+list: "[" [value ("," value)*] "]"
+STRING: /"[a-z]*"/
+NUMBER: /[0-9]+/
+%ignore /[ \t\n]+/
+"""
+
+ARITH = r"""
+start: expr
+expr: term (("+"|"-") term)*
+term: NUMBER | "(" expr ")"
+NUMBER: /[0-9]+/
+"""
+
+
+def test_matcher_accepts_valid_prefixes():
+    m = GrammarMatcher(JSON_ISH)
+    state = m.root
+    for ch in '{"ab": [1, 2]}':
+        state = m.advance(state, ch)
+        assert state is not None, ch
+    assert m.can_end(state)
+
+
+def test_matcher_rejects_invalid():
+    m = GrammarMatcher(JSON_ISH)
+    assert m.advance(m.root, "x") is None
+    s = m.advance(m.root, "{")
+    assert m.advance(s, "}") is not None
+    assert m.advance(s, "]") is None
+    assert not m.can_end(s)
+
+
+def test_matcher_partial_terminal():
+    m = GrammarMatcher(JSON_ISH)
+    s = m.advance(m.root, '"ab')      # inside a STRING
+    assert s is not None
+    assert not m.can_end(s)
+    s = m.advance(s, '"')
+    assert m.can_end(s)
+
+
+def test_matcher_multichar_advance():
+    m = GrammarMatcher(ARITH)
+    s = m.advance(m.root, "(1+2)")
+    assert s is not None and m.can_end(s)
+    assert m.advance(m.root, "1+") is not None
+    assert not m.can_end(m.advance(m.root, "1+"))
+    assert m.advance(m.root, ")") is None
+
+
+def test_matcher_longest_match_wins():
+    """Overlapping terminals resolve by longest match, not by terminal
+    sort order (A before AB alphabetically)."""
+    g = r"""
+start: AB "c" | A "d"
+AB: "ab"
+A: "a"
+"""
+    m = GrammarMatcher(g)
+    s = m.advance(m.root, "abc")      # must lex "ab" as AB, not A
+    assert s is not None and m.can_end(s)
+    s = m.advance(m.root, "ad")       # "a" + "d" path still works
+    assert s is not None and m.can_end(s)
+
+
+class FakeTokenizer:
+    """Char/string-level tokenizer with an HF-ish surface."""
+
+    def __init__(self, pieces):
+        self.vocab = {p: i + 3 for i, p in enumerate(pieces)}
+        self.vocab["<s>"] = 0
+        self.vocab["</s>"] = 1
+        self.bos_token = "<s>"
+        self.bos_token_id = 0
+        self.eos_token_id = 1
+        self.all_special_ids = [0, 1]
+        self._by_id = {i: p for p, i in self.vocab.items()}
+
+    def decode(self, ids):
+        return "".join(self._by_id[i] for i in ids)
+
+
+def test_next_token_validator():
+    pieces = ["0", "1", "12", "+", "-", "(", ")", "(1", "x", "+)"]
+    tok = FakeTokenizer(pieces)
+    v = NextTokenValidator(tok, ARITH)
+
+    valid, eos_ok = v.valid_token_ids("")
+    texts = {tok._by_id[i] for i in valid}
+    assert "x" not in texts
+    assert "+" not in texts and "+)" not in texts
+    assert {"0", "1", "12", "(", "(1"} <= texts
+    assert not eos_ok
+
+    valid, eos_ok = v.valid_token_ids("1")
+    texts = {tok._by_id[i] for i in valid}
+    assert eos_ok                      # "1" is a complete expr
+    assert "+" in texts and ")" not in texts
+    assert "(" not in texts
+
+    valid, eos_ok = v.valid_token_ids("(1")
+    texts = {tok._by_id[i] for i in valid}
+    assert ")" in texts and not eos_ok
+
+
+def test_grammar_logits_processor_masks():
+    pieces = ["0", "1", "12", "+", "-", "(", ")", "(1", "x", "+)"]
+    tok = FakeTokenizer(pieces)
+    proc = GrammarLogitsProcessor(tok, ARITH)
+    logits = np.zeros(len(tok.vocab) + 3, dtype=np.float32)
+    out = proc([], logits.copy())
+    assert out[tok.vocab["x"]] == -np.inf
+    assert out[tok.vocab["("]] == 0.0
+    assert out[tok.eos_token_id] == -np.inf
+    out = proc([tok.vocab["1"]], logits.copy())
+    assert out[tok.eos_token_id] == 0.0
+    assert out[tok.vocab[")"]] == -np.inf
+
+
+def test_engine_grammar_constrained_generation(tiny_llm):
+    """End-to-end: greedy decoding under a parenthesized-number grammar
+    yields text the grammar accepts at every prefix."""
+    from aphrodite_tpu.common.sampling_params import SamplingParams
+
+    tokenizer = tiny_llm.engine.tokenizer.tokenizer
+    grammar = r"""
+start: "(" NUMBER ")"
+NUMBER: /[0-9]+/
+"""
+    proc = GrammarLogitsProcessor(tokenizer, grammar)
+    sp = SamplingParams(temperature=0.0, max_tokens=8,
+                        logits_processors=[proc])
+    out = tiny_llm.generate(["the"], sp)[0].outputs[0]
+    text = out.text
+    m = GrammarMatcher(grammar)
+    state = m.root
+    for ch in text:
+        state = m.advance(state, ch)
+        assert state is not None, f"invalid output {text!r} at {ch!r}"
