@@ -102,15 +102,17 @@ class GrammarMatcher:
         }
         self._ignored = set(self.lark.lexer_conf.ignore)
         base = self.lark.parse_interactive().as_immutable()
-        self._parsers: Dict[int, object] = {}
+        self._parsers: Dict[tuple, object] = {}
         self.root = (self._intern(base), "")
-        self._advance_memo: Dict[Tuple[int, str, str], object] = {}
-        self._accepts_memo: Dict[int, Set[str]] = {}
+        self._advance_memo: Dict[tuple, object] = {}
+        self._accepts_memo: Dict[tuple, Set[str]] = {}
 
     # -- parser interning --
 
-    def _intern(self, parser) -> int:
-        key = hash(tuple(parser.parser_state.state_stack))
+    def _intern(self, parser):
+        # Key by the state-stack tuple itself (not its hash): dict
+        # handles collisions, a raw hash key would conflate states.
+        key = tuple(parser.parser_state.state_stack)
         self._parsers.setdefault(key, parser)
         return key
 
@@ -130,6 +132,8 @@ class GrammarMatcher:
         if memo_key in self._advance_memo:
             return self._advance_memo[memo_key]
         result = self._advance(parser_key, partial + text)
+        if len(self._advance_memo) > 500_000:   # bound long-lived servers
+            self._advance_memo.clear()
         self._advance_memo[memo_key] = result
         return result
 
@@ -214,7 +218,10 @@ class NextTokenValidator:
     (reference `grammar.py:391-428`)."""
 
     # Tries are grammar-independent; share per (tokenizer, charset).
-    _trie_cache: Dict[Tuple[int, Optional[frozenset]], TokenTrie] = {}
+    # Values hold the tokenizer too so its id() can't be recycled while
+    # the cache entry lives.
+    _trie_cache: Dict[Tuple[int, Optional[frozenset]],
+                      Tuple[object, TokenTrie]] = {}
 
     def __init__(self, tokenizer, grammar: str,
                  grammar_start: str = "start",
@@ -224,11 +231,11 @@ class NextTokenValidator:
         self.eos_token_id = tokenizer.eos_token_id
         chars_key = frozenset(legal_chars) if legal_chars else None
         cache_key = (id(tokenizer), chars_key)
-        trie = self._trie_cache.get(cache_key)
-        if trie is None:
-            trie = self._build_trie(tokenizer, legal_chars)
-            self._trie_cache[cache_key] = trie
-        self.trie = trie
+        entry = self._trie_cache.get(cache_key)
+        if entry is None or entry[0] is not tokenizer:
+            entry = (tokenizer, self._build_trie(tokenizer, legal_chars))
+            self._trie_cache[cache_key] = entry
+        self.trie = entry[1]
         # Decoded-prefix -> parser state for incremental stepping.
         self._text_states: Dict[str, object] = {"": self.matcher.root}
 
@@ -260,6 +267,8 @@ class NextTokenValidator:
         got = self._text_states.get(text)
         if got is not None:
             return got
+        if len(self._text_states) > 100_000:    # bound long-lived servers
+            self._text_states = {"": self.matcher.root}
         # Find the longest cached prefix and advance the delta.
         for cut in range(len(text) - 1, -1, -1):
             prev = self._text_states.get(text[:cut])
@@ -315,10 +324,44 @@ class GrammarLogitsProcessor:
                  grammar_start: str = "start") -> None:
         self.validator = get_validator(tokenizer, grammar, grammar_start)
         self.tokenizer = tokenizer
+        # Incremental-decode state: re-decoding the whole output every
+        # step would make a request O(n^2) in generated length.
+        self._n_seen = 0
+        self._text = ""
+        self._prev_tokens: Optional[List[str]] = None
+        self._prefix_offset = 0
+        self._read_offset = 0
+        self._last_id: Optional[int] = None
+
+    def _decode(self, token_ids: List[int]) -> str:
+        from aphrodite_tpu.transformers_utils.tokenizer import (
+            detokenize_incrementally)
+        if not token_ids:
+            return ""
+        if not hasattr(self.tokenizer, "convert_ids_to_tokens"):
+            return self.tokenizer.decode(token_ids)    # simple tokenizers
+        if self._n_seen > len(token_ids) or \
+                self._n_seen and token_ids[self._n_seen - 1] != \
+                self._last_id:
+            # Sequence restarted/forked: rebuild from scratch.
+            self._n_seen = 0
+            self._text = ""
+            self._prev_tokens = None
+            self._prefix_offset = 0
+            self._read_offset = 0
+        for i in range(self._n_seen, len(token_ids)):
+            (self._prev_tokens, delta, self._prefix_offset,
+             self._read_offset) = detokenize_incrementally(
+                self.tokenizer, token_ids[:i + 1], self._prev_tokens,
+                self._prefix_offset, self._read_offset)
+            self._text += delta
+        self._n_seen = len(token_ids)
+        self._last_id = token_ids[-1]
+        return self._text
 
     def __call__(self, token_ids: List[int],
                  logits: np.ndarray) -> np.ndarray:
-        text = self.tokenizer.decode(token_ids) if token_ids else ""
+        text = self._decode(list(token_ids))
         valid, eos_ok = self.validator.valid_token_ids(text)
         mask = np.zeros(logits.shape[-1], dtype=bool)
         if valid:

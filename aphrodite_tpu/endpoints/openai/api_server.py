@@ -151,7 +151,7 @@ class OpenAIServer:
                           "model_not_found", 404)
         return None
 
-    def _build_processors(self, req) -> Optional[list]:
+    async def _build_processors(self, req) -> Optional[list]:
         processors = []
         if req.logit_bias:
             try:
@@ -168,11 +168,18 @@ class OpenAIServer:
                         f"range [0, {self.vocab_size})")
             processors.append(BiasLogitsProcessor(biases))
         if getattr(req, "grammar", None):
+            import asyncio
+            import functools as _ft
+
             from aphrodite_tpu.common.grammar import (
                 GrammarLogitsProcessor)
             try:
-                processors.append(GrammarLogitsProcessor(
-                    self.tokenizer, req.grammar))
+                # First use of a grammar compiles LALR tables and walks
+                # the whole vocab — run off the event loop.
+                processors.append(
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, _ft.partial(GrammarLogitsProcessor,
+                                          self.tokenizer, req.grammar)))
             except Exception as e:
                 raise ValueError(f"Invalid grammar: {e}") from e
         return processors or None
@@ -201,7 +208,7 @@ class OpenAIServer:
 
         try:
             sampling_params = req.to_sampling_params(
-                req.max_tokens, self._build_processors(req))
+                req.max_tokens, await self._build_processors(req))
         except ValueError as e:
             return _error(str(e))
 
@@ -315,7 +322,7 @@ class OpenAIServer:
                 prompt_ids = self.tokenizer.encode(prompt)
                 max_tokens = self.max_model_len - len(prompt_ids)
             sampling_params = req.to_sampling_params(
-                max_tokens, self._build_processors(req))
+                max_tokens, await self._build_processors(req))
         except ValueError as e:
             return _error(str(e))
 

@@ -223,9 +223,14 @@ class AphroditeEngine:
         want = max(1, min([max_steps] + remaining))
         if want <= 1:
             return 1
+        # Bucket to powers of two: each burst length is its own compiled
+        # scan program, and compiles are expensive. Blocks reserved
+        # beyond the bucketed length stay on the sequences' block tables
+        # and satisfy the next round's reservation.
+        want = 1 << (want.bit_length() - 1)
         granted = self.scheduler.reserve_decode_burst(
             seq_group_metadata_list, want - 1)
-        return 1 + granted
+        return 1 << ((1 + granted).bit_length() - 1)
 
     def _process_burst_outputs(
             self, outputs_list: List[SamplerOutput],

@@ -96,9 +96,9 @@ class ModelRunner:
             static_argnames=("is_prompt", "use_prefix"),
             donate_argnums=(3,),      # kv_caches
         )
-        self._burst_step_fn = jax.jit(
-            self._burst_step,
-            static_argnames=("max_best_of", "num_topk"),
+        self._burst_scan_fn = jax.jit(
+            self._burst_scan,
+            static_argnames=("max_best_of", "num_topk", "num_steps"),
             donate_argnums=(3,),      # kv_caches
         )
         self._copy_fn = jax.jit(self._copy_blocks, donate_argnums=(0,))
@@ -143,6 +143,28 @@ class ModelRunner:
             slot_mapping=next_slots,
             context_lens=metadata.context_lens + 1)
         return packed, next_ids, next_pos, next_meta, new_caches
+
+    def _burst_scan(self, params, input_ids, positions, kv_caches,
+                    metadata, tensors, bases, salt1, salt2, greedy_mask,
+                    *, num_steps: int, max_best_of: int, num_topk: int):
+        """The whole K-step decode burst as ONE compiled program
+        (lax.scan over _burst_step). On this platform each dispatch
+        costs milliseconds of host<->device round-trip, so K separate
+        step dispatches dominate the decode loop; one scan dispatch
+        amortizes it to nothing. Returns stacked packed results
+        [num_steps, rows, w]."""
+        def body(carry, t):
+            ids, pos, meta, kv = carry
+            packed, ids, pos, meta, kv = self._burst_step(
+                params, ids, pos, kv, meta, tensors, bases, salt1,
+                salt2, greedy_mask, t,
+                max_best_of=max_best_of, num_topk=num_topk)
+            return (ids, pos, meta, kv), packed
+
+        (_, _, _, kv_caches), packed = jax.lax.scan(
+            body, (input_ids, positions, metadata, kv_caches),
+            jnp.arange(num_steps, dtype=jnp.int32))
+        return packed, kv_caches
 
     def _copy_blocks(self, kv_caches, src, dst):
         return [
@@ -457,10 +479,10 @@ class ModelRunner:
         blocks_to_copy: Optional[Dict[int, List[int]]] = None,
     ) -> Tuple[List[SamplerOutput], List[Tuple[jax.Array, jax.Array]]]:
         """Run `num_steps` decode iterations with device-side token
-        feedback: 2*num_steps async dispatches, ONE host sync at the end
-        (the stacked packed results). Eligibility (single-seq greedy/
-        random groups, no history-dependent sampling stages) is enforced
-        by the engine."""
+        feedback as ONE compiled scan dispatch and ONE host sync (the
+        stacked packed results). Eligibility (single-seq greedy/random
+        groups, no history-dependent sampling stages) is enforced by the
+        engine."""
         kv_caches = self._apply_block_copies(kv_caches, blocks_to_copy)
 
         inputs, sampling = self._prepare_decode(seq_group_metadata_list)
@@ -487,15 +509,12 @@ class ModelRunner:
 
         ids, pos, meta = (inputs["input_ids"], inputs["positions"],
                           inputs["metadata"])
-        packed_steps = []
-        for t in range(num_steps):
-            packed, ids, pos, meta, kv_caches = self._burst_step_fn(
-                params, ids, pos, kv_caches, meta, tensors, bases, salt1,
-                salt2, greedy_mask, np.int32(t),
-                max_best_of=plan.max_best_of, num_topk=plan.num_topk)
-            packed_steps.append(packed)
+        packed, kv_caches = self._burst_scan_fn(
+            params, ids, pos, kv_caches, meta, tensors, bases, salt1,
+            salt2, greedy_mask, num_steps=num_steps,
+            max_best_of=plan.max_best_of, num_topk=plan.num_topk)
 
-        all_packed = np.asarray(jnp.stack(packed_steps))   # ONE sync
+        all_packed = np.asarray(packed)                    # ONE sync
         outputs = [
             self.sampler.finalize(sampling, plan, all_packed[t], None)
             for t in range(num_steps)

@@ -98,25 +98,85 @@ def torch_bin_weights_iterator(
         del state
 
 
+def resolve_model_path(model_path: str) -> str:
+    """Local dirs/files pass through; anything else resolves via the HF
+    hub cache with a per-repo file lock so concurrent server replicas
+    download once (reference `hf_downloader.py:89-107` lock +
+    snapshot_download)."""
+    if os.path.isdir(model_path) or os.path.isfile(model_path):
+        return model_path
+    from huggingface_hub import snapshot_download
+    lock_dir = os.environ.get("APHRODITE_CACHE",
+                              os.path.expanduser("~/.cache/aphrodite"))
+    os.makedirs(lock_dir, exist_ok=True)
+    lock_path = os.path.join(
+        lock_dir, model_path.replace("/", "--") + ".lock")
+    with _file_lock(lock_path):
+        return snapshot_download(
+            model_path,
+            allow_patterns=["*.safetensors", "*.bin", "*.json", "*.model",
+                            "*.txt"])
+
+
+class _file_lock:
+    """Minimal advisory flock (the reference uses the `filelock`
+    package; fcntl avoids the dependency)."""
+
+    def __init__(self, path: str) -> None:
+        self._path = path
+        self._fd = None
+
+    def __enter__(self):
+        import fcntl
+        self._fd = open(self._path, "w")
+        fcntl.flock(self._fd, fcntl.LOCK_EX)
+        return self
+
+    def __exit__(self, *exc):
+        import fcntl
+        fcntl.flock(self._fd, fcntl.LOCK_UN)
+        self._fd.close()
+
+
+def _np_cache_iterator(model_path: str
+                       ) -> Iterator[Tuple[str, np.ndarray]]:
+    """Stream from (building on first use) a numpy-memmap cache of a
+    torch-bin checkpoint (reference npcache, `hf_downloader.py:307-340`).
+    After the one-time conversion, loads never pay torch deserialization
+    and tensors arrive memory-mapped."""
+    cache_dir = os.path.join(model_path, "np")
+    manifest = os.path.join(cache_dir, "weight_names.json")
+    os.makedirs(cache_dir, exist_ok=True)
+    with _file_lock(os.path.join(cache_dir, "convert.lock")):
+        if not os.path.exists(manifest):
+            names = []
+            for name, arr in torch_bin_weights_iterator(model_path):
+                np.save(os.path.join(cache_dir,
+                                     name.replace("/", "--")), arr)
+                names.append(name)
+            with open(manifest, "w") as f:
+                json.dump(names, f)
+    with open(manifest) as f:
+        names = json.load(f)
+    for name in names:
+        yield name, np.load(
+            os.path.join(cache_dir, name.replace("/", "--") + ".npy"),
+            mmap_mode="r")
+
+
 def hf_model_weights_iterator(
     model_path: str,
     load_format: str = "auto",
 ) -> Iterator[Tuple[str, np.ndarray]]:
     """Yield (name, numpy array) for every checkpoint tensor
-    (reference `hf_downloader.py:285-352`, minus hub download — the model
-    path must be local or already cached)."""
+    (reference `hf_downloader.py:285-352`)."""
+    model_path = resolve_model_path(model_path)
     if model_path.endswith(".gguf") and os.path.isfile(model_path):
         # GGUF single-file checkpoint: dequantize blocks at load
         # (reference `hf_downloader.py:293-295`).
         from aphrodite_tpu.modeling.gguf import gguf_weights_iterator
         yield from gguf_weights_iterator(model_path)
         return
-    if not os.path.isdir(model_path):
-        # Resolve via HF cache/download (requires network for new repos).
-        from huggingface_hub import snapshot_download
-        model_path = snapshot_download(
-            model_path,
-            allow_patterns=["*.safetensors", "*.bin", "*.json"])
 
     has_safetensors = bool(glob.glob(os.path.join(model_path,
                                                   "*.safetensors")))
@@ -127,6 +187,11 @@ def hf_model_weights_iterator(
             raise ValueError(
                 f"No *.safetensors files found in {model_path}.")
         yield from safetensors_weights_iterator(model_path)
+    elif load_format == "npcache":
+        if not has_bins:
+            raise ValueError(
+                f"npcache needs *.bin files in {model_path}.")
+        yield from _np_cache_iterator(model_path)
     elif load_format in ("auto", "pt"):
         if not has_bins:
             raise ValueError(

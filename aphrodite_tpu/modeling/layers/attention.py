@@ -131,15 +131,26 @@ class PagedAttention:
                 self.alibi_slopes is None and self.head_size % 128 == 0 \
                 and k_pages.dtype in (jnp.bfloat16, jnp.float32):
             from aphrodite_tpu.ops.pallas.paged_attention import (
-                paged_decode_attention)
+                paged_decode_attention, paged_decode_attention_allheads)
             # Padded table entries hold an out-of-range page id (the XLA
             # gather's fill convention); the kernel DMAs pages raw, so
             # clamp pads to a valid page — masked off by context_lens.
             tables = jnp.minimum(metadata.block_tables,
                                  k_pages.shape[1] - 1)
-            out = paged_decode_attention(
-                q3, k_pages, v_pages, tables,
-                metadata.context_lens, scale=self.scale)
+            # All-heads-per-cell variant wins for GQA: its VMEM scratch
+            # and redundant-FLOP factor scale with num_KV_heads, so gate
+            # on few kv heads and a real grouping factor; MHA keeps the
+            # per-head kernel.
+            if self.num_kv_heads <= 8 and \
+                    self.num_heads >= 2 * self.num_kv_heads and \
+                    self.num_heads <= 64:
+                out = paged_decode_attention_allheads(
+                    q3, k_pages, v_pages, tables,
+                    metadata.context_lens, scale=self.scale)
+            else:
+                out = paged_decode_attention(
+                    q3, k_pages, v_pages, tables,
+                    metadata.context_lens, scale=self.scale)
         else:
             out = paged_decode_attention_ref(
                 q3, k_pages, v_pages, metadata.block_tables,

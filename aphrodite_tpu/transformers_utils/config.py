@@ -14,6 +14,20 @@ def get_config(model: str,
         # (reference `transformers_utils/config.py:77-78`).
         from aphrodite_tpu.modeling.gguf import extract_gguf_config
         return extract_gguf_config(model)
+    # Checkpoints whose model_type transformers doesn't know load via
+    # our config classes without trust_remote_code (reference
+    # `transformers_utils/config.py:66-67,93-94`).
+    import json as _json
+    import os as _os
+    cfg_json = _os.path.join(model, "config.json")
+    if _os.path.isfile(cfg_json):
+        with open(cfg_json) as f:
+            declared = _json.load(f).get("model_type", "").lower()
+        if declared in ("yi", "qwen"):
+            from aphrodite_tpu.transformers_utils.configs import (
+                QWenConfig, YiConfig)
+            cls = YiConfig if declared == "yi" else QWenConfig
+            return cls.from_pretrained(model, revision=revision)
     try:
         config = AutoConfig.from_pretrained(
             model, trust_remote_code=trust_remote_code, revision=revision)

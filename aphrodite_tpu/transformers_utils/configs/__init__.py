@@ -1,0 +1,8 @@
+"""Custom HF config classes for checkpoints whose config.json declares a
+model_type transformers doesn't ship (reference
+`aphrodite/transformers_utils/configs/`): loading them through these
+classes avoids trust_remote_code."""
+from aphrodite_tpu.transformers_utils.configs.qwen import QWenConfig
+from aphrodite_tpu.transformers_utils.configs.yi import YiConfig
+
+__all__ = ["QWenConfig", "YiConfig"]

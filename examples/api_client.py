@@ -1,0 +1,39 @@
+"""Minimal OpenAI-API client for a running server (reference
+examples/api_client.py).
+
+Start the server first:
+    python -m aphrodite_tpu.endpoints.openai.api_server --model <model>
+"""
+import argparse
+import json
+import urllib.request
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="http://127.0.0.1:2242")
+    parser.add_argument("--model", required=True)
+    parser.add_argument("--prompt", default="The TPU is")
+    parser.add_argument("--max-tokens", type=int, default=64)
+    parser.add_argument("--grammar", default=None,
+                        help="optional lark grammar constraining output")
+    args = parser.parse_args()
+
+    body = {
+        "model": args.model,
+        "prompt": args.prompt,
+        "max_tokens": args.max_tokens,
+        "temperature": 0.7,
+    }
+    if args.grammar:
+        body["grammar"] = args.grammar
+    req = urllib.request.Request(
+        f"{args.host}/v1/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    resp = json.load(urllib.request.urlopen(req))
+    print(resp["choices"][0]["text"])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""Offline batched inference with the LLM API (reference
+examples/offline_inference.py).
+
+Usage:
+    python examples/offline_inference.py --model <hf-id-or-local-path>
+"""
+import argparse
+
+from aphrodite_tpu import LLM, SamplingParams
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", required=True,
+                        help="HF model id, local dir, or .gguf file")
+    parser.add_argument("--max-tokens", type=int, default=64)
+    parser.add_argument("--temperature", type=float, default=0.8)
+    args = parser.parse_args()
+
+    prompts = [
+        "Hello, my name is",
+        "The president of the United States is",
+        "The capital of France is",
+        "The future of AI is",
+    ]
+    sampling = SamplingParams(temperature=args.temperature, top_p=0.95,
+                              max_tokens=args.max_tokens)
+
+    llm = LLM(model=args.model)
+    for out in llm.generate(prompts, sampling):
+        print(f"Prompt: {out.prompt!r}")
+        print(f"Generated: {out.outputs[0].text!r}")
+        print()
+
+
+if __name__ == "__main__":
+    main()

@@ -184,3 +184,24 @@ def test_prefill_with_prefix_context():
                             jnp.array(ctx), jnp.array(kv_valid), scale)
     np.testing.assert_allclose(np.array(got), expected, rtol=2e-5,
                                atol=2e-5)
+
+
+@pytest.mark.parametrize("num_q_heads,num_kv_heads,pages_per_chunk",
+                         [(4, 4, 2), (4, 2, 4), (8, 1, 8), (8, 2, 1),
+                          (32, 8, 4)])
+def test_pallas_decode_allheads_matches_oracle(num_q_heads, num_kv_heads,
+                                               pages_per_chunk):
+    from aphrodite_tpu.ops.pallas.paged_attention import (
+        paged_decode_attention_allheads)
+    q, k_pages, v_pages, bt, ctx = make_problem(num_q_heads=num_q_heads,
+                                                num_kv_heads=num_kv_heads,
+                                                dim=128, page_size=8,
+                                                pages_per_seq=8, pages=32)
+    scale = 1.0 / np.sqrt(128)
+    expected = numpy_paged_attention(q, k_pages, v_pages, bt, ctx, scale)
+    got = paged_decode_attention_allheads(
+        jnp.array(q), jnp.array(k_pages), jnp.array(v_pages),
+        jnp.array(bt), jnp.array(ctx), scale=scale,
+        pages_per_chunk=pages_per_chunk, interpret=True)
+    np.testing.assert_allclose(np.array(got), expected, rtol=2e-3,
+                               atol=2e-3)

@@ -1,0 +1,68 @@
+"""Weight-acquisition tests: npcache streaming, custom config classes,
+path resolution (reference hf_downloader npcache `:307-340`, config
+registry `transformers_utils/config.py:8-10`)."""
+import json
+
+import numpy as np
+import pytest
+
+
+def test_npcache_roundtrip(tmp_path):
+    import torch
+
+    from aphrodite_tpu.modeling.hf_loader import hf_model_weights_iterator
+
+    state = {
+        "a.weight": torch.arange(12, dtype=torch.float32).reshape(3, 4),
+        "b.bias": torch.ones(5),
+    }
+    torch.save(state, tmp_path / "pytorch_model.bin")
+    first = dict(hf_model_weights_iterator(str(tmp_path), "npcache"))
+    assert set(first) == {"a.weight", "b.bias"}
+    np.testing.assert_array_equal(np.asarray(first["a.weight"]),
+                                  state["a.weight"].numpy())
+    # Cache dir must now exist and serve without the .bin.
+    assert (tmp_path / "np" / "weight_names.json").exists()
+    (tmp_path / "pytorch_model.bin").unlink()
+    # Keep a stub .bin so format detection passes; loader must hit cache.
+    (tmp_path / "pytorch_model.bin").touch()
+    second = dict(hf_model_weights_iterator(str(tmp_path), "npcache"))
+    np.testing.assert_array_equal(np.asarray(second["b.bias"]),
+                                  np.ones(5))
+
+
+def test_yi_qwen_config_classes(tmp_path):
+    from aphrodite_tpu.transformers_utils.config import get_config
+
+    yi_dir = tmp_path / "yi"
+    yi_dir.mkdir()
+    (yi_dir / "config.json").write_text(json.dumps({
+        "model_type": "Yi", "architectures": ["YiForCausalLM"],
+        "hidden_size": 128, "num_attention_heads": 4,
+        "num_key_value_heads": 2, "num_hidden_layers": 2,
+        "intermediate_size": 256, "vocab_size": 1024,
+    }))
+    cfg = get_config(str(yi_dir))
+    assert cfg.model_type.lower() == "yi"
+    assert cfg.num_key_value_heads == 2
+    assert cfg.rope_theta == 5000000.0       # Yi default
+
+    qwen_dir = tmp_path / "qwen"
+    qwen_dir.mkdir()
+    (qwen_dir / "config.json").write_text(json.dumps({
+        "model_type": "qwen", "architectures": ["QWenLMHeadModel"],
+        "hidden_size": 128, "num_attention_heads": 4,
+        "num_hidden_layers": 2, "intermediate_size": 256,
+        "vocab_size": 1024,
+    }))
+    cfg = get_config(str(qwen_dir))
+    assert cfg.model_type == "qwen"
+    assert cfg.no_bias is True
+
+
+def test_resolve_model_path_local(tmp_path):
+    from aphrodite_tpu.modeling.hf_loader import resolve_model_path
+    assert resolve_model_path(str(tmp_path)) == str(tmp_path)
+    f = tmp_path / "m.gguf"
+    f.touch()
+    assert resolve_model_path(str(f)) == str(f)
