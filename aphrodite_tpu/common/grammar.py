@@ -324,14 +324,12 @@ class GrammarLogitsProcessor:
                  grammar_start: str = "start") -> None:
         self.validator = get_validator(tokenizer, grammar, grammar_start)
         self.tokenizer = tokenizer
-        # Incremental-decode state: re-decoding the whole output every
-        # step would make a request O(n^2) in generated length.
-        self._n_seen = 0
-        self._text = ""
-        self._prev_tokens: Optional[List[str]] = None
-        self._prefix_offset = 0
-        self._read_offset = 0
-        self._last_id: Optional[int] = None
+        # Incremental-decode states keyed by the FULL token-id prefix:
+        # one processor instance serves every sibling sequence of an
+        # n>1 / best_of request (the sampler shares the params object),
+        # so per-prefix states are required for correctness, and they
+        # make each step O(1) decodes instead of O(n).
+        self._states: Dict[tuple, tuple] = {}
 
     def _decode(self, token_ids: List[int]) -> str:
         from aphrodite_tpu.transformers_utils.tokenizer import (
@@ -340,24 +338,28 @@ class GrammarLogitsProcessor:
             return ""
         if not hasattr(self.tokenizer, "convert_ids_to_tokens"):
             return self.tokenizer.decode(token_ids)    # simple tokenizers
-        if self._n_seen > len(token_ids) or \
-                self._n_seen and token_ids[self._n_seen - 1] != \
-                self._last_id:
-            # Sequence restarted/forked: rebuild from scratch.
-            self._n_seen = 0
-            self._text = ""
-            self._prev_tokens = None
-            self._prefix_offset = 0
-            self._read_offset = 0
-        for i in range(self._n_seen, len(token_ids)):
-            (self._prev_tokens, delta, self._prefix_offset,
-             self._read_offset) = detokenize_incrementally(
-                self.tokenizer, token_ids[:i + 1], self._prev_tokens,
-                self._prefix_offset, self._read_offset)
-            self._text += delta
-        self._n_seen = len(token_ids)
-        self._last_id = token_ids[-1]
-        return self._text
+        key = tuple(token_ids)
+        got = self._states.get(key)
+        if got is not None:
+            return got[3]
+        if len(self._states) > 8192:
+            self._states.clear()
+        # Extend the parent prefix's state, or rebuild from scratch.
+        start = len(key) - 1 if key[:-1] in self._states else 0
+        state = self._states.get(key[:-1], (None, 0, 0, ""))
+        prev_tokens, prefix_off, read_off, text = state
+        for i in range(start, len(token_ids)):
+            new_toks, delta, prefix_off, read_off = \
+                detokenize_incrementally(
+                    self.tokenizer, token_ids[:i + 1], prev_tokens,
+                    prefix_off, read_off)
+            # First call returns ALL tokens, later calls the appended
+            # one — accumulate like the engine does.
+            prev_tokens = new_toks if prev_tokens is None \
+                else prev_tokens + new_toks
+            text += delta
+        self._states[key] = (prev_tokens, prefix_off, read_off, text)
+        return text
 
     def __call__(self, token_ids: List[int],
                  logits: np.ndarray) -> np.ndarray:

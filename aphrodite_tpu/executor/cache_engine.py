@@ -74,14 +74,28 @@ class CacheEngine:
         quant = _CACHE_DTYPES[cache_config.cache_dtype]
         self.dtype = quant if quant is not None else model_dtype
 
+        if cache_config.cache_dtype == "int8":
+            from aphrodite_tpu.ops.kv_quant import set_kv_scale
+            import os
+            set_kv_scale(float(os.environ.get("APHRODITE_KV_SCALE",
+                                              "0.05")))
+
         self.kv_caches: List[KVCache] = self._allocate_device()
         # Host swap pool: per layer [2, heads_i, pages, page, dim] numpy
         # (list because DeciLM-style models vary heads per layer).
+        # Stored in the CACHE dtype (f32 would double/quadruple host RAM).
+        # np.zeros at init reserves only virtual memory — physical pages
+        # commit on first write — so this fails fast on absurd sizes
+        # without stalling startup or the first preemption.
         self._host_pool: Optional[List[np.ndarray]] = None
         if self.num_host_pages > 0:
+            self._ensure_host_pool()
+
+    def _ensure_host_pool(self) -> None:
+        if self._host_pool is None:
             self._host_pool = [
                 np.zeros((2, heads, self.num_host_pages, self.page_size,
-                          self.head_size), dtype=np.float32)
+                          self.head_size), dtype=np.dtype(self.dtype))
                 for heads in self.kv_heads_per_layer
             ]
 
@@ -117,14 +131,14 @@ class CacheEngine:
         """Device pages -> host pool (reference swap_out :141)."""
         if not mapping:
             return
+        self._ensure_host_pool()
         src = np.fromiter(mapping.keys(), dtype=np.int64)
         dst = np.fromiter(mapping.values(), dtype=np.int64)
         for layer, (k_pages, v_pages) in enumerate(self.kv_caches):
-            # One bulk gather per side, then a single host transfer.
-            k_host = np.asarray(jnp.take(k_pages, src, axis=1),
-                                dtype=np.float32)
-            v_host = np.asarray(jnp.take(v_pages, src, axis=1),
-                                dtype=np.float32)
+            # One bulk gather per side, then a single host transfer in
+            # the page dtype (no f32 inflation).
+            k_host = np.asarray(jnp.take(k_pages, src, axis=1))
+            v_host = np.asarray(jnp.take(v_pages, src, axis=1))
             self._host_pool[layer][0][:, dst] = k_host
             self._host_pool[layer][1][:, dst] = v_host
 
@@ -132,6 +146,7 @@ class CacheEngine:
         """Host pool -> device pages (reference swap_in :136)."""
         if not mapping:
             return
+        self._ensure_host_pool()
         src = np.fromiter(mapping.keys(), dtype=np.int64)
         dst = np.fromiter(mapping.values(), dtype=np.int64)
         new_caches: List[KVCache] = []

@@ -188,9 +188,12 @@ def hf_model_weights_iterator(
                 f"No *.safetensors files found in {model_path}.")
         yield from safetensors_weights_iterator(model_path)
     elif load_format == "npcache":
-        if not has_bins:
+        has_cache = os.path.exists(
+            os.path.join(model_path, "np", "weight_names.json"))
+        if not (has_bins or has_cache):
             raise ValueError(
-                f"npcache needs *.bin files in {model_path}.")
+                f"npcache needs *.bin files (or an existing np/ cache) "
+                f"in {model_path}.")
         yield from _np_cache_iterator(model_path)
     elif load_format in ("auto", "pt"):
         if not has_bins:

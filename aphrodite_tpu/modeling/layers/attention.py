@@ -101,8 +101,13 @@ class PagedAttention:
         if metadata.use_prefix:
             # Attend over [cached prefix ; this chunk] gathered from pages
             # (reference prefix path, triton context_attention_fwd).
+            from aphrodite_tpu.ops.kv_quant import dequant_scale
+            kv_s = dequant_scale(k_pages.dtype)
             kv_k = gather_pages(k_pages, metadata.block_tables)
             kv_v = gather_pages(v_pages, metadata.block_tables)
+            if kv_s != 1.0:
+                kv_k = kv_k.astype(jnp.float32) * kv_s
+                kv_v = kv_v.astype(jnp.float32) * kv_s
             # [b, Hkv, ctx, d] -> [b, ctx, Hkv, d]
             kv_k = kv_k.swapaxes(1, 2)
             kv_v = kv_v.swapaxes(1, 2)
@@ -125,11 +130,16 @@ class PagedAttention:
         # window and block tables wrap (reference model_runner.py:278-293),
         # so the kernels need no window logic in decode.
         # Mosaic tiling: DMA slice last dim must be 128-aligned, so small
-        # heads (e.g. 64) take the XLA gather path for now; quantized
-        # (fp8) pages also use the XLA path pending a quantized kernel.
+        # heads (e.g. 64) take the XLA gather path for now. Quantized
+        # pages (int8/fp8) run in-kernel: the int8 scale folds into the
+        # score scale and output epilogue (see ops/kv_quant.py).
+        from aphrodite_tpu.ops.kv_quant import dequant_scale
+        quant_ok = k_pages.dtype in (jnp.bfloat16, jnp.float32) or (
+            k_pages.dtype in (jnp.int8, jnp.float8_e5m2) and
+            k_pages.shape[2] % 32 == 0)     # 8-bit sublane tile
         if self.use_pallas and jax.default_backend() == "tpu" and \
                 self.alibi_slopes is None and self.head_size % 128 == 0 \
-                and k_pages.dtype in (jnp.bfloat16, jnp.float32):
+                and quant_ok:
             from aphrodite_tpu.ops.pallas.paged_attention import (
                 paged_decode_attention, paged_decode_attention_allheads)
             # Padded table entries hold an out-of-range page id (the XLA
@@ -146,11 +156,13 @@ class PagedAttention:
                     self.num_heads <= 64:
                 out = paged_decode_attention_allheads(
                     q3, k_pages, v_pages, tables,
-                    metadata.context_lens, scale=self.scale)
+                    metadata.context_lens, scale=self.scale,
+                    kv_scale=dequant_scale(k_pages.dtype))
             else:
                 out = paged_decode_attention(
                     q3, k_pages, v_pages, tables,
-                    metadata.context_lens, scale=self.scale)
+                    metadata.context_lens, scale=self.scale,
+                    kv_scale=dequant_scale(k_pages.dtype))
         else:
             out = paged_decode_attention_ref(
                 q3, k_pages, v_pages, metadata.block_tables,

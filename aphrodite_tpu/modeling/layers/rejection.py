@@ -1,0 +1,81 @@
+"""Modified rejection sampling for speculative decoding.
+
+Reference: `aphrodite/modeling/layers/rejection.py:9-352` (torch
+implementation of "Accelerating Large Language Model Decoding with
+Speculative Sampling", arXiv:2302.01318). TPU-native rewrite: a pure
+jittable function over [batch, k, vocab] probability tensors — no
+module state, no device bookkeeping; acceptance, recovered-distribution
+sampling, and the after-first-rejection masking are all dense vector
+ops. Like the reference, the sampler is present-but-unwired: the
+speculative-decoding scheduler lands in a later round, and the
+statistical test (tests/samplers/test_rejection.py) pins the output
+distribution to the target model's.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _categorical(key: jax.Array, probs: jax.Array) -> jax.Array:
+    """Sample from the trailing-axis distribution via the Gumbel trick
+    (probs may contain zeros; log is masked)."""
+    logits = jnp.log(jnp.maximum(probs, 1e-38))
+    gumbel = jax.random.gumbel(key, probs.shape, dtype=jnp.float32)
+    return jnp.argmax(logits + gumbel, axis=-1)
+
+
+def rejection_sample(
+    key: jax.Array,
+    target_probs: jax.Array,      # [batch, k, vocab] f32
+    bonus_token_ids: jax.Array,   # [batch] int32
+    draft_probs: jax.Array,       # [batch, k, vocab] f32
+    draft_token_ids: jax.Array,   # [batch, k] int32
+) -> Tuple[jax.Array, jax.Array]:
+    """Accept/reject k speculative tokens per sequence.
+
+    Returns (output_token_ids [batch, k+1], num_accepted [batch]).
+    Position j emits: the draft token while all previous drafts were
+    accepted; the token re-sampled from the RECOVERED distribution
+    norm(max(0, p_target - p_draft)) at the first rejection; -1 after
+    it. If every draft is accepted, the bonus token fills slot k
+    (reference forward `:42-102`, _get_accepted `:133`,
+    _get_recovered_probs `:179`)."""
+    batch, k, vocab = target_probs.shape
+    key_u, key_r = jax.random.split(key)
+
+    # Acceptance: u < p_target(tok) / p_draft(tok).
+    p_t = jnp.take_along_axis(target_probs,
+                              draft_token_ids[..., None], axis=-1)[..., 0]
+    p_d = jnp.take_along_axis(draft_probs,
+                              draft_token_ids[..., None], axis=-1)[..., 0]
+    u = jax.random.uniform(key_u, (batch, k), dtype=jnp.float32)
+    accepted = u * jnp.maximum(p_d, 1e-38) < p_t      # [batch, k]
+
+    # Recovered distribution at each position (used only at the first
+    # rejection): norm(max(0, p_t - p_d)).
+    diff = jnp.maximum(target_probs - draft_probs, 0.0)
+    denom = jnp.sum(diff, axis=-1, keepdims=True)
+    # All-zero diff (distributions identical): fall back to the target.
+    recovered = jnp.where(denom > 0, diff / jnp.maximum(denom, 1e-38),
+                          target_probs)
+    recovered_ids = _categorical(key_r, recovered)    # [batch, k]
+
+    # Prefix-accept logic: position j is a kept draft iff all drafts
+    # <= j accepted; the first rejection emits the recovered token.
+    all_prev = jnp.cumprod(accepted.astype(jnp.int32), axis=-1)  # [b,k]
+    num_accepted = jnp.sum(all_prev, axis=-1)                    # [b]
+    idx = jnp.arange(k)[None, :]
+    keep_draft = idx < num_accepted[:, None]
+    is_first_reject = idx == num_accepted[:, None]
+    tokens_k = jnp.where(
+        keep_draft, draft_token_ids,
+        jnp.where(is_first_reject, recovered_ids, -1)).astype(jnp.int32)
+
+    # Slot k: bonus token iff everything accepted.
+    bonus = jnp.where(num_accepted == k, bonus_token_ids,
+                      -1).astype(jnp.int32)
+    out = jnp.concatenate([tokens_k, bonus[:, None]], axis=1)
+    return out, num_accepted

@@ -116,9 +116,11 @@ def paged_decode_attention_ref(
     Pallas kernel avoids.
     """
     from aphrodite_tpu.ops.kv_cache import gather_pages
+    from aphrodite_tpu.ops.kv_quant import dequant_scale
     b, num_q_heads, d = q.shape
     num_kv_heads = k_pages.shape[0]
     group = num_q_heads // num_kv_heads
+    kv_s = dequant_scale(k_pages.dtype)    # int8 pages store value/S
 
     k = gather_pages(k_pages, block_tables)  # [b, Hkv, ctx, d]
     v = gather_pages(v_pages, block_tables)
@@ -126,7 +128,7 @@ def paged_decode_attention_ref(
 
     qg = q.reshape(b, num_kv_heads, group, d)
     scores = jnp.einsum("bkgd,bktd->bkgt", qg.astype(jnp.float32),
-                        k.astype(jnp.float32)) * scale  # [b,Hkv,g,ctx]
+                        k.astype(jnp.float32)) * (scale * kv_s)
 
     if alibi_slopes is not None:
         # [Hq, 1, ctx] -> [1, Hkv, group, ctx] (q head h = kv*group + g)
@@ -137,5 +139,6 @@ def paged_decode_attention_ref(
     mask = positions < context_lens[:, None, None, None]
     scores = jnp.where(mask, scores, _NEG_INF)
     weights = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bkgt,bktd->bkgd", weights, v.astype(jnp.float32))
+    out = jnp.einsum("bkgt,bktd->bkgd", weights,
+                     v.astype(jnp.float32)) * kv_s
     return out.reshape(b, num_q_heads, d).astype(q.dtype)

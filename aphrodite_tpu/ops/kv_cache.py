@@ -60,9 +60,10 @@ def write_to_kv_cache(
     k_flat = k_pages.reshape(num_kv_heads, num_pages * page_size, head_dim)
     v_flat = v_pages.reshape(num_kv_heads, num_pages * page_size, head_dim)
 
+    from aphrodite_tpu.ops.kv_quant import quantize_kv
     # [num_tokens, heads, dim] -> [heads, num_tokens, dim]
-    key_ht = key.astype(k_pages.dtype).swapaxes(0, 1)
-    value_ht = value.astype(v_pages.dtype).swapaxes(0, 1)
+    key_ht = quantize_kv(key, k_pages.dtype).swapaxes(0, 1)
+    value_ht = quantize_kv(value, v_pages.dtype).swapaxes(0, 1)
 
     k_flat = k_flat.at[:, slot_mapping, :].set(key_ht, mode="drop")
     v_flat = v_flat.at[:, slot_mapping, :].set(value_ht, mode="drop")

@@ -1,0 +1,42 @@
+"""Quantized KV-cache number format helpers.
+
+Reference: `kernels/attention/attention_kernels.cu` fp8-E5M2 cache
+variants + `kernels/quantization/...` cache conversions. Two formats:
+
+- fp8 (e5m2): straight cast on write, cast back on read. No scale.
+- int8: symmetric with ONE static scale S (value = int8 * S). S defaults
+  to 0.05 (range +-6.35, resolution 0.05 — ample for RMS-normed K/V
+  activations). Dequant never touches the big tensors: attention folds
+  S into the score scale (q.k*S == (q*S).k) and into the output
+  epilogue (out = (p.v_int) * S), so int8 KV costs one scalar multiply.
+
+The scale is process-global, set by the cache engine before the first
+trace; jitted code reads it as a trace-time constant.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_KV_SCALE = 0.05
+
+
+def set_kv_scale(scale: float) -> None:
+    global _KV_SCALE
+    _KV_SCALE = float(scale)
+
+
+def kv_scale() -> float:
+    return _KV_SCALE
+
+
+def quantize_kv(x, page_dtype):
+    """Cast activations to the cache page dtype (write path)."""
+    if page_dtype == jnp.int8:
+        return jnp.clip(jnp.round(x.astype(jnp.float32) / _KV_SCALE),
+                        -127, 127).astype(jnp.int8)
+    return x.astype(page_dtype)
+
+
+def dequant_scale(page_dtype) -> float:
+    """Multiplier that turns stored page values back into activations."""
+    return _KV_SCALE if page_dtype == jnp.int8 else 1.0

@@ -56,6 +56,7 @@ def _decode_kernel(
     pages_per_chunk: int,
     page_size: int,
     scale: float,
+    kv_scale: float,
 ):
     b = pl.program_id(0)
     h = pl.program_id(1)
@@ -90,7 +91,7 @@ def _decode_kernel(
     m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
     l_scr[...] = jnp.zeros_like(l_scr)
 
-    q = q_ref[0, 0].astype(jnp.float32) * scale  # [group, d]
+    q = q_ref[0, 0].astype(jnp.float32) * (scale * kv_scale)
 
     # Padded batch rows may have ctx == 0: no DMA may start, because the
     # matching wait never runs and scratch semaphores persist across grid
@@ -140,7 +141,8 @@ def _decode_kernel(
 
     l_final = l_scr[:, :1]
     l_safe = jnp.where(l_final == 0.0, 1.0, l_final)
-    out_ref[0, 0] = (acc_scr[...] / l_safe).astype(out_ref.dtype)
+    out_ref[0, 0] = (acc_scr[...] * (kv_scale / l_safe)).astype(
+        out_ref.dtype)
 
 
 def _decode_kernel_allheads(
@@ -166,6 +168,7 @@ def _decode_kernel_allheads(
     pages_per_chunk: int,
     page_size: int,
     scale: float,
+    kv_scale: float,
 ):
     """All-kv-heads-per-cell flash decoding: one grid cell handles every
     kv head of one sequence, so the online-softmax runs on
@@ -223,7 +226,9 @@ def _decode_kernel_allheads(
         # produce exactly sum_h p_h v_h per row. 8x redundant MXU FLOPs
         # buy ~8x fewer serialized dot latencies — decode attention here
         # is instruction-latency-bound, the MXU is idle either way.
-        q_all = q_ref[0].astype(jnp.float32) * scale      # [Hg, d]
+        # int8 pages store value/kv_scale: fold it into the score
+        # scale; the V side is restored once in the epilogue.
+        q_all = q_ref[0].astype(jnp.float32) * (scale * kv_scale)
         k_flat = k_buf[slot].reshape(
             H * chunk_tokens, q_all.shape[1]).astype(jnp.float32)
         s = jax.lax.dot_general(
@@ -257,12 +262,14 @@ def _decode_kernel_allheads(
 
     l_final = l_scr[:, :1]
     l_safe = jnp.where(l_final == 0.0, 1.0, l_final)
-    out_ref[0] = (acc_scr[...] / l_safe).astype(out_ref.dtype)
+    out_ref[0] = (acc_scr[...] * (kv_scale / l_safe)).astype(
+        out_ref.dtype)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("scale", "pages_per_chunk", "interpret"))
+    static_argnames=("scale", "kv_scale", "pages_per_chunk",
+                     "interpret"))
 def paged_decode_attention_allheads(
     q: jax.Array,             # [batch, num_q_heads, head_dim]
     k_pages: jax.Array,
@@ -271,6 +278,7 @@ def paged_decode_attention_allheads(
     context_lens: jax.Array,  # [batch] int32
     *,
     scale: float,
+    kv_scale: float = 1.0,
     pages_per_chunk: int = 8,
     interpret: bool = False,
 ) -> jax.Array:
@@ -296,6 +304,7 @@ def paged_decode_attention_allheads(
         pages_per_chunk=pages_per_chunk,
         page_size=page_size,
         scale=scale,
+        kv_scale=kv_scale,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -332,7 +341,8 @@ def paged_decode_attention_allheads(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("scale", "pages_per_chunk", "interpret"))
+    static_argnames=("scale", "kv_scale", "pages_per_chunk",
+                     "interpret"))
 def paged_decode_attention(
     q: jax.Array,             # [batch, num_q_heads, head_dim]
     k_pages: jax.Array,       # [num_kv_heads, num_pages, page_size, d]
@@ -341,6 +351,7 @@ def paged_decode_attention(
     context_lens: jax.Array,  # [batch] int32
     *,
     scale: float,
+    kv_scale: float = 1.0,
     pages_per_chunk: int = 8,
     interpret: bool = False,
 ) -> jax.Array:
@@ -366,6 +377,7 @@ def paged_decode_attention(
         pages_per_chunk=pages_per_chunk,
         page_size=page_size,
         scale=scale,
+        kv_scale=kv_scale,
     )
 
     grid_spec = pltpu.PrefetchScalarGridSpec(

@@ -16,11 +16,19 @@ def get_config(model: str,
         return extract_gguf_config(model)
     # Checkpoints whose model_type transformers doesn't know load via
     # our config classes without trust_remote_code (reference
-    # `transformers_utils/config.py:66-67,93-94`).
+    # `transformers_utils/config.py:66-67,93-94`). Hub ids fetch just
+    # config.json to inspect the declared type.
     import json as _json
     import os as _os
     cfg_json = _os.path.join(model, "config.json")
-    if _os.path.isfile(cfg_json):
+    if not _os.path.isfile(cfg_json) and not _os.path.isdir(model):
+        try:
+            from huggingface_hub import hf_hub_download
+            cfg_json = hf_hub_download(model, "config.json",
+                                       revision=revision)
+        except Exception:
+            cfg_json = ""          # offline / not a hub id
+    if cfg_json and _os.path.isfile(cfg_json):
         with open(cfg_json) as f:
             declared = _json.load(f).get("model_type", "").lower()
         if declared in ("yi", "qwen"):

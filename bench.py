@@ -100,11 +100,16 @@ def main() -> None:
     multi_step = int(os.environ.get("BENCH_MULTI_STEP", "32"))
     quant = os.environ.get("BENCH_QUANT") or None
     kv_dtype = os.environ.get("BENCH_KV_DTYPE", "auto")
+    # 8-bit KV pages need >=32-token pages for the Pallas decode kernel
+    # (8-bit sublane tile); bf16 keeps the default 16.
+    block_size = int(os.environ.get(
+        "BENCH_BLOCK", "32" if kv_dtype in ("int8", "fp8") else "16"))
     engine = AphroditeEngine.from_engine_args(EngineArgs(
         model=tmp, tokenizer=tmp, load_format="dummy", dtype="bfloat16",
         max_model_len=2048, max_num_seqs=batch, disable_log_stats=True,
         skip_tokenizer_init=True, multi_step=multi_step,
-        quantization=quant, kv_cache_dtype=kv_dtype))
+        quantization=quant, kv_cache_dtype=kv_dtype,
+        block_size=block_size))
 
     # Fit the batch to KV capacity: a batch whose total footprint
     # exceeds the device pool just thrashes swap/preemption and measures

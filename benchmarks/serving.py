@@ -1,0 +1,123 @@
+"""Serving load generator: poisson arrivals against the async engine,
+reporting TTFT / per-token / e2e latency percentiles and goodput.
+
+Reference: `tests/benchmarks/serving.py` (HTTP + ShareGPT dataset).
+This harness drives AsyncAphrodite in-process (the HTTP layer adds
+fixed overhead identical across engines) with synthetic
+token-id prompts, so it runs hermetically on any chip — BASELINE.md's
+tracked serving metric is p50 TTFT.
+
+Usage:
+    python benchmarks/serving.py --model <path-or-id> [--request-rate 4]
+        [--num-requests 128] [--prompt-len 128] [--output-len 64]
+Prints one JSON line with the percentile table.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+
+import numpy as np
+
+
+async def poisson_arrivals(n: int, rate: float, rng: np.random.RandomState):
+    for i in range(n):
+        yield i
+        if rate == float("inf"):
+            continue
+        await asyncio.sleep(rng.exponential(1.0 / rate))
+
+
+async def run(args) -> dict:
+    from aphrodite_tpu.common.sampling_params import SamplingParams
+    from aphrodite_tpu.engine.args_tools import AsyncEngineArgs
+    from aphrodite_tpu.engine.async_aphrodite import AsyncAphrodite
+
+    engine = AsyncAphrodite.from_engine_args(AsyncEngineArgs(
+        model=args.model, load_format=args.load_format,
+        dtype=args.dtype, max_num_seqs=args.max_num_seqs,
+        max_model_len=args.max_model_len, quantization=args.quantization,
+        kv_cache_dtype=args.kv_cache_dtype,
+        skip_tokenizer_init=True, disable_log_stats=True,
+        multi_step=args.multi_step))
+    vocab = engine.engine.model_config.get_vocab_size()
+    rng = np.random.RandomState(0)
+    prompts = [
+        rng.randint(5, vocab - 5, size=args.prompt_len).tolist()
+        for _ in range(args.num_requests)
+    ]
+
+    ttfts, tpots, e2es = [], [], []
+
+    async def one(i: int) -> None:
+        sp = SamplingParams(temperature=0.0, max_tokens=args.output_len,
+                            ignore_eos=True)
+        t0 = time.perf_counter()
+        first = None
+        final = None
+        async for out in engine.generate(
+                None, sp, f"req-{i}", prompt_token_ids=prompts[i]):
+            if first is None and out.outputs and \
+                    out.outputs[0].token_ids:
+                first = time.perf_counter()
+            final = out
+        t1 = time.perf_counter()
+        n_out = len(final.outputs[0].token_ids)
+        ttfts.append((first or t1) - t0)
+        if n_out > 1:
+            tpots.append((t1 - (first or t1)) / (n_out - 1))
+        e2es.append(t1 - t0)
+
+    tasks = []
+    t_start = time.perf_counter()
+    async for i in poisson_arrivals(args.num_requests, args.request_rate,
+                                    rng):
+        tasks.append(asyncio.create_task(one(i)))
+    await asyncio.gather(*tasks)
+    wall = time.perf_counter() - t_start
+
+    def pct(xs, p):
+        return float(np.percentile(np.asarray(xs), p)) if xs else None
+
+    return {
+        "metric": "serving_p50_ttft_s",
+        "value": round(pct(ttfts, 50), 4),
+        "unit": "s",
+        "detail": {
+            "request_rate": args.request_rate,
+            "num_requests": args.num_requests,
+            "throughput_out_tok_s": round(
+                args.num_requests * args.output_len / wall, 1),
+            "ttft_p50": round(pct(ttfts, 50), 4),
+            "ttft_p90": round(pct(ttfts, 90), 4),
+            "ttft_p99": round(pct(ttfts, 99), 4),
+            "tpot_p50": round(pct(tpots, 50), 5),
+            "e2e_p50": round(pct(e2es, 50), 4),
+            "e2e_p99": round(pct(e2es, 99), 4),
+        },
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", required=True)
+    parser.add_argument("--load-format", default="auto")
+    parser.add_argument("--dtype", default="bfloat16")
+    parser.add_argument("--quantization", default=None)
+    parser.add_argument("--kv-cache-dtype", default="auto")
+    parser.add_argument("--max-num-seqs", type=int, default=256)
+    parser.add_argument("--max-model-len", type=int, default=2048)
+    parser.add_argument("--multi-step", type=int, default=8)
+    parser.add_argument("--request-rate", type=float, default=4.0,
+                        help="poisson requests/s (inf = all at once)")
+    parser.add_argument("--num-requests", type=int, default=128)
+    parser.add_argument("--prompt-len", type=int, default=128)
+    parser.add_argument("--output-len", type=int, default=64)
+    args = parser.parse_args()
+    print(json.dumps(asyncio.run(run(args))))
+
+
+if __name__ == "__main__":
+    main()

@@ -205,3 +205,27 @@ def test_pallas_decode_allheads_matches_oracle(num_q_heads, num_kv_heads,
         pages_per_chunk=pages_per_chunk, interpret=True)
     np.testing.assert_allclose(np.array(got), expected, rtol=2e-3,
                                atol=2e-3)
+
+
+def test_pallas_decode_int8_kv_scale():
+    """int8 KV pages with the scale folded into score/epilogue must
+    match the float oracle on the dequantized values."""
+    from aphrodite_tpu.ops.pallas.paged_attention import (
+        paged_decode_attention, paged_decode_attention_allheads)
+    q, k_pages, v_pages, bt, ctx = make_problem(num_q_heads=8,
+                                                num_kv_heads=2,
+                                                dim=128, page_size=8,
+                                                pages_per_seq=8, pages=32)
+    S = 0.05
+    k_int = np.clip(np.round(k_pages / S), -127, 127).astype(np.int8)
+    v_int = np.clip(np.round(v_pages / S), -127, 127).astype(np.int8)
+    scale = 1.0 / np.sqrt(128)
+    expected = numpy_paged_attention(q, k_int.astype(np.float32) * S,
+                                     v_int.astype(np.float32) * S,
+                                     bt, ctx, scale)
+    for fn in (paged_decode_attention, paged_decode_attention_allheads):
+        got = fn(jnp.array(q), jnp.array(k_int), jnp.array(v_int),
+                 jnp.array(bt), jnp.array(ctx), scale=scale, kv_scale=S,
+                 pages_per_chunk=4, interpret=True)
+        np.testing.assert_allclose(np.array(got), expected, rtol=2e-3,
+                                   atol=2e-3)

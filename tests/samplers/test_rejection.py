@@ -1,0 +1,106 @@
+"""Rejection-sampler tests, mirroring the reference's statistical
+convergence strategy (`tests/samplers/test_rejection_sampling.py:211`):
+the empirical distribution of emitted tokens must converge to the
+TARGET distribution regardless of the draft distribution."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from aphrodite_tpu.modeling.layers.rejection import rejection_sample
+
+rs = np.random.RandomState(0)
+
+
+def rand_dist(n, vocab, peaked=False):
+    if peaked:
+        logits = rs.randn(n, vocab) * 3
+    else:
+        logits = rs.randn(n, vocab)
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    return (e / e.sum(-1, keepdims=True)).astype(np.float32)
+
+
+def test_all_accepted_emits_drafts_and_bonus():
+    vocab, k = 16, 3
+    p = rand_dist(k, vocab)[None]                 # identical p == q
+    drafts = jnp.asarray([[1, 2, 3]], dtype=jnp.int32)
+    out, n_acc = rejection_sample(
+        jax.random.PRNGKey(0), jnp.asarray(p), jnp.asarray([7]),
+        jnp.asarray(p), drafts)
+    # p==q means acceptance prob 1 for every draft.
+    assert int(n_acc[0]) == k
+    assert out.tolist() == [[1, 2, 3, 7]]
+
+
+def test_rejection_emits_recovered_then_minus_one():
+    vocab, k = 8, 4
+    # Target puts ALL mass on token 0; draft proposes token 5 with
+    # target prob 0 -> always rejected at position 0.
+    target = np.zeros((1, k, vocab), np.float32)
+    target[..., 0] = 1.0
+    draft = np.zeros((1, k, vocab), np.float32)
+    draft[..., 5] = 1.0
+    drafts = jnp.full((1, k), 5, dtype=jnp.int32)
+    out, n_acc = rejection_sample(
+        jax.random.PRNGKey(1), jnp.asarray(target), jnp.asarray([7]),
+        jnp.asarray(draft), drafts)
+    assert int(n_acc[0]) == 0
+    assert out[0, 0] == 0                         # recovered = target
+    assert out[0, 1:].tolist() == [-1, -1, -1, -1]
+
+
+@pytest.mark.parametrize("peaked", [False, True])
+def test_output_distribution_converges_to_target(peaked):
+    """Draw many single-step samples with mismatched draft/target and
+    check the emitted-token histogram converges to TARGET (the whole
+    point of modified rejection sampling)."""
+    vocab = 10
+    n = 100_000
+    target_1 = rand_dist(1, vocab, peaked)[0]
+    draft_1 = rand_dist(1, vocab, peaked)[0]
+
+    target = jnp.broadcast_to(target_1, (n, 1, vocab))
+    draft = jnp.broadcast_to(draft_1, (n, 1, vocab))
+    key = jax.random.PRNGKey(42)
+    draft_ids = jax.random.categorical(
+        key, jnp.log(jnp.asarray(draft_1))[None, :],
+        shape=(n, 1)).astype(jnp.int32)
+    bonus = jax.random.categorical(
+        jax.random.PRNGKey(7), jnp.log(jnp.asarray(target_1))[None, :],
+        shape=(n,)).astype(jnp.int32)
+
+    out, _ = jax.jit(rejection_sample)(
+        jax.random.PRNGKey(3), target, bonus, draft, draft_ids)
+    emitted = np.asarray(out[:, 0])               # first emitted token
+    hist = np.bincount(emitted, minlength=vocab).astype(np.float64)
+    emp = hist / hist.sum()
+    tv = 0.5 * np.abs(emp - np.asarray(target_1, np.float64)).sum()
+    # TV distance ~ O(1/sqrt(n)) if correct; 0.01 is ~10 sigma of noise.
+    assert tv < 0.01, (tv, emp, target_1)
+
+
+def test_distribution_convergence_improves_with_samples():
+    """The reference's convergence assertion: distance shrinks as the
+    sample count grows (catches 'close but biased' implementations)."""
+    vocab = 10
+    target_1 = rand_dist(1, vocab)[0]
+    draft_1 = rand_dist(1, vocab)[0]
+
+    def tv_at(n, seed):
+        target = jnp.broadcast_to(target_1, (n, 1, vocab))
+        draft = jnp.broadcast_to(draft_1, (n, 1, vocab))
+        draft_ids = jax.random.categorical(
+            jax.random.PRNGKey(seed),
+            jnp.log(jnp.asarray(draft_1))[None, :],
+            shape=(n, 1)).astype(jnp.int32)
+        out, _ = rejection_sample(
+            jax.random.PRNGKey(seed + 1), target,
+            jnp.zeros((n,), jnp.int32), draft, draft_ids)
+        emitted = np.asarray(out[:, 0])
+        emp = np.bincount(emitted, minlength=vocab) / n
+        return 0.5 * np.abs(emp - np.asarray(target_1,
+                                             np.float64)).sum()
+
+    assert tv_at(200_000, 11) < tv_at(2_000, 13)

@@ -152,3 +152,25 @@ NUMBER: /[0-9]+/
     for ch in text:
         state = m.advance(state, ch)
         assert state is not None, f"invalid output {text!r} at {ch!r}"
+
+
+def test_processor_decode_forked_siblings(tiny_llm):
+    """One processor instance serves all sibling sequences of an n>1
+    request; decoding must be correct per-prefix even when siblings
+    share a last token (regression: last-token-only fork detection)."""
+    from aphrodite_tpu.common.grammar import GrammarLogitsProcessor
+    tok = tiny_llm.engine.tokenizer.tokenizer
+    proc = GrammarLogitsProcessor(tok, 'start: TEXT\nTEXT: /[a-z ]+/')
+    a = tok.encode("the quick brown fox")
+    b = tok.encode("the lazy dog")
+    # Interleave sibling calls like the sampler does.
+    for i in range(1, max(len(a), len(b))):
+        if i <= len(a):
+            assert proc._decode(a[:i]) == tok.decode(a[:i])
+        if i <= len(b):
+            assert proc._decode(b[:i]) == tok.decode(b[:i])
+    # Same last token, different prefixes.
+    s1 = a[:2] + [a[-1]]
+    s2 = b[:2] + [a[-1]]
+    assert proc._decode(s1) == tok.decode(s1)
+    assert proc._decode(s2) == tok.decode(s2)

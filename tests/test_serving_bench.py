@@ -1,0 +1,22 @@
+"""Serving load-gen harness smoke test (benchmarks/serving.py is the
+p50-TTFT artifact BASELINE.md tracks)."""
+import argparse
+import asyncio
+import sys
+
+
+def test_serving_harness(tiny_model_dir):
+    sys.path.insert(0, "benchmarks")
+    from serving import run
+
+    args = argparse.Namespace(
+        model=tiny_model_dir, load_format="dummy", dtype="float32",
+        quantization=None, kv_cache_dtype="auto", max_num_seqs=4,
+        max_model_len=256, multi_step=4, request_rate=float("inf"),
+        num_requests=6, prompt_len=12, output_len=5)
+    result = asyncio.run(run(args))
+    assert result["metric"] == "serving_p50_ttft_s"
+    d = result["detail"]
+    assert d["ttft_p50"] > 0 and d["ttft_p99"] >= d["ttft_p50"]
+    assert d["e2e_p50"] >= d["ttft_p50"]
+    assert d["throughput_out_tok_s"] > 0
