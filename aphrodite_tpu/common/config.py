@@ -99,7 +99,7 @@ class ModelConfig:
         self.tokenizer_mode = tokenizer_mode
 
     def _verify_quantization(self) -> None:
-        supported = ("awq", "gptq", "gguf", "squeezellm", "int8")
+        supported = ("awq", "gptq", "gguf", "squeezellm", "int8", "quip")
         if self.quantization is not None:
             self.quantization = self.quantization.lower()
             if self.quantization not in supported:

@@ -328,8 +328,11 @@ class GrammarLogitsProcessor:
         # one processor instance serves every sibling sequence of an
         # n>1 / best_of request (the sampler shares the params object),
         # so per-prefix states are required for correctness, and they
-        # make each step O(1) decodes instead of O(n).
-        self._states: Dict[tuple, tuple] = {}
+        # make each step O(1) decodes instead of O(n). LRU-bounded:
+        # old (short-prefix) entries evict first, live tips stay, so
+        # memory is O(window * n) instead of O(n^2).
+        from collections import OrderedDict
+        self._states: "OrderedDict[tuple, tuple]" = OrderedDict()
 
     def _decode(self, token_ids: List[int]) -> str:
         from aphrodite_tpu.transformers_utils.tokenizer import (
@@ -341,9 +344,10 @@ class GrammarLogitsProcessor:
         key = tuple(token_ids)
         got = self._states.get(key)
         if got is not None:
+            self._states.move_to_end(key)
             return got[3]
-        if len(self._states) > 8192:
-            self._states.clear()
+        while len(self._states) > 512:
+            self._states.popitem(last=False)      # evict oldest prefix
         # Extend the parent prefix's state, or rebuild from scratch.
         start = len(key) - 1 if key[:-1] in self._states else 0
         state = self._states.get(key[:-1], (None, 0, 0, ""))

@@ -138,10 +138,11 @@ class PagedAttention:
             k_pages.dtype in (jnp.int8, jnp.float8_e5m2) and
             k_pages.shape[2] % 32 == 0)     # 8-bit sublane tile
         if self.use_pallas and jax.default_backend() == "tpu" and \
-                self.alibi_slopes is None and self.head_size % 128 == 0 \
-                and quant_ok:
+                self.head_size % 128 == 0 and quant_ok:
             from aphrodite_tpu.ops.pallas.paged_attention import (
                 paged_decode_attention, paged_decode_attention_allheads)
+            slopes = None if self.alibi_slopes is None else \
+                jnp.asarray(self.alibi_slopes, dtype=jnp.float32)
             # Padded table entries hold an out-of-range page id (the XLA
             # gather's fill convention); the kernel DMAs pages raw, so
             # clamp pads to a valid page — masked off by context_lens.
@@ -156,12 +157,12 @@ class PagedAttention:
                     self.num_heads <= 64:
                 out = paged_decode_attention_allheads(
                     q3, k_pages, v_pages, tables,
-                    metadata.context_lens, scale=self.scale,
+                    metadata.context_lens, slopes, scale=self.scale,
                     kv_scale=dequant_scale(k_pages.dtype))
             else:
                 out = paged_decode_attention(
                     q3, k_pages, v_pages, tables,
-                    metadata.context_lens, scale=self.scale,
+                    metadata.context_lens, slopes, scale=self.scale,
                     kv_scale=dequant_scale(k_pages.dtype))
         else:
             out = paged_decode_attention_ref(

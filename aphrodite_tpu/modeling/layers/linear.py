@@ -119,8 +119,15 @@ class LinearBase:
     def weight_loader(self, params: Dict[str, np.ndarray], name: str,
                       hf_tensor: np.ndarray,
                       shard_id=None) -> None:
-        params[name] = self.linear_method.load_weight(params, name,
-                                                      hf_tensor)
+        converted = self.linear_method.load_weight(params, name,
+                                                   hf_tensor)
+        # Methods may store a checkpoint tensor under a different param
+        # name (e.g. QuIP's Qidxs decompresses into `weight`).
+        rename = getattr(self.linear_method, "pending_rename", None)
+        if rename:
+            name = rename
+            self.linear_method.pending_rename = None
+        params[name] = converted
         sidecar = getattr(self.linear_method, "pending_sidecar", None)
         if sidecar:
             params.update(sidecar)

@@ -18,6 +18,7 @@ from aphrodite_tpu.modeling.layers.quantization.base_config import (
     QuantizationConfig)
 from aphrodite_tpu.modeling.layers.quantization.gptq import GPTQConfig
 from aphrodite_tpu.modeling.layers.quantization.int8 import Int8Config
+from aphrodite_tpu.modeling.layers.quantization.quip import QuipConfig
 from aphrodite_tpu.modeling.layers.quantization.squeezellm import (
     SqueezeLLMConfig)
 
@@ -26,6 +27,7 @@ _QUANTIZATION_CONFIG_REGISTRY = {
     "gptq": GPTQConfig,
     "squeezellm": SqueezeLLMConfig,
     "int8": Int8Config,
+    "quip": QuipConfig,
 }
 
 

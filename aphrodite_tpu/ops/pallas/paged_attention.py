@@ -39,25 +39,21 @@ def _decode_kernel(
     # scalar prefetch
     block_tables_ref,   # [batch, pages_per_seq] int32 (SMEM)
     context_lens_ref,   # [batch] int32 (SMEM)
-    # inputs
-    q_ref,              # [1, 1, group, head_dim] VMEM
-    k_hbm,              # [num_kv_heads, num_pages, page_size, d] ANY/HBM
-    v_hbm,
-    # outputs
-    out_ref,            # [1, group, head_dim] VMEM
-    # scratch
-    k_buf,              # [2, chunk_tokens, d] VMEM (kv dtype)
-    v_buf,
-    sems,               # DMA sems [2, 2]
-    acc_scr,            # [group, d] f32
-    m_scr,              # [group, 128] f32
-    l_scr,              # [group, 128] f32
-    *,
+    # inputs (slopes_ref [group, 128] present only with has_alibi)
+    *refs,
     pages_per_chunk: int,
     page_size: int,
     scale: float,
     kv_scale: float,
+    has_alibi: bool = False,
 ):
+    if has_alibi:
+        (q_ref, k_hbm, v_hbm, slopes_ref, out_ref,
+         k_buf, v_buf, sems, acc_scr, m_scr, l_scr) = refs
+    else:
+        (q_ref, k_hbm, v_hbm, out_ref,
+         k_buf, v_buf, sems, acc_scr, m_scr, l_scr) = refs
+        slopes_ref = None
     b = pl.program_id(0)
     h = pl.program_id(1)
     chunk_tokens = pages_per_chunk * page_size
@@ -117,6 +113,10 @@ def _decode_kernel(
 
         pos = c * chunk_tokens + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1)
+        if slopes_ref is not None:
+            # ALiBi: bias grows with kv ABSOLUTE position (reference
+            # make_alibi_bias, layers/attention.py:196).
+            s = s + slopes_ref[:, :1] * pos.astype(jnp.float32)
         s = jnp.where(pos < ctx, s, _NEG_INF)
 
         m_prev = m_scr[:, :1]                        # [group, 1]
@@ -149,26 +149,15 @@ def _decode_kernel_allheads(
     # scalar prefetch
     block_tables_ref,   # [batch, pages_per_seq] int32 (SMEM)
     context_lens_ref,   # [batch] int32 (SMEM)
-    # inputs
-    q_ref,              # [1, H*group, head_dim] VMEM
-    k_hbm,              # [H, num_pages, page_size, d] ANY/HBM
-    v_hbm,
-    # outputs
-    out_ref,            # [1, H*group, head_dim] VMEM
-    # scratch
-    k_buf,              # [2, H, chunk_tokens, d]
-    v_buf,
-    sems,               # DMA sems [2, 2]
-    acc_scr,            # [H*group, d] f32
-    m_scr,              # [H*group, 128] f32
-    l_scr,              # [H*group, 128] f32
-    *,
+    # inputs (slopes_ref [H*group, 128] present only with has_alibi)
+    *refs,
     num_kv_heads: int,
     group: int,
     pages_per_chunk: int,
     page_size: int,
     scale: float,
     kv_scale: float,
+    has_alibi: bool = False,
 ):
     """All-kv-heads-per-cell flash decoding: one grid cell handles every
     kv head of one sequence, so the online-softmax runs on
@@ -176,6 +165,13 @@ def _decode_kernel_allheads(
     of 8 separate [group=4, chunk] cells. Decode attention here is
     instruction-issue-bound, not bandwidth-bound — tiny tiles waste the
     VPU/MXU on per-op overhead, so merging heads is worth ~4x."""
+    if has_alibi:
+        (q_ref, k_hbm, v_hbm, slopes_ref, out_ref,
+         k_buf, v_buf, sems, acc_scr, m_scr, l_scr) = refs
+    else:
+        (q_ref, k_hbm, v_hbm, out_ref,
+         k_buf, v_buf, sems, acc_scr, m_scr, l_scr) = refs
+        slopes_ref = None
     b = pl.program_id(0)
     H = num_kv_heads
     chunk_tokens = pages_per_chunk * page_size
@@ -240,6 +236,8 @@ def _decode_kernel_allheads(
             jnp.int32, s.shape, 0) // group
         pos = c * chunk_tokens + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1) % chunk_tokens
+        if slopes_ref is not None:
+            s = s + slopes_ref[:, :1] * pos.astype(jnp.float32)
         live = (col_head == row_head) & (pos < ctx)
         s = jnp.where(live, s, _NEG_INF)
         m_prev = m_scr[:, :1]
@@ -276,6 +274,7 @@ def paged_decode_attention_allheads(
     v_pages: jax.Array,
     block_tables: jax.Array,  # [batch, pages_per_seq] int32, 0-padded
     context_lens: jax.Array,  # [batch] int32
+    alibi_slopes: jax.Array = None,   # [num_q_heads] f32, optional
     *,
     scale: float,
     kv_scale: float = 1.0,
@@ -305,16 +304,25 @@ def paged_decode_attention_allheads(
         page_size=page_size,
         scale=scale,
         kv_scale=kv_scale,
+        has_alibi=alibi_slopes is not None,
     )
+    in_specs = [
+        pl.BlockSpec((1, num_q_heads, head_dim),
+                     lambda b, *_: (b, 0, 0)),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    inputs = [block_tables, context_lens, q, k_pages, v_pages]
+    if alibi_slopes is not None:
+        in_specs.append(
+            pl.BlockSpec((num_q_heads, 128), lambda b, *_: (0, 0)))
+        inputs.append(jnp.broadcast_to(
+            alibi_slopes.astype(jnp.float32)[:, None],
+            (num_q_heads, 128)))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(batch,),
-        in_specs=[
-            pl.BlockSpec((1, num_q_heads, head_dim),
-                         lambda b, *_: (b, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, num_q_heads, head_dim),
                                lambda b, *_: (b, 0, 0)),
         scratch_shapes=[
@@ -334,7 +342,7 @@ def paged_decode_attention_allheads(
         out_shape=jax.ShapeDtypeStruct((batch, num_q_heads, head_dim),
                                        q.dtype),
         interpret=interpret,
-    )(block_tables, context_lens, q, k_pages, v_pages)
+    )(*inputs)
     return out
 
 
@@ -349,6 +357,7 @@ def paged_decode_attention(
     v_pages: jax.Array,
     block_tables: jax.Array,  # [batch, pages_per_seq] int32, 0-padded
     context_lens: jax.Array,  # [batch] int32
+    alibi_slopes: jax.Array = None,   # [num_q_heads] f32, optional
     *,
     scale: float,
     kv_scale: float = 1.0,
@@ -378,17 +387,27 @@ def paged_decode_attention(
         page_size=page_size,
         scale=scale,
         kv_scale=kv_scale,
+        has_alibi=alibi_slopes is not None,
     )
 
+    in_specs = [
+        pl.BlockSpec((1, 1, group, head_dim),
+                     lambda b, h, *_: (b, h, 0, 0)),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    inputs = [block_tables, context_lens, q_grouped, k_pages, v_pages]
+    if alibi_slopes is not None:
+        # Rows h*group..(h+1)*group of the [Hq, 128] tile per grid head.
+        in_specs.append(
+            pl.BlockSpec((group, 128), lambda b, h, *_: (h, 0)))
+        inputs.append(jnp.broadcast_to(
+            alibi_slopes.astype(jnp.float32)[:, None],
+            (num_q_heads, 128)))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, group, head_dim),
-                         lambda b, h, *_: (b, h, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, group, head_dim),
                                lambda b, h, *_: (b, h, 0, 0)),
         scratch_shapes=[
@@ -407,5 +426,5 @@ def paged_decode_attention(
         out_shape=jax.ShapeDtypeStruct(
             (batch, num_kv_heads, group, head_dim), q.dtype),
         interpret=interpret,
-    )(block_tables, context_lens, q_grouped, k_pages, v_pages)
+    )(*inputs)
     return out.reshape(batch, num_q_heads, head_dim)

@@ -79,7 +79,8 @@ async def run(args) -> dict:
     wall = time.perf_counter() - t_start
 
     def pct(xs, p):
-        return float(np.percentile(np.asarray(xs), p)) if xs else None
+        # 0.0 (not None) for empty series: round() downstream.
+        return float(np.percentile(np.asarray(xs), p)) if xs else 0.0
 
     return {
         "metric": "serving_p50_ttft_s",

@@ -229,3 +229,26 @@ def test_pallas_decode_int8_kv_scale():
                  pages_per_chunk=4, interpret=True)
         np.testing.assert_allclose(np.array(got), expected, rtol=2e-3,
                                    atol=2e-3)
+
+
+@pytest.mark.parametrize("kernel_name", ["v1", "allheads"])
+def test_pallas_decode_alibi(kernel_name):
+    """In-kernel ALiBi bias matches the numpy oracle."""
+    from aphrodite_tpu.ops.pallas.paged_attention import (
+        paged_decode_attention, paged_decode_attention_allheads)
+    q, k_pages, v_pages, bt, ctx = make_problem(num_q_heads=8,
+                                                num_kv_heads=2,
+                                                dim=128, page_size=8,
+                                                pages_per_seq=8, pages=32)
+    slopes = np.array([2.0 ** -(i + 1) for i in range(8)],
+                      dtype=np.float32)
+    scale = 1.0 / np.sqrt(128)
+    expected = numpy_paged_attention(q, k_pages, v_pages, bt, ctx, scale,
+                                     alibi_slopes=slopes)
+    fn = paged_decode_attention if kernel_name == "v1" else \
+        paged_decode_attention_allheads
+    got = fn(jnp.array(q), jnp.array(k_pages), jnp.array(v_pages),
+             jnp.array(bt), jnp.array(ctx), jnp.array(slopes),
+             scale=scale, pages_per_chunk=4, interpret=True)
+    np.testing.assert_allclose(np.array(got), expected, rtol=2e-3,
+                               atol=2e-3)

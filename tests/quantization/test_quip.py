@@ -1,0 +1,127 @@
+"""QuIP# (E8P12) tests: codebook construction vs an independent scalar
+enumeration, bit-exact decode semantics, FWHT vs scipy's Hadamard
+matrix, and the full linear-method forward (reference
+`kernels/quantization/quip/origin_order.cu`, `quip_utils.py`)."""
+import itertools
+import math
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+import scipy.linalg
+
+from aphrodite_tpu.modeling.layers.quantization.quip import (
+    QuipConfig, QuipLinearMethod, decompress_e8p, fwht, matmul_hadU,
+    packed_abs_grid, quip_weight_from_qidxs)
+
+rs = np.random.RandomState(0)
+
+
+def test_packed_abs_grid_structure():
+    grid = packed_abs_grid()
+    assert grid.shape == (256,)
+    # Independent count: abs combos over {.5,1.5,2.5,3.5}^8 with
+    # norm^2 <= 10, unique -> 227; plus 29 norm-12 rows = 256.
+    combos = [c for c in itertools.product([0.5, 1.5, 2.5, 3.5],
+                                           repeat=8)
+              if sum(v * v for v in c) <= 10 + 1e-6]
+    assert len({tuple(c) for c in combos}) == 227
+    # Each packed entry's bytes decode to |v|*4 with only byte 7
+    # possibly negative (the parity sign bit).
+    b = grid.view(np.uint8).reshape(256, 8)
+    vals = b.astype(np.int8).astype(np.int32)
+    assert np.all(vals[:, :7] > 0)
+    assert np.all((np.abs(vals) % 2 == 0) & (np.abs(vals) <= 14))
+
+
+def test_decompress_e8p_scalar_oracle():
+    """Vectorized decode vs a direct per-element transcription of
+    decode8weights (origin_order.cu:206-228)."""
+    grid = packed_abs_grid()
+    codes = rs.randint(-2**15, 2**15, size=(5, 4), dtype=np.int16)
+
+    def scalar_decode(code):
+        w = int(np.uint16(code))
+        bits_sign = w & 0xFF
+        parity = bin(bits_sign).count("1") & 1
+        sign_vec = bits_sign ^ parity
+        packed = int(np.uint64(grid[w >> 8]))
+        out = []
+        for i in range(8):
+            byte = (packed >> (8 * i)) & 0xFF
+            if (sign_vec >> i) & 1:
+                byte ^= 252
+            byte |= 1
+            byte = (byte - parity * 2) & 0xFF
+            v = byte if byte < 128 else byte - 256
+            out.append(v / 4.0)
+        return [out[j] for j in (0, 2, 1, 3, 4, 6, 5, 7)]
+
+    got = decompress_e8p(codes)
+    want = np.array([[scalar_decode(c) for c in row] for row in codes],
+                    np.float32).reshape(5, 32)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+    # E8P weights are half-integer multiples in [-4, 4).
+    assert np.all(np.abs(got * 4 - np.round(got * 4)) < 1e-6)
+    assert np.abs(got).max() <= 4.0
+
+
+@pytest.mark.parametrize("n", [8, 64, 256])
+def test_fwht_matches_hadamard_matrix(n):
+    x = rs.randn(3, n).astype(np.float32)
+    H = scipy.linalg.hadamard(n).astype(np.float32)
+    want = x @ H.T            # Sylvester H is symmetric
+    got = np.asarray(fwht(jnp.asarray(x)))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
+    # Orthogonality with 1/sqrt(n) scale.
+    twice = np.asarray(fwht(fwht(jnp.asarray(x), 1 / math.sqrt(n)),
+                            1 / math.sqrt(n)))
+    np.testing.assert_allclose(twice, x, rtol=1e-5, atol=1e-4)
+
+
+def test_quip_linear_method_forward():
+    """apply() equals the explicit had/matmul/had reference pipeline."""
+    in_f, out_f = 64, 32
+    method = QuipLinearMethod(QuipConfig())
+    params = method.create_weights(in_f, out_f, jnp.float32, bias=False,
+                                   out_axis=None, in_axis=None)
+    qidxs = rs.randint(-2**15, 2**15, size=(out_f, in_f // 8),
+                       dtype=np.int16)
+    params["weight"] = jnp.asarray(quip_weight_from_qidxs(qidxs))
+    params["SU"] = jnp.asarray(
+        rs.choice([-1.0, 1.0], in_f).astype(np.float32))
+    params["SV"] = jnp.asarray(
+        rs.choice([-1.0, 1.0], out_f).astype(np.float32))
+    params["Wscale"] = jnp.asarray(0.7, dtype=jnp.float32)
+
+    x = rs.randn(5, in_f).astype(np.float32)
+    got = np.asarray(method.apply(params, jnp.asarray(x)))
+
+    W = decompress_e8p(qidxs)                     # [out, in]
+    H = scipy.linalg.hadamard(in_f) / math.sqrt(in_f)
+    Ho = scipy.linalg.hadamard(out_f) / math.sqrt(out_f)
+    xs = (x * params["SU"]) @ (H.T * 0.7)
+    ref = (xs @ W.T) @ Ho.T * np.asarray(params["SV"])
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_quip_registered():
+    from aphrodite_tpu.modeling.layers.quantization import (
+        get_quantization_config_cls)
+    assert get_quantization_config_cls("quip") is QuipConfig
+
+
+def test_quip_load_weight_renames_qidxs():
+    """Qidxs decompresses into the `weight` slot via the loader's
+    pending_rename mechanism."""
+    from aphrodite_tpu.modeling.layers.linear import ColumnParallelLinear
+    method = QuipLinearMethod(QuipConfig())
+    layer = ColumnParallelLinear(64, 32, linear_method=method,
+                                 dtype=jnp.float32)
+    params = layer.init()
+    qidxs = rs.randint(-2**15, 2**15, size=(32, 8), dtype=np.int16)
+    layer.weight_loader(params, "Qidxs", qidxs)
+    assert "Qidxs" not in params
+    np.testing.assert_allclose(np.asarray(params["weight"]),
+                               quip_weight_from_qidxs(qidxs), atol=0)
