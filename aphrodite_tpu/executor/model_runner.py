@@ -257,13 +257,13 @@ class ModelRunner:
                         dtype=np.int32)
         ctx_lens = np.zeros((padded_batch,), dtype=np.int32)
         plens = np.zeros((padded_batch,), dtype=np.int32)
-        max_pages = _PAGES_BUCKET
-        if use_prefix:
-            max_pages = max(
-                _PAGES_BUCKET,
-                -(-max((len(next(iter(md.block_tables.values()), []))
-                        for md in seq_group_metadata_list),
-                       default=1) // _PAGES_BUCKET) * _PAGES_BUCKET)
+        # Bucket the table width to the longest scheduled table (always
+        # — long prompts exceed one bucket regardless of prefix use).
+        max_pages = max(
+            _PAGES_BUCKET,
+            -(-max((len(next(iter(md.block_tables.values()), []))
+                    for md in seq_group_metadata_list),
+                   default=1) // _PAGES_BUCKET) * _PAGES_BUCKET)
         num_pages_oob = self.num_slots // self.page_size
         tables = np.full((padded_batch, max_pages), num_pages_oob,
                          dtype=np.int32)

@@ -148,22 +148,45 @@ class PagedAttention:
             # clamp pads to a valid page — masked off by context_lens.
             tables = jnp.minimum(metadata.block_tables,
                                  k_pages.shape[1] - 1)
-            # All-heads-per-cell variant wins for GQA: its VMEM scratch
-            # and redundant-FLOP factor scale with num_KV_heads, so gate
-            # on few kv heads and a real grouping factor; MHA keeps the
-            # per-head kernel.
+            # Bigger chunks amortize the per-chunk loop/DMA overhead for
+            # long contexts; largest power-of-two <= 32 dividing the
+            # (bucketed) table width, >= 512 tokens per chunk when the
+            # context allows.
+            pps = tables.shape[1]
+            page_size = k_pages.shape[2]
+            batch = q3.shape[0]
+            ppc = 8
+            # Bigger chunks only for SMALL batches: the table width is
+            # the batch MAX, so in a mixed large batch one long sequence
+            # would inflate every short sequence's chunk (masked DMA +
+            # compute). Small-batch long-context is where fewer chunk
+            # iterations pay.
+            if batch < 32:
+                while ppc * 2 <= 32 and pps % (ppc * 2) == 0 and \
+                        ppc * page_size < 512:
+                    ppc *= 2
+            if pps % ppc != 0:
+                ppc = 1
+            # All-heads-per-cell variant wins for GQA at LARGE batch and
+            # short-to-medium context (it amortizes per-cell instruction
+            # overhead but its masked cross-head score tile wastes
+            # H x the VPU work, which scales with context). Few long
+            # sequences keep the per-(seq, head) kernel.
             if self.num_kv_heads <= 8 and \
                     self.num_heads >= 2 * self.num_kv_heads and \
-                    self.num_heads <= 64:
+                    self.num_heads <= 64 and batch >= 32 and \
+                    pps * page_size <= 2048:
                 out = paged_decode_attention_allheads(
                     q3, k_pages, v_pages, tables,
                     metadata.context_lens, slopes, scale=self.scale,
-                    kv_scale=dequant_scale(k_pages.dtype))
+                    kv_scale=dequant_scale(k_pages.dtype),
+                    pages_per_chunk=ppc)
             else:
                 out = paged_decode_attention(
                     q3, k_pages, v_pages, tables,
                     metadata.context_lens, slopes, scale=self.scale,
-                    kv_scale=dequant_scale(k_pages.dtype))
+                    kv_scale=dequant_scale(k_pages.dtype),
+                    pages_per_chunk=ppc)
         else:
             out = paged_decode_attention_ref(
                 q3, k_pages, v_pages, metadata.block_tables,

@@ -1,0 +1,87 @@
+"""Decode-attention kernel microbenchmark (reference
+`tests/benchmarks/attention.py:93`): Pallas kernels vs the XLA gather
+path across batch/context shapes, timed inside one jitted lax.scan so
+per-dispatch latency doesn't pollute the numbers.
+
+Usage:
+    python benchmarks/attention.py [--batch 256] [--ctx 1024]
+Prints one JSON line per variant.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch", type=int, default=256)
+    parser.add_argument("--ctx", type=int, default=1024)
+    parser.add_argument("--heads", type=int, default=32)
+    parser.add_argument("--kv-heads", type=int, default=8)
+    parser.add_argument("--head-dim", type=int, default=128)
+    parser.add_argument("--page-size", type=int, default=16)
+    parser.add_argument("--iters", type=int, default=16)
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from aphrodite_tpu.ops.attention import paged_decode_attention_ref
+    from aphrodite_tpu.ops.pallas.paged_attention import (
+        paged_decode_attention, paged_decode_attention_allheads)
+
+    B, ctx, page = args.batch, args.ctx, args.page_size
+    Hq, Hkv, d = args.heads, args.kv_heads, args.head_dim
+    pps = ctx // page
+    num_pages = max(B * pps + 1, 1024)
+    rs = np.random.RandomState(0)
+    dtype = jnp.bfloat16 if jax.default_backend() == "tpu" \
+        else jnp.float32
+    q = jnp.asarray(rs.randn(B, Hq, d) * 0.05, dtype)
+    kp = jnp.asarray(rs.randn(Hkv, num_pages, page, d) * 0.05, dtype)
+    vp = jnp.asarray(rs.randn(Hkv, num_pages, page, d) * 0.05, dtype)
+    bt = jnp.asarray(
+        rs.permutation(B * pps).reshape(B, pps).astype(np.int32))
+    cl = jnp.full((B,), ctx, jnp.int32)
+    scale = d ** -0.5
+    kv_gb = B * ctx * 2 * Hkv * d * kp.dtype.itemsize / 1e9
+
+    variants = {
+        "xla_gather": lambda c: paged_decode_attention_ref(
+            c, kp, vp, bt, cl, scale),
+    }
+    if jax.default_backend() == "tpu" and d % 128 == 0:
+        variants["pallas_v1"] = lambda c: paged_decode_attention(
+            c, kp, vp, bt, cl, scale=scale)
+        variants["pallas_allheads"] = \
+            lambda c: paged_decode_attention_allheads(
+                c, kp, vp, bt, cl, scale=scale)
+
+    for name, fn in variants.items():
+        @jax.jit
+        def many(c):
+            def body(x, _):
+                return x * 0.999 + 1e-6 * fn(x), ()
+            return jax.lax.scan(body, c, None, length=args.iters)[0]
+
+        out = many(q)
+        _ = float(jnp.sum(out))                 # force + warm
+        t0 = time.perf_counter()
+        _ = float(jnp.sum(many(q)))
+        dt = (time.perf_counter() - t0) / args.iters
+        print(json.dumps({
+            "metric": f"decode_attention_{name}",
+            "value": round(dt * 1e3, 3),
+            "unit": "ms/layer",
+            "detail": {"batch": B, "ctx": ctx,
+                       "kv_gb_per_call": round(kv_gb, 3),
+                       "eff_gb_s": round(kv_gb / dt, 1)},
+        }))
+
+
+if __name__ == "__main__":
+    main()

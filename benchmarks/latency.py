@@ -1,0 +1,83 @@
+"""Batch-1 decode latency benchmark (reference
+`tests/benchmarks/latency.py`; BASELINE.md table 2: 2000-token prompt,
+1024 output tokens, ignore_eos, single sequence).
+
+Usage:
+    python benchmarks/latency.py --model <path-or-id> [--prompt-len 2000]
+        [--output-len 1024]
+Prints one JSON line: decode tok/s + TTFT.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", required=True)
+    parser.add_argument("--load-format", default="auto")
+    parser.add_argument("--dtype", default="bfloat16")
+    parser.add_argument("--quantization", default=None)
+    parser.add_argument("--kv-cache-dtype", default="auto")
+    parser.add_argument("--prompt-len", type=int, default=2000)
+    parser.add_argument("--output-len", type=int, default=1024)
+    parser.add_argument("--multi-step", type=int, default=32)
+    parser.add_argument("--warmup", type=int, default=1)
+    args = parser.parse_args()
+
+    from aphrodite_tpu.common.sampling_params import SamplingParams
+    from aphrodite_tpu.common.sequence import Sequence, SequenceGroup
+    from aphrodite_tpu.engine.aphrodite_engine import AphroditeEngine
+    from aphrodite_tpu.engine.args_tools import EngineArgs
+
+    engine = AphroditeEngine.from_engine_args(EngineArgs(
+        model=args.model, load_format=args.load_format, dtype=args.dtype,
+        quantization=args.quantization,
+        kv_cache_dtype=args.kv_cache_dtype,
+        max_model_len=args.prompt_len + args.output_len + 16,
+        max_num_seqs=1, skip_tokenizer_init=True,
+        disable_log_stats=True, multi_step=args.multi_step))
+    vocab = engine.model_config.get_vocab_size()
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(5, vocab - 5, size=args.prompt_len).tolist()
+
+    def run(out_len):
+        sp = SamplingParams(temperature=0.0, max_tokens=out_len,
+                            ignore_eos=True)
+        seq = Sequence(next(engine.seq_counter), None, list(prompt),
+                       engine.cache_config.block_size)
+        engine.scheduler.add_seq_group(
+            SequenceGroup(f"lat-{time.monotonic_ns()}", [seq], sp,
+                          time.monotonic()))
+        t0 = time.perf_counter()
+        ttft = None
+        n = 0
+        while engine.has_unfinished_requests():
+            outs = engine.step()
+            if ttft is None and outs and outs[0].outputs and \
+                    outs[0].outputs[0].token_ids:
+                ttft = time.perf_counter() - t0
+            for o in outs:
+                if o.finished:
+                    n = len(o.outputs[0].token_ids)
+        return time.perf_counter() - t0, ttft, n
+
+    for _ in range(args.warmup):
+        run(min(64, args.output_len))
+    wall, ttft, n = run(args.output_len)
+    decode_tps = (n - 1) / (wall - ttft) if n > 1 else 0.0
+    print(json.dumps({
+        "metric": "bs1_decode_tok_s",
+        "value": round(decode_tps, 1),
+        "unit": "tok/s",
+        "detail": {"ttft_s": round(ttft, 3), "e2e_s": round(wall, 2),
+                   "prompt_len": args.prompt_len, "output_len": n},
+    }))
+
+
+if __name__ == "__main__":
+    main()

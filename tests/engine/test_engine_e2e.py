@@ -290,3 +290,17 @@ def test_swap_preemption_under_tight_memory(tiny_model_dir):
     plenty, _ = run(512)
     tight, saw_pressure = run(18)
     assert tight == plenty
+
+
+def test_long_prompt_beyond_page_bucket(tiny_model_dir):
+    """Prompts longer than one table bucket (>8 pages) must prefill and
+    decode (regression: _prepare_prompt clamped tables to 8 pages and
+    crashed on 2000-token prompts)."""
+    from aphrodite_tpu.endpoints.llm import LLM
+    llm = LLM(model=tiny_model_dir, load_format="dummy", dtype="float32",
+              block_size=16, max_model_len=256, max_num_seqs=2,
+              swap_space=0.01, skip_tokenizer_init=True)
+    prompt = [(i * 7) % 100 + 5 for i in range(200)]      # 13 pages
+    sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+    out = llm.generate(prompt_token_ids=[prompt], sampling_params=sp)
+    assert len(out[0].outputs[0].token_ids) == 6

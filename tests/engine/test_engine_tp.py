@@ -31,3 +31,48 @@ def test_tp4_matches_single_device(tp_llm, tiny_llm):
     tp_out = tp_llm.generate(prompt, sp)[0].outputs[0].token_ids
     single = tiny_llm.generate(prompt, sp)[0].outputs[0].token_ids
     assert tp_out == single
+
+
+_TINY8_CFG = {
+    "architectures": ["LlamaForCausalLM"], "model_type": "llama",
+    "vocab_size": 128, "hidden_size": 64, "intermediate_size": 128,
+    "num_hidden_layers": 2, "num_attention_heads": 8,
+    "num_key_value_heads": 2, "max_position_embeddings": 256,
+    "rms_norm_eps": 1e-6, "rope_theta": 10000.0,
+    "tie_word_embeddings": False, "torch_dtype": "float32",
+    "bos_token_id": 0, "eos_token_id": 1,
+}
+_TINY_MIXTRAL_CFG = {
+    "architectures": ["MixtralForCausalLM"], "model_type": "mixtral",
+    "vocab_size": 128, "hidden_size": 64, "intermediate_size": 96,
+    "num_hidden_layers": 2, "num_attention_heads": 4,
+    "num_key_value_heads": 2, "num_local_experts": 4,
+    "num_experts_per_tok": 2, "max_position_embeddings": 256,
+    "rms_norm_eps": 1e-6, "rope_theta": 10000.0,
+    "tie_word_embeddings": False, "torch_dtype": "float32",
+    "bos_token_id": 0, "eos_token_id": 1,
+}
+
+
+def _greedy_tokens(model_dir, tp):
+    from aphrodite_tpu.endpoints.llm import LLM
+    llm = LLM(model=model_dir, load_format="dummy", dtype="float32",
+              tensor_parallel_size=tp, block_size=16, max_model_len=128,
+              max_num_seqs=2, swap_space=0.01, skip_tokenizer_init=True)
+    sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+    out = llm.generate(prompt_token_ids=[[5, 9, 11, 3]],
+                       sampling_params=sp)
+    return out[0].outputs[0].token_ids
+
+
+@pytest.mark.parametrize("cfg,tp", [(_TINY8_CFG, 8),
+                                    (_TINY_MIXTRAL_CFG, 4)])
+def test_tp_matches_single_device_parametrized(tmp_path, cfg, tp):
+    """Full-engine greedy bit-compat at high tp: tp=8 with
+    kv_heads=2 < tp (KV pages replicate while q heads shard), and
+    Mixtral MoE (expert axis sharded over tp) at tp=4."""
+    import json
+    path = tmp_path / "m"
+    path.mkdir()
+    (path / "config.json").write_text(json.dumps(cfg))
+    assert _greedy_tokens(str(path), tp) == _greedy_tokens(str(path), 1)
