@@ -109,7 +109,12 @@ def main() -> None:
         max_model_len=2048, max_num_seqs=batch, disable_log_stats=True,
         skip_tokenizer_init=True, multi_step=multi_step,
         quantization=quant, kv_cache_dtype=kv_dtype,
-        block_size=block_size))
+        block_size=block_size,
+        # Big prefill rounds: each scheduling round pays a fixed
+        # dispatch+sync cost on this platform, so batch as many prompt
+        # tokens as possible per round.
+        max_num_batched_tokens=int(os.environ.get("BENCH_PREFILL_TOKENS",
+                                                  "4096"))))
 
     # Fit the batch to KV capacity: a batch whose total footprint
     # exceeds the device pool just thrashes swap/preemption and measures
