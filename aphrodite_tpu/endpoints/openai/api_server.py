@@ -98,6 +98,8 @@ class OpenAIServer:
         app = web.Application(middlewares=[self._auth_middleware])
         app[ENGINE_KEY] = self.engine
         app.router.add_get("/health", self.health)
+        app.router.add_post("/start_profile", self.start_profile)
+        app.router.add_post("/stop_profile", self.stop_profile)
         app.router.add_get("/v1/models", self.show_models)
         app.router.add_post("/v1/tokenize", self.tokenize)
         app.router.add_post("/v1/completions", self.create_completion)
@@ -108,7 +110,9 @@ class OpenAIServer:
 
     @web.middleware
     async def _auth_middleware(self, request: web.Request, handler):
-        if self.api_keys and request.path.startswith("/v1"):
+        protected = request.path.startswith("/v1") or \
+            request.path in ("/start_profile", "/stop_profile")
+        if self.api_keys and protected:
             auth = request.headers.get("Authorization", "")
             token = auth.removeprefix("Bearer ").strip()
             if token not in self.api_keys:
@@ -121,6 +125,28 @@ class OpenAIServer:
     async def health(self, request: web.Request) -> web.Response:
         await self.engine.check_health()
         return web.Response(status=200)
+
+    async def start_profile(self, request: web.Request) -> web.Response:
+        """Begin a jax.profiler trace (xprof/tensorboard viewable);
+        body: {"trace_dir": "..."} (default /tmp/aphrodite-profile)."""
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        trace_dir = body.get("trace_dir", "/tmp/aphrodite-profile")
+        try:
+            self.engine.engine.start_profile(trace_dir)
+        except RuntimeError as e:
+            return _error(str(e))
+        return web.json_response({"status": "profiling",
+                                  "trace_dir": trace_dir})
+
+    async def stop_profile(self, request: web.Request) -> web.Response:
+        try:
+            self.engine.engine.stop_profile()
+        except RuntimeError as e:
+            return _error(str(e))
+        return web.json_response({"status": "stopped"})
 
     async def metrics(self, request: web.Request) -> web.Response:
         return web.Response(body=generate_latest(),

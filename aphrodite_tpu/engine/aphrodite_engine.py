@@ -87,6 +87,31 @@ class AphroditeEngine:
         self._ttft_samples: List[float] = []
         self._tpot_samples: List[float] = []
         self._e2e_samples: List[float] = []
+        self._profiling = False
+
+    # -- profiling (reference aux tracing; TPU-native: jax.profiler
+    #    traces carry XLA/TPU timelines viewable in tensorboard/xprof) --
+
+    def start_profile(self, trace_dir: str) -> None:
+        """Begin a jax.profiler trace of engine steps (device timeline +
+        host events) into `trace_dir`."""
+        import jax
+        if self._profiling:
+            raise RuntimeError("profiler already running")
+        jax.profiler.start_trace(trace_dir)
+        self._profiling = True
+        logger.info("Started jax.profiler trace -> %s", trace_dir)
+
+    def stop_profile(self) -> None:
+        import jax
+        if not self._profiling:
+            raise RuntimeError("profiler not running")
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            # A failed flush (disk full) must not wedge the API.
+            self._profiling = False
+        logger.info("Stopped jax.profiler trace")
 
     # -- construction --
 

@@ -105,12 +105,25 @@ def resolve_model_path(model_path: str) -> str:
     snapshot_download)."""
     if os.path.isdir(model_path) or os.path.isfile(model_path):
         return model_path
-    from huggingface_hub import snapshot_download
     lock_dir = os.environ.get("APHRODITE_CACHE",
                               os.path.expanduser("~/.cache/aphrodite"))
     os.makedirs(lock_dir, exist_ok=True)
     lock_path = os.path.join(
         lock_dir, model_path.replace("/", "--") + ".lock")
+    if os.environ.get("APHRODITE_USE_MODELSCOPE", "").lower() in (
+            "1", "true"):
+        # Reference hf_downloader.py:30-41: ModelScope replaces the HF
+        # hub when requested. Same lock: replicas download once.
+        try:
+            from modelscope.hub.snapshot_download import (
+                snapshot_download as ms_snapshot_download)
+        except ImportError as e:
+            raise ImportError(
+                "APHRODITE_USE_MODELSCOPE is set but the modelscope "
+                "package is not installed") from e
+        with _file_lock(lock_path):
+            return ms_snapshot_download(model_path)
+    from huggingface_hub import snapshot_download
     with _file_lock(lock_path):
         return snapshot_download(
             model_path,

@@ -128,6 +128,7 @@ def gptq_matmul(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
     # small, so spend VMEM on big tiles — block_k spans several quant
     # groups (the kernel dequants each group chunk separately) and
     # block_n goes up to 2048 lanes.
+    import os
     block_k = gs
     while block_k < 512 and K % (block_k * 2) == 0:
         block_k *= 2
@@ -135,9 +136,13 @@ def gptq_matmul(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
         (bn for bn in (2048, 1024, 512, 256, 128) if N % bn == 0),
         key=lambda bn: bn)
     sublane = 16 if x.dtype == jnp.bfloat16 else 8
-    block_m = min(512, -(-m // sublane) * sublane)
-    if block_m >= 512 and block_n > 1024:
-        block_n = 1024          # keep acc + tiles within VMEM
+    bm_cap = int(os.environ.get("APHRODITE_QMM_BLOCK_M", "512"))
+    bm_cap = max(sublane, bm_cap // sublane * sublane)
+    block_m = min(bm_cap, -(-m // sublane) * sublane)
+    bn_cap = int(os.environ.get("APHRODITE_QMM_BLOCK_N", "0")) or (
+        1024 if block_m >= 512 else 4096)
+    while block_n > 128 and (block_n > bn_cap or N % block_n != 0):
+        block_n //= 2           # keep N % block_n == 0 under any cap
     padded_m = -(-m // block_m) * block_m
     # Plane-order unpack (see _unpack_planes): permute x's columns to
     # match — per GROUP, since the kernel unpacks each group chunk

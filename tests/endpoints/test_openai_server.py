@@ -246,3 +246,28 @@ def test_grammar_constrained_completion(server_ctx):
             "grammar": "start: !!not a grammar"})
         assert r.status == 400
     run(server_ctx, go)
+
+
+def test_profile_endpoints(server_ctx, tmp_path):
+    """POST /start_profile + /stop_profile wrap a jax.profiler trace
+    around live requests (SURVEY §5 tracing/profiling)."""
+    trace_dir = str(tmp_path / "trace")
+
+    async def go(client):
+        r = await client.post("/start_profile",
+                              json={"trace_dir": trace_dir})
+        assert r.status == 200, await r.text()
+        r = await client.post("/v1/completions", json={
+            "model": MODEL_KEY, "prompt": "hi", "max_tokens": 2,
+            "ignore_eos": True})
+        assert r.status == 200
+        r = await client.post("/stop_profile", json={})
+        assert r.status == 200
+        # Double-stop errors cleanly.
+        r = await client.post("/stop_profile", json={})
+        assert r.status == 400
+    run(server_ctx, go)
+    import glob
+    assert glob.glob(trace_dir + "/**/*.pb", recursive=True) or \
+        glob.glob(trace_dir + "/**/*.xplane.pb", recursive=True) or \
+        glob.glob(trace_dir + "/*", recursive=False)
