@@ -158,6 +158,7 @@ def _decode_kernel_allheads(
     scale: float,
     kv_scale: float,
     has_alibi: bool = False,
+    single_chunk: bool = False,
 ):
     """All-kv-heads-per-cell flash decoding: one grid cell handles every
     kv head of one sequence, so the online-softmax runs on
@@ -178,10 +179,11 @@ def _decode_kernel_allheads(
     ctx = context_lens_ref[b]
     num_chunks = (ctx + chunk_tokens - 1) // chunk_tokens
 
-    def chunk_dmas(c, slot):
+    def chunk_dmas(c, slot, cell=None):
+        cell = b if cell is None else cell
         copies = []
         for p in range(pages_per_chunk):  # static unroll
-            page_idx = block_tables_ref[b, c * pages_per_chunk + p]
+            page_idx = block_tables_ref[cell, c * pages_per_chunk + p]
             dst = pl.ds(p * page_size, page_size)
             for h in range(H):            # static unroll
                 copies.append(
@@ -194,24 +196,41 @@ def _decode_kernel_allheads(
                                           sems.at[slot, 1]))
         return copies
 
-    def start_chunk(c, slot):
-        for dma in chunk_dmas(c, slot):
+    def start_chunk(c, slot, cell=None):
+        for dma in chunk_dmas(c, slot, cell):
             dma.start()
 
     acc_scr[...] = jnp.zeros_like(acc_scr)
     m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
     l_scr[...] = jnp.zeros_like(l_scr)
 
-    @pl.when(num_chunks > 0)
-    def _():
-        start_chunk(0, 0)
+    if single_chunk:
+        # Every sequence fits one chunk (table width == chunk): pipeline
+        # ACROSS grid cells instead — cell b starts cell b+1's loads
+        # before waiting on its own, so the ~page-DMA latency chain
+        # overlaps the previous cell's compute. Scratch (and its
+        # semaphores) persist across cells, alternating slots by cell
+        # parity (body() derives the slot from b).
+
+        @pl.when(b == 0)
+        def _():
+            start_chunk(0, 0, cell=0)
+
+        @pl.when(b + 1 < pl.num_programs(0))
+        def _():
+            start_chunk(0, jax.lax.rem(b + 1, 2), cell=b + 1)
+    else:
+        @pl.when(num_chunks > 0)
+        def _():
+            start_chunk(0, 0)
 
     def body(c, _):
-        slot = jax.lax.rem(c, 2)
+        slot = jax.lax.rem(b, 2) if single_chunk else jax.lax.rem(c, 2)
 
-        @pl.when(c + 1 < num_chunks)
-        def _():
-            start_chunk(c + 1, jax.lax.rem(c + 1, 2))
+        if not single_chunk:
+            @pl.when(c + 1 < num_chunks)
+            def _():
+                start_chunk(c + 1, jax.lax.rem(c + 1, 2))
 
         for dma in chunk_dmas(c, slot):
             dma.wait()
@@ -256,7 +275,13 @@ def _decode_kernel_allheads(
         m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
 
-    jax.lax.fori_loop(0, num_chunks, body, None)
+    if single_chunk:
+        # Unconditional: this cell's DMAs were started by the previous
+        # cell (or above for b == 0) and MUST be waited even for ctx==0
+        # padding rows (masking zeroes their contribution).
+        body(0, None)
+    else:
+        jax.lax.fori_loop(0, num_chunks, body, None)
 
     l_final = l_scr[:, :1]
     l_safe = jnp.where(l_final == 0.0, 1.0, l_final)
@@ -305,6 +330,7 @@ def paged_decode_attention_allheads(
         scale=scale,
         kv_scale=kv_scale,
         has_alibi=alibi_slopes is not None,
+        single_chunk=pages_per_seq == pages_per_chunk,
     )
     in_specs = [
         pl.BlockSpec((1, num_q_heads, head_dim),
