@@ -173,24 +173,42 @@ class GrammarMatcher:
         parser = self._parsers[parser_key]
         return self._intern(parser.feed_token(Token(terminal, text)))
 
-    def _commit_partial(self, state):
-        """If the pending partial text is itself a complete terminal,
-        the state after committing it; else None."""
+    def can_end(self, state) -> bool:
+        """True if the input consumed so far is a complete sentence.
+
+        `_advance` defers committing while any accepted terminal could
+        still grow (maximal munch), so the pending partial may span
+        SEVERAL complete terminals — e.g. with terminals AB="ab!",
+        A="a", B="b" and input "ab", the state is deferred on AB but
+        "ab" = A B may already be a full parse. A single
+        longest-complete-match commit misses that (round-2 advisor
+        finding), so this searches every complete-match split of the
+        partial for one that reaches $END."""
         parser_key, partial = state
+        # Every complete match consumes >= 1 char (match_re requires
+        # m.end() > 0; literals are non-empty), so recursion depth is
+        # bounded by the INITIAL partial length. The bound must not
+        # shrink with the remainder: a split into N single-char
+        # terminals legitimately recurses N deep.
+        return self._can_end(parser_key, partial, len(partial) + 4)
+
+    def _can_end(self, parser_key: int, partial: str,
+                 depth_left: int) -> bool:
+        accepts = self._accepts(parser_key)
         if partial == "":
-            return state
-        for terminal in sorted(self._accepts(parser_key)):
+            return END in accepts
+        if depth_left <= 0:                    # defensive cycle bound
+            return False
+        for terminal in sorted(accepts):
             if terminal == END:
                 continue
             processed, remainder, _ = self._validators[terminal](partial)
-            if processed == partial and remainder == "":
-                return (self._feed(parser_key, terminal, partial), "")
-        return None
-
-    def can_end(self, state) -> bool:
-        committed = self._commit_partial(state)
-        return committed is not None and \
-            END in self._accepts(committed[0])
+            if processed is None:
+                continue
+            next_key = self._feed(parser_key, terminal, processed)
+            if self._can_end(next_key, remainder, depth_left - 1):
+                return True
+        return False
 
 
 class TokenTrie:

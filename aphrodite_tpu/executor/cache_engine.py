@@ -68,17 +68,25 @@ class CacheEngine:
         self.num_layers = model_config.hf_config.num_hidden_layers
         self.num_kv_heads = model_config.get_total_num_kv_heads()
         self.kv_heads_per_layer = model_config.get_kv_heads_per_layer()
-        self.head_size = model_config.get_head_size()
+        # Pages store head_dim padded to the 128-lane tile (see
+        # ops/kv_cache.padded_head_size) so every head size runs the
+        # Pallas decode/write kernels.
+        from aphrodite_tpu.ops.kv_cache import padded_head_size
+        self.head_size = padded_head_size(model_config.get_head_size())
 
         model_dtype = _MODEL_DTYPES[model_config.dtype]
         quant = _CACHE_DTYPES[cache_config.cache_dtype]
         self.dtype = quant if quant is not None else model_dtype
 
+        # int8 KV dequant scale: owned here, threaded explicitly through
+        # InputMetadata.kv_scale (static field) so jit caches key on it
+        # — no process-global (round-2 advisor finding).
+        self.kv_scale = 1.0
         if cache_config.cache_dtype == "int8":
-            from aphrodite_tpu.ops.kv_quant import set_kv_scale
             import os
-            set_kv_scale(float(os.environ.get("APHRODITE_KV_SCALE",
-                                              "0.05")))
+            from aphrodite_tpu.ops.kv_quant import DEFAULT_KV_SCALE
+            self.kv_scale = float(os.environ.get(
+                "APHRODITE_KV_SCALE", str(DEFAULT_KV_SCALE)))
 
         self.kv_caches: List[KVCache] = self._allocate_device()
         # Host swap pool: per layer [2, heads_i, pages, page, dim] numpy
@@ -168,8 +176,9 @@ class CacheEngine:
         `cache_engine.py:148-171`), for the profiling -> page-count math.
         Uses TOTAL kv heads: with TP sharding each chip holds
         heads/tp, but it also only gets budget/tp of the pool."""
+        from aphrodite_tpu.ops.kv_cache import padded_head_size
         total_heads = sum(model_config.get_kv_heads_per_layer())
-        head_size = model_config.get_head_size()
+        head_size = padded_head_size(model_config.get_head_size())
         if cache_config.cache_dtype in ("fp8", "int8"):
             elt = 1
         elif model_config.dtype == "float32":

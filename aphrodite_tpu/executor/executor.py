@@ -85,7 +85,8 @@ class TPUExecutor:
             self.model, self.params, model_config, scheduler_config,
             page_size=cache_config.block_size,
             num_slots=self.cache_engine.num_slots,
-            mesh=self.mesh)
+            mesh=self.mesh,
+            kv_scale=self.cache_engine.kv_scale)
 
         self.lora_manager = None
         if lora_config is not None:

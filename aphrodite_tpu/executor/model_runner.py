@@ -73,6 +73,7 @@ class ModelRunner:
         page_size: int,
         num_slots: int,
         mesh=None,
+        kv_scale: float = 1.0,
     ) -> None:
         self.model = model
         self.params = params
@@ -81,6 +82,7 @@ class ModelRunner:
         self.page_size = page_size
         self.num_slots = num_slots          # OOB pad value for slots
         self.mesh = mesh
+        self.kv_scale = kv_scale            # int8 KV dequant scale
         self.sampler = Sampler(model_config.get_vocab_size())
 
         # LoRA: bucket keys carrying slot-stacked adapter tensors, and a
@@ -304,6 +306,7 @@ class ModelRunner:
             block_tables=jnp.asarray(tables),
             context_lens=jnp.asarray(ctx_lens),
             prompt_lens=jnp.asarray(plens),
+            kv_scale=self.kv_scale,
         )
         prompt_offsets = [int(c) for c in ctx_lens[:batch]]
         sampling = SamplingMetadata(
@@ -386,6 +389,7 @@ class ModelRunner:
             slot_mapping=jnp.asarray(slots),
             block_tables=jnp.asarray(tables),
             context_lens=jnp.asarray(ctx_lens),
+            kv_scale=self.kv_scale,
         )
         sampling = SamplingMetadata(
             seq_groups=seq_groups,

@@ -37,3 +37,7 @@ class InputMetadata:
     # Prefill against a non-empty cached prefix (prefix caching / chunked
     # prefill); selects the gather-from-pages prefill path.
     use_prefix: bool = struct.field(pytree_node=False, default=False)
+    # int8 KV dequant scale (value = int8 * kv_scale); 1.0 for non-int8
+    # caches. Static so every jit / Pallas compile cache keys on it —
+    # the scale is a trace-time constant folded into kernel epilogues.
+    kv_scale: float = struct.field(pytree_node=False, default=1.0)

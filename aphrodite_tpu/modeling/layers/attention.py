@@ -54,6 +54,11 @@ class PagedAttention:
             jnp.asarray(alibi_slopes, dtype=jnp.float32)
         self.sliding_window = sliding_window
         self.use_pallas = use_pallas
+        from aphrodite_tpu.ops.kv_cache import padded_head_size
+        # Cache pages pad head_dim to the 128-lane tile; q/k/v pad with
+        # zeros on the way in (inert in scores) and outputs slice the
+        # pad lanes off. See ops/kv_cache.padded_head_size.
+        self.padded_head = padded_head_size(head_size)
 
     def __call__(
         self,
@@ -75,8 +80,14 @@ class PagedAttention:
         if k_pages is not None:
             flat_k = k.reshape(-1, self.num_kv_heads, self.head_size)
             flat_v = v.reshape(-1, self.num_kv_heads, self.head_size)
+            if self.padded_head != self.head_size:
+                pad = ((0, 0), (0, 0),
+                       (0, self.padded_head - self.head_size))
+                flat_k = jnp.pad(flat_k, pad)
+                flat_v = jnp.pad(flat_v, pad)
             k_pages, v_pages = write_to_kv_cache(
-                flat_k, flat_v, k_pages, v_pages, metadata.slot_mapping)
+                flat_k, flat_v, k_pages, v_pages, metadata.slot_mapping,
+                kv_scale=metadata.kv_scale)
             # Keep the scatter un-fused from its readers: fusing the
             # in-place page update into the attention gather forces XLA to
             # materialize a full temp copy of the cache (multi-GB/step).
@@ -102,9 +113,12 @@ class PagedAttention:
             # Attend over [cached prefix ; this chunk] gathered from pages
             # (reference prefix path, triton context_attention_fwd).
             from aphrodite_tpu.ops.kv_quant import dequant_scale
-            kv_s = dequant_scale(k_pages.dtype)
+            kv_s = dequant_scale(k_pages.dtype, metadata.kv_scale)
             kv_k = gather_pages(k_pages, metadata.block_tables)
             kv_v = gather_pages(v_pages, metadata.block_tables)
+            if self.padded_head != self.head_size:
+                kv_k = kv_k[..., :self.head_size]
+                kv_v = kv_v[..., :self.head_size]
             if kv_s != 1.0:
                 kv_k = kv_k.astype(jnp.float32) * kv_s
                 kv_v = kv_v.astype(jnp.float32) * kv_s
@@ -126,19 +140,22 @@ class PagedAttention:
     def _decode(self, q, k_pages, v_pages,
                 metadata: InputMetadata) -> jax.Array:
         q3 = q.reshape(q.shape[0], self.num_heads, self.head_size)
+        if self.padded_head != self.head_size:
+            # Pages pad head_dim to the lane tile; zero q lanes leave
+            # scores untouched and the output pad lanes slice off below.
+            q3 = jnp.pad(q3, ((0, 0), (0, 0),
+                              (0, self.padded_head - self.head_size)))
         # Sliding window: context_lens are already clamped host-side to the
         # window and block tables wrap (reference model_runner.py:278-293),
         # so the kernels need no window logic in decode.
-        # Mosaic tiling: DMA slice last dim must be 128-aligned, so small
-        # heads (e.g. 64) take the XLA gather path for now. Quantized
-        # pages (int8/fp8) run in-kernel: the int8 scale folds into the
-        # score scale and output epilogue (see ops/kv_quant.py).
+        # Quantized pages (int8/fp8) run in-kernel: the int8 scale folds
+        # into the score scale and output epilogue (see ops/kv_quant.py).
         from aphrodite_tpu.ops.kv_quant import dequant_scale
         quant_ok = k_pages.dtype in (jnp.bfloat16, jnp.float32) or (
             k_pages.dtype in (jnp.int8, jnp.float8_e5m2) and
             k_pages.shape[2] % 32 == 0)     # 8-bit sublane tile
         if self.use_pallas and jax.default_backend() == "tpu" and \
-                self.head_size % 128 == 0 and quant_ok:
+                quant_ok:
             from aphrodite_tpu.ops.pallas.paged_attention import (
                 paged_decode_attention, paged_decode_attention_allheads)
             slopes = None if self.alibi_slopes is None else \
@@ -179,17 +196,22 @@ class PagedAttention:
                 out = paged_decode_attention_allheads(
                     q3, k_pages, v_pages, tables,
                     metadata.context_lens, slopes, scale=self.scale,
-                    kv_scale=dequant_scale(k_pages.dtype),
+                    kv_scale=dequant_scale(k_pages.dtype,
+                                           metadata.kv_scale),
                     pages_per_chunk=ppc)
             else:
                 out = paged_decode_attention(
                     q3, k_pages, v_pages, tables,
                     metadata.context_lens, slopes, scale=self.scale,
-                    kv_scale=dequant_scale(k_pages.dtype),
+                    kv_scale=dequant_scale(k_pages.dtype,
+                                           metadata.kv_scale),
                     pages_per_chunk=ppc)
         else:
             out = paged_decode_attention_ref(
                 q3, k_pages, v_pages, metadata.block_tables,
                 metadata.context_lens, self.scale,
-                alibi_slopes=self.alibi_slopes)
+                alibi_slopes=self.alibi_slopes,
+                kv_scale=metadata.kv_scale)
+        if self.padded_head != self.head_size:
+            out = out[..., :self.head_size]
         return out[:, None]  # [batch, 1, H, d]

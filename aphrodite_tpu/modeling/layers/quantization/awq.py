@@ -110,6 +110,23 @@ class AWQLinearMethod(LinearMethod):
 
     def apply(self, params: Dict[str, jax.Array],
               x: jax.Array) -> jax.Array:
+        cfg = self.config
+        qw = params["qweight"]
+        in_features, n_packed = qw.shape
+        lead = x.shape[:-1]
+        if jax.default_backend() == "tpu":
+            from aphrodite_tpu.ops.pallas.quant_matmul import (
+                awq_matmul, awq_supported)
+            if awq_supported(in_features, n_packed * 8, cfg.group_size):
+                y = awq_matmul(x.reshape(-1, in_features), qw,
+                               params["qzeros"], params["scales"],
+                               group_size=cfg.group_size)
+                y = y.reshape(*lead, n_packed * 8)
+                if "bias" in params:
+                    y = y + params["bias"]
+                return y
+        # XLA fallback: dequantize the whole matrix then matmul (the
+        # ~9x-HBM-traffic path — only for shapes the kernel rejects).
         w = self.dequantize(params, x.dtype)
         y = x @ w
         if "bias" in params:

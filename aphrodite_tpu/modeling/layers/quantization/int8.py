@@ -60,10 +60,22 @@ class Int8LinearMethod(LinearMethod):
 
     def apply(self, params: Dict[str, jax.Array],
               x: jax.Array) -> jax.Array:
-        # int8 weights upcast in the GEMM prologue; scales applied on the
+        w = params["weight"]
+        in_features, out_features = w.shape
+        if jax.default_backend() == "tpu":
+            from aphrodite_tpu.ops.pallas.quant_matmul import (
+                int8_matmul, int8_supported)
+            if int8_supported(in_features, out_features):
+                lead = x.shape[:-1]
+                y = int8_matmul(x.reshape(-1, in_features), w,
+                                params["scales"])
+                y = y.reshape(*lead, out_features)
+                if "bias" in params:
+                    y = y + params["bias"]
+                return y
+        # XLA fallback: upcast in the GEMM prologue; scales on the
         # output channel.
-        w = params["weight"].astype(x.dtype)
-        y = (x @ w) * params["scales"].astype(x.dtype)
+        y = (x @ w.astype(x.dtype)) * params["scales"].astype(x.dtype)
         if "bias" in params:
             y = y + params["bias"]
         return y

@@ -186,6 +186,36 @@ def _pad_pow2(n: int) -> int:
     return 1 << (n - 1).bit_length()
 
 
+def get_hadK(n: int, use_rand: bool = True):
+    """(had [K, K] or None, K, q_features) for dimension n — the
+    factored transform decomposition (reference `quip_utils.get_hadK`):
+    n = 2^exp * base; base == 1 runs a plain FWHT over n, otherwise the
+    transform is had_K (x) H_{n/K} with a [K, K] orthogonal factor.
+
+    With use_rand the factor is a random special-orthogonal matrix;
+    the reference draws it UNSEEDED at load (scipy special_ortho_group),
+    so it cannot reproduce the quantization-time transform either —
+    real checkpoints are expected to carry had_left/had_right, which
+    override these params at weight load. Seeded here (keyed on n) so
+    at least repeated loads of the same model agree. Without use_rand
+    the reference falls back to pre-computed Hadamard tables
+    (hadamard.safetensors) that are not shipped here; callers must
+    reject that configuration for non-power-of-two dims."""
+    base = n
+    exp = 0
+    while base % 2 == 0:
+        base //= 2
+        exp += 1
+    if base == 1:
+        return None, 1, n
+    if use_rand:
+        from scipy.stats import special_ortho_group
+        mat = special_ortho_group.rvs(
+            base, random_state=np.random.RandomState(base))
+        return np.asarray(mat, dtype=np.float32), base, n
+    return None, 1, _pad_pow2(n)
+
+
 class QuipLinearMethod(LinearMethod):
     """QuIP# linear execution: y = SV * hadU(hadUt(SU * x) @ W^T).
 
@@ -204,14 +234,31 @@ class QuipLinearMethod(LinearMethod):
 
     def create_weights(self, in_features, out_features, dtype, bias,
                        out_axis, in_axis):
-        q_in = _pad_pow2(in_features)
-        q_out = _pad_pow2(out_features)
+        had_l, k_l, q_in = get_hadK(in_features, self.config.use_rand)
+        had_r, k_r, q_out = get_hadK(out_features, self.config.use_rand)
+        if not self.config.use_rand and (q_in != in_features or
+                                         q_out != out_features):
+            # Padding to the next power of two applies a transform
+            # DIFFERENT from quantization time unless the quantizer
+            # padded identically; without the reference's Hadamard
+            # factor tables we cannot know, so fail loudly (ADVICE r2).
+            raise ValueError(
+                "QuIP with use_rand=false needs power-of-two layer "
+                f"dims (got in={in_features}, out={out_features}); "
+                "the pre-computed Hadamard factor tables the reference "
+                "uses for other sizes are not available. Use a "
+                "use_rand=true checkpoint (had_left/had_right ship in "
+                "the checkpoint) or power-of-two dims.")
         params = {
             "weight": jnp.zeros((q_in, q_out), dtype=dtype),
             "Wscale": jnp.ones((), dtype=jnp.float32),
             "SU": jnp.ones((in_features,), dtype=dtype),
             "SV": jnp.ones((out_features,), dtype=dtype),
         }
+        if had_l is not None:
+            params["had_left"] = jnp.asarray(had_l, dtype=jnp.float32)
+        if had_r is not None:
+            params["had_right"] = jnp.asarray(had_r, dtype=jnp.float32)
         if bias:
             params["bias"] = jnp.zeros((out_features,), dtype=dtype)
         return params
@@ -221,6 +268,8 @@ class QuipLinearMethod(LinearMethod):
         # replicate.
         specs = {"weight": P(None, None), "Wscale": P(),
                  "SU": P(None), "SV": P(None)}
+        for name in ("had_left", "had_right"):
+            specs[name] = P(None, None)
         if bias:
             specs["bias"] = P(None)
         return specs
@@ -231,15 +280,19 @@ class QuipLinearMethod(LinearMethod):
         q_in, q_out = w.shape
         in_features = params["SU"].shape[0]
         out_features = params["SV"].shape[0]
+        had_l = params.get("had_left")
+        had_r = params.get("had_right")
+        k_l = 1 if had_l is None else had_l.shape[0]
+        k_r = 1 if had_r is None else had_r.shape[0]
         lead = x.shape[:-1]
         xr = x.reshape(-1, in_features) * params["SU"][None, :]
-        xr = matmul_hadU(xr.astype(jnp.float32), None, 1, q_in,
+        xr = matmul_hadU(xr.astype(jnp.float32), had_l, k_l, q_in,
                          transpose=True)
         # Wscale stays a traced multiply — float(tracer) would fail
         # under jit.
         xr = xr * params["Wscale"].astype(jnp.float32)
         out = xr @ w.astype(jnp.float32)          # [m, q_out]
-        out = matmul_hadU(out, None, 1, q_out)[..., :out_features]
+        out = matmul_hadU(out, had_r, k_r, q_out)[..., :out_features]
         out = out * params["SV"][None, :].astype(jnp.float32)
         out = out.astype(x.dtype).reshape(*lead, out_features)
         if "bias" in params:
@@ -255,11 +308,10 @@ class QuipLinearMethod(LinearMethod):
 
 
 def quip_weight_from_qidxs(qidxs: np.ndarray) -> np.ndarray:
-    """Checkpoint Qidxs [out, q_in/8] int16 -> dense [q_in, q_out] f32
+    """Checkpoint Qidxs [q_out, q_in/8] int16 -> dense [q_in, q_out] f32
     ready for QuipLinearMethod's `weight` slot (decompress at load; the
-    transpose makes apply() a plain x @ w)."""
-    dense = decompress_e8p(np.asarray(qidxs, np.int16))   # [out, q_in]
-    q_out = _pad_pow2(dense.shape[0])
-    padded = np.zeros((q_out, dense.shape[1]), np.float32)
-    padded[:dense.shape[0]] = dense
-    return padded.T.copy()
+    transpose makes apply() a plain x @ w). Checkpoint Qidxs already
+    carry the transform dims q_out/q_in (reference create_weights
+    allocates them that way), so no padding happens here."""
+    dense = decompress_e8p(np.asarray(qidxs, np.int16))   # [q_out, q_in]
+    return dense.T.copy()

@@ -108,6 +108,7 @@ def paged_decode_attention_ref(
     context_lens: jax.Array,   # [batch]
     scale: float,
     alibi_slopes: Optional[jax.Array] = None,
+    kv_scale: float = 1.0,
 ) -> jax.Array:
     """Decode attention over the paged cache — jnp reference path.
 
@@ -120,7 +121,7 @@ def paged_decode_attention_ref(
     b, num_q_heads, d = q.shape
     num_kv_heads = k_pages.shape[0]
     group = num_q_heads // num_kv_heads
-    kv_s = dequant_scale(k_pages.dtype)    # int8 pages store value/S
+    kv_s = dequant_scale(k_pages.dtype, kv_scale)  # int8 stores value/S
 
     k = gather_pages(k_pages, block_tables)  # [b, Hkv, ctx, d]
     v = gather_pages(v_pages, block_tables)

@@ -30,12 +30,25 @@ import jax
 import jax.numpy as jnp
 
 
+def padded_head_size(head_size: int) -> int:
+    """Cache pages store head_dim padded to the 128-lane tile: Mosaic
+    DMAs slice whole lane tiles, so a 64/80/96-wide head would exclude
+    the Pallas decode/write kernels entirely (round-1/2 gate at
+    `layers/attention.py:141`). Zero pad lanes are inert — q pads with
+    zeros so scores are unchanged, and the output's pad lanes are
+    sliced off (the reference's head-size list `attention.py:17` is the
+    CUDA analog of this constraint). Cost: up to 2x KV bytes for
+    head 64 models — the standard TPU trade."""
+    return -(-head_size // 128) * 128
+
+
 def write_to_kv_cache(
     key: jax.Array,        # [num_tokens, num_kv_heads, head_dim]
     value: jax.Array,      # [num_tokens, num_kv_heads, head_dim]
     k_pages: jax.Array,    # [num_kv_heads, num_pages, page_size, head_dim]
     v_pages: jax.Array,    # [num_kv_heads, num_pages, page_size, head_dim]
     slot_mapping: jax.Array,  # [num_tokens] int32; pad with num_slots (OOB)
+    kv_scale: float = 1.0,    # int8 quantization scale (trace-time const)
 ) -> Tuple[jax.Array, jax.Array]:
     """Scatter freshly computed K/V for each token into its cache slot.
 
@@ -62,8 +75,8 @@ def write_to_kv_cache(
 
     from aphrodite_tpu.ops.kv_quant import quantize_kv
     # [num_tokens, heads, dim] -> [heads, num_tokens, dim]
-    key_ht = quantize_kv(key, k_pages.dtype).swapaxes(0, 1)
-    value_ht = quantize_kv(value, v_pages.dtype).swapaxes(0, 1)
+    key_ht = quantize_kv(key, k_pages.dtype, kv_scale).swapaxes(0, 1)
+    value_ht = quantize_kv(value, v_pages.dtype, kv_scale).swapaxes(0, 1)
 
     k_flat = k_flat.at[:, slot_mapping, :].set(key_ht, mode="drop")
     v_flat = v_flat.at[:, slot_mapping, :].set(value_ht, mode="drop")

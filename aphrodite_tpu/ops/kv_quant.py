@@ -10,33 +10,27 @@ variants + `kernels/quantization/...` cache conversions. Two formats:
   S into the score scale (q.k*S == (q*S).k) and into the output
   epilogue (out = (p.v_int) * S), so int8 KV costs one scalar multiply.
 
-The scale is process-global, set by the cache engine before the first
-trace; jitted code reads it as a trace-time constant.
+The scale is OWNED by the CacheEngine (read from APHRODITE_KV_SCALE at
+engine init) and threaded explicitly through InputMetadata.kv_scale — a
+static pytree field, so every jit/Pallas cache keys on it. No process
+global: two engines in one process with different scales each get their
+own compiled programs (round-2 advisor finding).
 """
 from __future__ import annotations
 
 import jax.numpy as jnp
 
-_KV_SCALE = 0.05
+DEFAULT_KV_SCALE = 0.05
 
 
-def set_kv_scale(scale: float) -> None:
-    global _KV_SCALE
-    _KV_SCALE = float(scale)
-
-
-def kv_scale() -> float:
-    return _KV_SCALE
-
-
-def quantize_kv(x, page_dtype):
+def quantize_kv(x, page_dtype, scale: float = 1.0):
     """Cast activations to the cache page dtype (write path)."""
     if page_dtype == jnp.int8:
-        return jnp.clip(jnp.round(x.astype(jnp.float32) / _KV_SCALE),
+        return jnp.clip(jnp.round(x.astype(jnp.float32) / scale),
                         -127, 127).astype(jnp.int8)
     return x.astype(page_dtype)
 
 
-def dequant_scale(page_dtype) -> float:
+def dequant_scale(page_dtype, scale: float = 1.0) -> float:
     """Multiplier that turns stored page values back into activations."""
-    return _KV_SCALE if page_dtype == jnp.int8 else 1.0
+    return float(scale) if page_dtype == jnp.int8 else 1.0

@@ -1,4 +1,4 @@
-"""Fused weight-dequant matmul Pallas kernel (GPTQ int4/int8 layout).
+"""Fused weight-dequant matmul Pallas kernels (GPTQ + AWQ layouts).
 
 Reference equivalents: `kernels/quantization/gptq/q_gemm.cu` (exllama
 reconstruct+gemm) — the CUDA side fuses int4 dequant into the GEMM so
@@ -19,6 +19,17 @@ Grid: (m_tiles, n_tiles, k_tiles), k innermost accumulating into a VMEM
 f32 scratch; block_k == group_size so each k-step sees exactly one
 quantization group (z and s are single rows — a broadcast, no gather).
 desc_act (g_idx shuffles) stays on the XLA path.
+
+AWQ (`awq_matmul` below) is the lane-dual: its int32 words pack 8
+output columns (interleaved nibble order, `dequantize.cuh:40-53`)
+rather than 8 input rows, so the kernel unpacks nibble PLANES along
+lanes — tile-local plane-major column order — and the wrapper permutes
+zeros/scales into that order in the XLA prologue and un-permutes the
+output columns once at the end (reshape/transpose pairs XLA lowers
+natively; an in-kernel natural-order unpack would be the same
+per-element shuffle disaster the GPTQ docstring describes).
+Reference: `kernels/quantization/awq/gemm_kernels.cu:1-667` fuses
+dequant into a grouped GEMM the same way.
 """
 from __future__ import annotations
 
@@ -189,4 +200,208 @@ def gptq_matmul(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(x, qweight, z_all, scales3)
+    return out[:m] if padded_m != m else out
+
+
+# --------------------------------------------------------------- AWQ --
+
+def _awq_kernel(x_ref, qw_ref, z_ref, s_ref, o_ref, acc_ref, *,
+                k_tiles: int, group_size: int):
+    """One (m, n, k) grid step for the AWQ layout: qw packs 8 output
+    columns per int32 word; nibble planes unpack along LANES into
+    tile-local plane-major column order (plane p occupies lanes
+    [p*bn/8, (p+1)*bn/8)). z/s arrive pre-arranged in the same order,
+    so dequant is elementwise; the wrapper un-permutes the output."""
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    gs = group_size
+    n_groups = z_ref.shape[0]
+    qw = qw_ref[...]                                  # [bk, bn/8] int32
+    planes = [
+        jax.lax.bitwise_and(jax.lax.shift_right_logical(qw, 4 * p), 0xF)
+        for p in range(8)
+    ]
+    w_pm = jax.lax.concatenate(planes, 1)             # [bk, bn] int32
+    chunks = []
+    for g in range(n_groups):
+        q_g = w_pm[g * gs:(g + 1) * gs]
+        z = z_ref[g]                                  # [1, bn] int32
+        s = s_ref[g].astype(jnp.float32)              # [1, bn]
+        chunks.append(
+            ((q_g - z).astype(jnp.float32) * s).astype(x_ref.dtype))
+    w = chunks[0] if n_groups == 1 else jax.lax.concatenate(chunks, 0)
+    acc_ref[...] += jnp.dot(x_ref[...], w,
+                            preferred_element_type=jnp.float32)
+
+    @pl.when(k == k_tiles - 1)
+    def _flush():
+        o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+
+def awq_supported(in_features: int, out_features: int,
+                  group_size: int) -> bool:
+    """Shapes the fused AWQ kernel handles; others use the XLA path."""
+    return (in_features % group_size == 0 and
+            128 <= group_size <= 1024 and
+            out_features % 1024 == 0)    # block_n >= 1024 keeps the
+                                         # plane width lane-aligned
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("group_size", "interpret"))
+def awq_matmul(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
+               scales: jax.Array, *, group_size: int,
+               interpret: bool = False) -> jax.Array:
+    """y[m, N] = x[m, K] @ dequant(qweight, qzeros, scales) for the AWQ
+    int4 layout (qweight [K, N/8] int32, 8 interleaved nibbles along N;
+    qzeros [G, N/8] same packing; scales [G, N]; w = (q - z) * s).
+    """
+    from aphrodite_tpu.modeling.layers.quantization.awq import (
+        AWQ_ORDER, _unpack_awq)
+    m, K = x.shape
+    N = qweight.shape[1] * 8
+    gs = group_size
+    G = K // gs
+
+    block_k = gs
+    while block_k < 512 and K % (block_k * 2) == 0:
+        block_k *= 2
+    import os
+    bn_cap = int(os.environ.get("APHRODITE_QMM_BLOCK_N", "0")) or 2048
+    block_n = max((bn for bn in (2048, 1024) if N % bn == 0 and
+                   bn <= max(bn_cap, 1024)), default=None)
+    if block_n is None:
+        raise ValueError(f"{N=} must be a multiple of 1024")
+    sublane = 16 if x.dtype == jnp.bfloat16 else 8
+    bm_cap = int(os.environ.get("APHRODITE_QMM_BLOCK_M", "512"))
+    bm_cap = max(sublane, bm_cap // sublane * sublane)
+    block_m = min(bm_cap, -(-m // sublane) * sublane)
+    padded_m = -(-m // block_m) * block_m
+    if padded_m != m:
+        x = jnp.pad(x, ((0, padded_m - m), (0, 0)))
+
+    k_tiles = K // block_k
+    groups_per_tile = block_k // gs
+    n_tiles = N // block_n
+    grid = (padded_m // block_m, n_tiles, k_tiles)
+
+    # Tile-local plane-major arrangement for z and s: natural column
+    # c = t*bn + 8j + e sits at t*bn + AWQ_ORDER[e]*(bn/8) + j. Build it
+    # with reshape/transpose (XLA-native): [.., bn/8, 8] -> index the
+    # nibble-order axis -> [.., 8, bn/8].
+    inv = np.argsort(np.asarray(AWQ_ORDER))    # plane p -> element e
+
+    def to_plane_major(a):                     # [..., N] natural
+        t = a.reshape(*a.shape[:-1], n_tiles, block_n // 8, 8)
+        t = jnp.moveaxis(t[..., inv], -1, -2)  # [.., 8, bn/8]
+        return t.reshape(*a.shape[:-1], N)
+
+    order = np.asarray(AWQ_ORDER)
+    z_nat = _unpack_awq(qzeros)                # [G, N] natural order
+    z_pm = to_plane_major(z_nat).reshape(G, 1, N)
+    s_pm = to_plane_major(scales).reshape(G, 1, N)
+
+    out_pm = pl.pallas_call(
+        functools.partial(_awq_kernel, k_tiles=k_tiles, group_size=gs),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_m, block_k), lambda i, n, k: (i, k)),
+            pl.BlockSpec((block_k, block_n // 8),
+                         lambda i, n, k: (k, n)),
+            pl.BlockSpec((groups_per_tile, 1, block_n),
+                         lambda i, n, k: (k, 0, n)),
+            pl.BlockSpec((groups_per_tile, 1, block_n),
+                         lambda i, n, k: (k, 0, n)),
+        ],
+        out_specs=pl.BlockSpec((block_m, block_n),
+                               lambda i, n, k: (i, n)),
+        out_shape=jax.ShapeDtypeStruct((padded_m, N), x.dtype),
+        scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(x, qweight, z_pm, s_pm)
+
+    # Un-permute output columns: plane-major -> natural (inverse of
+    # to_plane_major).
+    y = out_pm.reshape(padded_m, n_tiles, 8, block_n // 8)
+    y = jnp.moveaxis(y, -2, -1)[..., order]    # [m, t, bn/8, 8]
+    y = y.reshape(padded_m, N)
+    return y[:m] if padded_m != m else y
+
+
+# -------------------------------------------------------- int8 dense --
+
+def _int8_kernel(x_ref, w_ref, s_ref, o_ref, acc_ref, *, k_tiles: int):
+    """Per-channel int8 weight tile: upcast in VMEM registers (HBM only
+    ever sees int8 bytes), accumulate, scale columns at flush."""
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    w = w_ref[...].astype(x_ref.dtype)
+    acc_ref[...] += jnp.dot(x_ref[...], w,
+                            preferred_element_type=jnp.float32)
+
+    @pl.when(k == k_tiles - 1)
+    def _flush():
+        o_ref[...] = (acc_ref[...] *
+                      s_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def int8_supported(in_features: int, out_features: int) -> bool:
+    return in_features % 256 == 0 and out_features % 128 == 0
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def int8_matmul(x: jax.Array, weight: jax.Array, scales: jax.Array, *,
+                interpret: bool = False) -> jax.Array:
+    """y[m, N] = (x[m, K] @ int8 weight[K, N]) * scales[N] with the
+    weight read from HBM at int8 width (the XLA fallback's explicit
+    astype may materialize a bf16 copy)."""
+    import os
+    m, K = x.shape
+    N = weight.shape[1]
+    block_k = 256
+    while block_k < 512 and K % (block_k * 2) == 0:
+        block_k *= 2
+    block_n = max(
+        (bn for bn in (2048, 1024, 512, 256, 128) if N % bn == 0),
+        key=lambda bn: bn)
+    sublane = 16 if x.dtype == jnp.bfloat16 else 8
+    bm_cap = int(os.environ.get("APHRODITE_QMM_BLOCK_M", "512"))
+    bm_cap = max(sublane, bm_cap // sublane * sublane)
+    block_m = min(bm_cap, -(-m // sublane) * sublane)
+    bn_cap = int(os.environ.get("APHRODITE_QMM_BLOCK_N", "0")) or (
+        1024 if block_m >= 512 else 4096)
+    while block_n > 128 and (block_n > bn_cap or N % block_n != 0):
+        block_n //= 2
+    padded_m = -(-m // block_m) * block_m
+    if padded_m != m:
+        x = jnp.pad(x, ((0, padded_m - m), (0, 0)))
+    k_tiles = K // block_k
+    grid = (padded_m // block_m, N // block_n, k_tiles)
+
+    out = pl.pallas_call(
+        functools.partial(_int8_kernel, k_tiles=k_tiles),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_m, block_k), lambda i, n, k: (i, k)),
+            pl.BlockSpec((block_k, block_n), lambda i, n, k: (k, n)),
+            pl.BlockSpec((1, block_n), lambda i, n, k: (0, n)),
+        ],
+        out_specs=pl.BlockSpec((block_m, block_n),
+                               lambda i, n, k: (i, n)),
+        out_shape=jax.ShapeDtypeStruct((padded_m, N), x.dtype),
+        scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(x, weight, scales.reshape(1, N))
     return out[:m] if padded_m != m else out

@@ -152,11 +152,15 @@ def main() -> None:
     toks = total_out / dt
     baseline = BASELINE_BY_QUANT.get(quant, BASELINE_TOKS)
     tag = f"_{quant}" if quant else ""
+    # quant/batch/kv ride in the JSON so round-over-round comparisons
+    # can't conflate differently-configured runs (round-2 advisor).
     print(json.dumps({
         "metric": f"offline_throughput_{size}{tag}",
         "value": round(toks, 1),
         "unit": "out_tok/s",
         "vs_baseline": round(toks / baseline, 4),
+        "quant": quant, "batch": batch, "steps": steps,
+        "kv_dtype": kv_dtype, "baseline": baseline,
     }))
 
 

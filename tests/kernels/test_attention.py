@@ -252,3 +252,94 @@ def test_pallas_decode_alibi(kernel_name):
              scale=scale, pages_per_chunk=4, interpret=True)
     np.testing.assert_allclose(np.array(got), expected, rtol=2e-3,
                                atol=2e-3)
+
+
+@pytest.mark.parametrize("d_true", [64, 80, 96])
+def test_pallas_decode_padded_head(d_true):
+    """Head sizes below the 128-lane tile run with zero-padded pages
+    (ops/kv_cache.padded_head_size): pad lanes are inert in scores and
+    sliced off the output."""
+    from aphrodite_tpu.ops.pallas.paged_attention import (
+        paged_decode_attention, paged_decode_attention_allheads)
+    q, k_pages, v_pages, bt, ctx = make_problem(num_q_heads=8,
+                                                num_kv_heads=2,
+                                                dim=d_true, page_size=8,
+                                                pages_per_seq=8,
+                                                pages=32)
+    scale = 1.0 / np.sqrt(d_true)
+    expected = numpy_paged_attention(q, k_pages, v_pages, bt, ctx, scale)
+    dp = 128
+    qp = np.pad(q, ((0, 0), (0, 0), (0, dp - d_true)))
+    kp = np.pad(k_pages, ((0, 0), (0, 0), (0, 0), (0, dp - d_true)))
+    vp = np.pad(v_pages, ((0, 0), (0, 0), (0, 0), (0, dp - d_true)))
+    for fn in (paged_decode_attention, paged_decode_attention_allheads):
+        got = fn(jnp.array(qp), jnp.array(kp), jnp.array(vp),
+                 jnp.array(bt), jnp.array(ctx), scale=scale,
+                 pages_per_chunk=4, interpret=True)
+        np.testing.assert_allclose(np.array(got)[..., :d_true], expected,
+                                   rtol=2e-3, atol=2e-3)
+
+
+def test_paged_attention_layer_pads_small_heads():
+    """PagedAttention end-to-end with head 64: the layer pads writes,
+    q, and slices the output; cache pages carry the padded lane dim."""
+    from aphrodite_tpu.modeling.input_metadata import InputMetadata
+    from aphrodite_tpu.modeling.layers.attention import PagedAttention
+    from aphrodite_tpu.ops.kv_cache import padded_head_size
+    rng = np.random.default_rng(3)
+    B, H, Hkv, d = 2, 4, 2, 64
+    dp = padded_head_size(d)
+    assert dp == 128
+    page_size, num_pages = 8, 16
+    layer = PagedAttention(H, d, d ** -0.5, num_kv_heads=Hkv)
+    k_pages = jnp.zeros((Hkv, num_pages, page_size, dp), jnp.float32)
+    v_pages = jnp.zeros((Hkv, num_pages, page_size, dp), jnp.float32)
+
+    # Prefill 5 tokens, then decode 1: compare against the ref decode
+    # over an unpadded cache.
+    seq = 5
+    tables = np.array([[1, 2], [3, 4]], dtype=np.int32)
+    slots = np.array([[t * page_size + p for p in range(seq)]
+                      for t in (1, 3)], dtype=np.int32).reshape(-1)
+    meta = InputMetadata(
+        slot_mapping=jnp.asarray(slots),
+        block_tables=jnp.asarray(tables),
+        context_lens=jnp.zeros((B,), jnp.int32),
+        prompt_lens=jnp.full((B,), seq, jnp.int32),
+        is_prompt=True)
+    qkv = rng.normal(size=(3, B, seq)).astype(np.float32)
+    q = np.repeat(qkv[0][..., None], H * d, axis=-1) * 0.1
+    k = np.repeat(qkv[1][..., None], Hkv * d, axis=-1) * 0.1
+    v = np.repeat(qkv[2][..., None], Hkv * d, axis=-1) * 0.1
+    out, k_pages, v_pages = layer(jnp.asarray(q), jnp.asarray(k),
+                                  jnp.asarray(v), k_pages, v_pages, meta)
+    assert out.shape == (B, seq, H * d)
+    assert k_pages.shape[-1] == dp
+    # Written pages hold the true values in the first d lanes, zeros in
+    # the pad lanes.
+    kp_np = np.asarray(k_pages)
+    assert np.allclose(kp_np[..., d:], 0.0)
+    assert np.allclose(kp_np[0, 1, :seq, :d], k[0, :, :d], atol=1e-6)
+
+    # Decode step matches the unpadded jnp reference.
+    qd = rng.normal(size=(B, 1, H * d)).astype(np.float32) * 0.1
+    kd = rng.normal(size=(B, 1, Hkv * d)).astype(np.float32) * 0.1
+    vd = rng.normal(size=(B, 1, Hkv * d)).astype(np.float32) * 0.1
+    meta_d = InputMetadata(
+        slot_mapping=jnp.asarray(
+            np.array([1 * page_size + seq, 3 * page_size + seq],
+                     dtype=np.int32)),
+        block_tables=jnp.asarray(tables),
+        context_lens=jnp.full((B,), seq + 1, jnp.int32),
+        is_prompt=False)
+    out_d, k_pages, v_pages = layer(jnp.asarray(qd), jnp.asarray(kd),
+                                    jnp.asarray(vd), k_pages, v_pages,
+                                    meta_d)
+    assert out_d.shape == (B, 1, H * d)
+    ref = paged_decode_attention_ref(
+        jnp.asarray(qd.reshape(B, H, d)),
+        k_pages[..., :d], v_pages[..., :d],
+        jnp.asarray(tables), jnp.full((B,), seq + 1, jnp.int32),
+        d ** -0.5)
+    np.testing.assert_allclose(np.asarray(out_d).reshape(B, H, d),
+                               np.asarray(ref), rtol=1e-4, atol=1e-5)

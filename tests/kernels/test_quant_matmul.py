@@ -76,3 +76,73 @@ def test_apply_uses_fallback_on_cpu():
     y = np.asarray(method.apply(params, x))
     ref = np.asarray(x @ method.dequantize(params, jnp.float32))
     np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5)
+
+
+# --------------------------------------------------------------- AWQ --
+
+def make_awq_inputs(group_size, K, N, m, dtype=np.float32):
+    G = K // group_size
+    qweight = rs.randint(-2**31, 2**31, (K, N // 8), dtype=np.int32)
+    qzeros = rs.randint(-2**31, 2**31, (G, N // 8), dtype=np.int32)
+    scales = (rs.rand(G, N).astype(dtype) * 0.1 + 0.01)
+    x = rs.randn(m, K).astype(dtype)
+    params = {"qweight": jnp.asarray(qweight),
+              "qzeros": jnp.asarray(qzeros),
+              "scales": jnp.asarray(scales)}
+    return params, jnp.asarray(x)
+
+
+@pytest.mark.parametrize("group_size,K,N,m", [
+    (128, 256, 1024, 5),        # unpadded m
+    (128, 512, 2048, 64),       # block_n = 2048
+    (256, 512, 1024, 16),       # multi-row group
+    (128, 128, 3072, 8),        # n_tiles = 3 at block_n 1024
+])
+def test_awq_matches_xla_dequant(group_size, K, N, m):
+    from aphrodite_tpu.modeling.layers.quantization.awq import (
+        AWQConfig, AWQLinearMethod)
+    from aphrodite_tpu.ops.pallas.quant_matmul import awq_matmul
+    params, x = make_awq_inputs(group_size, K, N, m)
+    method = AWQLinearMethod(AWQConfig(4, group_size))
+    ref = np.asarray(x @ method.dequantize(params, jnp.float32))
+    got = np.asarray(awq_matmul(
+        x, params["qweight"], params["qzeros"], params["scales"],
+        group_size=group_size, interpret=True))
+    rel = np.abs(ref - got).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 2e-5, rel
+
+
+def test_awq_supported_gate():
+    from aphrodite_tpu.ops.pallas.quant_matmul import awq_supported
+    assert awq_supported(4096, 14336 * 2, 128)      # gate_up
+    assert awq_supported(14336, 4096, 128)          # down
+    assert awq_supported(4096, 6144, 128)           # qkv
+    assert not awq_supported(4000, 4096, 128)       # K % gs
+    assert not awq_supported(4096, 4096 + 512, 128)  # N % 1024
+    assert not awq_supported(4096, 4096, 64)        # group too small
+
+
+@pytest.mark.parametrize("K,N,m", [
+    (256, 512, 5),
+    (512, 1024, 64),
+])
+def test_int8_matmul_matches_xla(K, N, m):
+    from aphrodite_tpu.ops.pallas.quant_matmul import int8_matmul
+    w = rs.randint(-128, 128, (K, N), dtype=np.int8)
+    s = (rs.rand(N).astype(np.float32) * 0.01 + 1e-3)
+    x = rs.randn(m, K).astype(np.float32)
+    ref = (x @ w.astype(np.float32)) * s
+    got = np.asarray(int8_matmul(jnp.asarray(x), jnp.asarray(w),
+                                 jnp.asarray(s), interpret=True))
+    rel = np.abs(ref - got).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 2e-5, rel
+
+
+def test_awq_apply_fallback_on_cpu():
+    from aphrodite_tpu.modeling.layers.quantization.awq import (
+        AWQConfig, AWQLinearMethod)
+    params, x = make_awq_inputs(128, 256, 1024, 4)
+    method = AWQLinearMethod(AWQConfig(4, 128))
+    y = np.asarray(method.apply(params, x))
+    ref = np.asarray(x @ method.dequantize(params, jnp.float32))
+    np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-5)

@@ -85,6 +85,25 @@ def main() -> int:
         np.float32)
     check("allheads alibi", refa, gota)
 
+    # -- head 64/80: padded-lane decode (pages pad head_dim to 128) --
+    for d_true in (64, 80):
+        dp = 128
+        qs = jnp.asarray(rs.randn(B, Hq, d_true) * 0.1, jnp.bfloat16)
+        kps = jnp.asarray(rs.randn(Hkv, pages, page, d_true) * 0.1,
+                          jnp.bfloat16)
+        vps = jnp.asarray(rs.randn(Hkv, pages, page, d_true) * 0.1,
+                          jnp.bfloat16)
+        pad3 = ((0, 0), (0, 0), (0, dp - d_true))
+        pad4 = ((0, 0), (0, 0), (0, 0), (0, dp - d_true))
+        refs = oracle(qs, kps, vps, bt, ctx, scale)
+        for name, fn in (("v1", paged_decode_attention),
+                         ("allheads", paged_decode_attention_allheads)):
+            got = np.asarray(fn(
+                jnp.pad(qs, pad3), jnp.pad(kps, pad4),
+                jnp.pad(vps, pad4), bt, ctx, scale=scale,
+                pages_per_chunk=2), np.float32)[..., :d_true]
+            check(f"{name} head{d_true} padded", refs, got)
+
     # -- fused GPTQ dequant matmul --
     bits, gs, K, N, m = 4, 128, 4096, 14336, 256
     pack, G = 32 // bits, K // gs
@@ -105,6 +124,41 @@ def main() -> int:
     print(f"gptq_matmul int4: rel err {rel:.2e}")
     if rel > 3e-2:
         failures.append(("gptq", rel))
+
+    # -- fused AWQ dequant matmul --
+    from aphrodite_tpu.modeling.layers.quantization.awq import (
+        AWQConfig, AWQLinearMethod)
+    from aphrodite_tpu.ops.pallas.quant_matmul import (awq_matmul,
+                                                       int8_matmul)
+    K, N, m = 4096, 6144, 256
+    G = K // 128
+    qwa = jnp.asarray(rs.randint(-2**31, 2**31, (K, N // 8),
+                                 dtype=np.int32))
+    qza = jnp.asarray(rs.randint(-2**31, 2**31, (G, N // 8),
+                                 dtype=np.int32))
+    sca = jnp.asarray(rs.rand(G, N) * 0.01, jnp.bfloat16)
+    xa = jnp.asarray(rs.randn(m, K), jnp.bfloat16)
+    amethod = AWQLinearMethod(AWQConfig(4, 128))
+    aparams = {"qweight": qwa, "qzeros": qza, "scales": sca}
+    refa2 = np.asarray(xa @ amethod.dequantize(aparams, jnp.bfloat16),
+                       np.float32)
+    gota2 = np.asarray(awq_matmul(xa, qwa, qza, sca, group_size=128),
+                       np.float32)
+    rel = np.abs(refa2 - gota2).max() / (np.abs(refa2).max() + 1e-9)
+    print(f"awq_matmul int4: rel err {rel:.2e}")
+    if rel > 3e-2:
+        failures.append(("awq", rel))
+
+    # -- int8 dense matmul --
+    w8 = jnp.asarray(rs.randint(-128, 128, (K, N), dtype=np.int8))
+    s8 = jnp.asarray(rs.rand(N) * 0.01 + 1e-3, jnp.float32)
+    refi = np.asarray((xa.astype(jnp.float32) @ w8.astype(jnp.float32))
+                      * s8, np.float32)
+    goti = np.asarray(int8_matmul(xa, w8, s8), np.float32)
+    rel = np.abs(refi - goti).max() / (np.abs(refi).max() + 1e-9)
+    print(f"int8_matmul: rel err {rel:.2e}")
+    if rel > 3e-2:
+        failures.append(("int8", rel))
 
     if failures:
         print("FAILURES:", failures)

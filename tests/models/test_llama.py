@@ -36,7 +36,9 @@ NUM_PAGES = 32
 
 def make_caches(model, dtype=jnp.float32):
     cfg = model.config
-    head_dim = cfg.hidden_size // cfg.num_attention_heads
+    from aphrodite_tpu.ops.kv_cache import padded_head_size
+    head_dim = padded_head_size(
+        cfg.hidden_size // cfg.num_attention_heads)
     return [
         (jnp.zeros((cfg.num_key_value_heads, NUM_PAGES, PAGE_SIZE,
                     head_dim), dtype=dtype),

@@ -106,6 +106,82 @@ def test_quip_linear_method_forward():
     np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
 
 
+def test_get_hadK_decomposition():
+    from aphrodite_tpu.modeling.layers.quantization.quip import get_hadK
+    had, k, q = get_hadK(64)
+    assert had is None and k == 1 and q == 64
+    had, k, q = get_hadK(96)            # 2^5 * 3
+    assert had.shape == (3, 3) and k == 3 and q == 96
+    # special-orthogonal factor
+    np.testing.assert_allclose(had @ had.T, np.eye(3), atol=1e-5)
+    # deterministic across calls (seeded on the base)
+    had2, _, _ = get_hadK(96)
+    np.testing.assert_allclose(had, had2, atol=0)
+    # use_rand=False pads to the next power of two (K=1)
+    had, k, q = get_hadK(96, use_rand=False)
+    assert had is None and k == 1 and q == 128
+
+
+@pytest.mark.parametrize("n,k", [(96, 3), (80, 5)])
+def test_matmul_hadU_factored_orthogonal(n, k):
+    """hadU(hadUt(x)) == x for the K>1 factored transform."""
+    from aphrodite_tpu.modeling.layers.quantization.quip import get_hadK
+    had, kk, q = get_hadK(n)
+    assert kk == k and q == n
+    x = rs.randn(4, n).astype(np.float32)
+    mid = matmul_hadU(jnp.asarray(x), jnp.asarray(had), k, n,
+                      transpose=True)
+    back = matmul_hadU(mid, jnp.asarray(had), k, n)
+    np.testing.assert_allclose(np.asarray(back), x, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_quip_linear_method_forward_non_pow2():
+    """apply() with non-power-of-two dims uses the factored transform
+    and matches an explicit (had_K kron H_{n/K}) matrix pipeline."""
+    from aphrodite_tpu.modeling.layers.quantization.quip import get_hadK
+    in_f, out_f = 96, 80
+    method = QuipLinearMethod(QuipConfig())
+    params = method.create_weights(in_f, out_f, jnp.float32, bias=False,
+                                   out_axis=None, in_axis=None)
+    assert params["weight"].shape == (96, 80)
+    assert params["had_left"].shape == (3, 3)
+    assert params["had_right"].shape == (5, 5)
+    qidxs = rs.randint(-2**15, 2**15, size=(out_f, in_f // 8),
+                       dtype=np.int16)
+    params["weight"] = jnp.asarray(quip_weight_from_qidxs(qidxs))
+    params["SU"] = jnp.asarray(
+        rs.choice([-1.0, 1.0], in_f).astype(np.float32))
+    params["SV"] = jnp.asarray(
+        rs.choice([-1.0, 1.0], out_f).astype(np.float32))
+    params["Wscale"] = jnp.asarray(0.7, dtype=jnp.float32)
+
+    x = rs.randn(5, in_f).astype(np.float32)
+    got = np.asarray(method.apply(params, jnp.asarray(x)))
+
+    def full_transform(n, k, had):
+        h = scipy.linalg.hadamard(n // k) / math.sqrt(n // k)
+        return np.kron(np.asarray(had), h)        # [n, n]
+
+    had_l, _, _ = get_hadK(in_f)
+    had_r, _, _ = get_hadK(out_f)
+    # matmul_hadU(x, had, K, n, transpose=True) is x @ kron(had, H),
+    # and without transpose x @ kron(had.T, H) — hence Tl vs Tr.T.
+    Tl = full_transform(in_f, 3, had_l)
+    Tr = full_transform(out_f, 5, had_r)
+    W = decompress_e8p(qidxs)                     # [out, in]
+    xs = (x * params["SU"]) @ Tl * 0.7
+    ref = (xs @ W.T) @ Tr.T * np.asarray(params["SV"])
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_quip_rejects_non_pow2_without_rand():
+    method = QuipLinearMethod(QuipConfig(use_rand=False))
+    with pytest.raises(ValueError, match="power-of-two"):
+        method.create_weights(96, 80, jnp.float32, bias=False,
+                              out_axis=None, in_axis=None)
+
+
 def test_quip_registered():
     from aphrodite_tpu.modeling.layers.quantization import (
         get_quantization_config_cls)

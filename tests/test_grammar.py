@@ -78,6 +78,59 @@ A: "a"
     assert s is not None and m.can_end(s)
 
 
+def test_can_end_splits_deferred_partial():
+    """A pending partial deferred on an extendable terminal may already
+    be a complete parse as a SPLIT of other terminals (round-2 advisor:
+    the single-commit check masked EOS in that case)."""
+    g = r"""
+start: AB | A B
+AB: "ab!"
+A: "a"
+B: "b"
+"""
+    m = GrammarMatcher(g)
+    s = m.advance(m.root, "ab")       # deferred on AB ("ab!" may grow)
+    assert s is not None
+    assert s[1] == "ab"               # still a pending partial
+    assert m.can_end(s)               # "ab" = A B is a full parse
+    s2 = m.advance(s, "!")            # completing AB still works
+    assert s2 is not None and m.can_end(s2)
+
+
+def test_can_end_deep_single_char_split():
+    """A split into N single-char terminals needs recursion depth N —
+    the cycle bound must key off the initial partial length, not the
+    shrinking remainder (code-review r3 finding)."""
+    g = r"""
+start: A+ | LONG
+A: "a"
+LONG: "aaaaaaaaaaaa!"
+"""
+    m = GrammarMatcher(g)
+    for n in (2, 7, 11):
+        s = m.advance(m.root, "a" * n)    # deferred on LONG
+        assert s is not None
+        assert m.can_end(s), n            # n A's is a full parse
+
+
+def test_deferred_partial_recovers_commit_path():
+    """Text consumed past a deferral is re-evaluated from the retained
+    candidate, so continuations that require committing a SHORTER
+    terminal are not lost."""
+    g = r"""
+start: ABC | A BD
+ABC: "abc"
+A: "a"
+BD: "bd"
+"""
+    m = GrammarMatcher(g)
+    s = m.advance(m.root, "ab")       # deferred on ABC
+    assert s is not None
+    assert m.advance(s, "c") is not None      # complete ABC
+    s2 = m.advance(s, "d")                     # requires A + BD split
+    assert s2 is not None and m.can_end(s2)
+
+
 class FakeTokenizer:
     """Char/string-level tokenizer with an HF-ish surface."""
 
