@@ -269,14 +269,24 @@ class ParallelConfig:
         worker_use_ray: bool = False,  # accepted for CLI parity; unused
         max_parallel_loading_workers: Optional[int] = None,
         disable_custom_all_reduce: bool = False,
+        sequence_parallel_size: int = 1,
+        sp_prefill_threshold: int = 1024,
     ) -> None:
         self.pipeline_parallel_size = pipeline_parallel_size
         self.tensor_parallel_size = tensor_parallel_size
         self.data_parallel_size = data_parallel_size
         self.max_parallel_loading_workers = max_parallel_loading_workers
         self.disable_custom_all_reduce = disable_custom_all_reduce
+        # Sequence/context parallelism: prompts whose (padded) length is
+        # >= sp_prefill_threshold run prefill attention as a ring over
+        # the sp mesh axis (ops/ring_attention.py) — K/V shards rotate
+        # via ppermute on ICI, peak per-chip activation memory is
+        # O(seq/sp). Beyond the reference's capabilities (it has no
+        # SP/CP at all, SURVEY.md §2.3); decode stays on tp.
+        self.sequence_parallel_size = sequence_parallel_size
+        self.sp_prefill_threshold = sp_prefill_threshold
         self.world_size = (pipeline_parallel_size * tensor_parallel_size *
-                           data_parallel_size)
+                           data_parallel_size * sequence_parallel_size)
         self._verify_args()
 
     def _verify_args(self) -> None:
@@ -284,6 +294,7 @@ class ParallelConfig:
             ("pipeline_parallel_size", self.pipeline_parallel_size),
             ("tensor_parallel_size", self.tensor_parallel_size),
             ("data_parallel_size", self.data_parallel_size),
+            ("sequence_parallel_size", self.sequence_parallel_size),
         ):
             if value < 1:
                 raise ValueError(f"{name} must be >= 1, got {value}.")

@@ -38,6 +38,8 @@ class EngineArgs:
     pipeline_parallel_size: int = 1
     tensor_parallel_size: int = 1
     data_parallel_size: int = 1
+    sequence_parallel_size: int = 1
+    sp_prefill_threshold: int = 1024
     max_parallel_loading_workers: Optional[int] = None
     block_size: int = 16
     swap_space: float = 4          # GiB
@@ -94,6 +96,14 @@ class EngineArgs:
                             default=1)
         parser.add_argument("--data-parallel-size", "-dp", type=int,
                             default=1)
+        parser.add_argument("--sequence-parallel-size", "-sp", type=int,
+                            default=1,
+                            help="ring-attention mesh axis for long "
+                                 "prompt prefill")
+        parser.add_argument("--sp-prefill-threshold", type=int,
+                            default=1024,
+                            help="route prefill through ring attention "
+                                 "at/above this padded prompt length")
         parser.add_argument("--max-parallel-loading-workers", type=int,
                             default=None)
         parser.add_argument("--block-size", type=int, default=16,
@@ -151,7 +161,9 @@ class EngineArgs:
             self.pipeline_parallel_size, self.tensor_parallel_size,
             self.data_parallel_size, self.worker_use_ray,
             self.max_parallel_loading_workers,
-            self.disable_custom_all_reduce)
+            self.disable_custom_all_reduce,
+            sequence_parallel_size=self.sequence_parallel_size,
+            sp_prefill_threshold=self.sp_prefill_threshold)
         scheduler_config = SchedulerConfig(
             self.max_num_batched_tokens, self.max_num_seqs,
             model_config.max_model_len, self.max_paddings,

@@ -5,16 +5,19 @@ on side stream `:118-134`, copy `:136-146`) and the CUDA cache kernels
 (`kernels/cache_kernels.cu`).
 
 TPU-native: per layer the cache is (k_pages, v_pages) arrays of shape
-[num_kv_heads, num_pages, page_size, head_dim] (see ops/kv_cache.py for
-the layout rationale). Swap space is pinned host numpy; swap_in/out are
-`jax.device_put`/`device_get` of whole pages — JAX dispatches these
-asynchronously, which replaces the reference's dedicated CUDA stream +
-event machinery. Copy-on-write page copies run as one fused gather/
-scatter inside the jitted step (ops.kv_cache.copy_blocks).
+[num_pages, page_size, num_kv_heads * head_dim] — token-major, heads
+collapsed into lanes (see ops/kv_cache.py for the layout rationale).
+Swap space is pinned host numpy; swap_in/out are `jax.device_put`/
+`device_get` of whole pages — JAX dispatches these asynchronously, which
+replaces the reference's dedicated CUDA stream + event machinery.
+Copy-on-write page copies run as one fused gather/scatter inside the
+jitted step (ops.kv_cache.copy_blocks).
 
-Under a mesh, pages are sharded over the tp axis on the kv-head dim —
-each chip holds its heads' pages, the direct analog of the reference's
-per-worker cache (`cache_engine.py:48`, num_heads divided by TP).
+Under a mesh, pages shard over the tp axis on the LANE dim — head
+blocks are contiguous lane ranges, so a lane partition IS a head
+partition: each chip holds its heads' pages, the direct analog of the
+reference's per-worker cache (`cache_engine.py:48`, heads divided by
+TP).
 """
 from __future__ import annotations
 
@@ -89,7 +92,8 @@ class CacheEngine:
                 "APHRODITE_KV_SCALE", str(DEFAULT_KV_SCALE)))
 
         self.kv_caches: List[KVCache] = self._allocate_device()
-        # Host swap pool: per layer [2, heads_i, pages, page, dim] numpy
+        # Host swap pool: per layer [2, pages, page, heads_i*dim] numpy
+        # — token-major like the device pages, indexed by page on axis 1
         # (list because DeciLM-style models vary heads per layer).
         # Stored in the CACHE dtype (f32 would double/quadruple host RAM).
         # np.zeros at init reserves only virtual memory — physical pages
@@ -102,8 +106,9 @@ class CacheEngine:
     def _ensure_host_pool(self) -> None:
         if self._host_pool is None:
             self._host_pool = [
-                np.zeros((2, heads, self.num_host_pages, self.page_size,
-                          self.head_size), dtype=np.dtype(self.dtype))
+                np.zeros((2, self.num_host_pages, self.page_size,
+                          heads * self.head_size),
+                         dtype=np.dtype(self.dtype))
                 for heads in self.kv_heads_per_layer
             ]
 
@@ -111,18 +116,20 @@ class CacheEngine:
 
     def _allocate_device(self) -> List[KVCache]:
         def alloc(num_heads: int):
-            shape = (num_heads, self.num_device_pages, self.page_size,
-                     self.head_size)
+            shape = (self.num_device_pages, self.page_size,
+                     num_heads * self.head_size)
             z = jnp.zeros(shape, dtype=self.dtype)
             if self.mesh is not None:
                 tp = self.mesh.shape["tp"]
                 if num_heads % tp == 0:
-                    spec = P("tp", None, None, None)
+                    # Lane partition == head partition (heads are
+                    # contiguous lane blocks).
+                    spec = P(None, None, "tp")
                 else:
                     # Fewer KV heads than chips: replicate the pages,
                     # exactly as the reference replicates KV heads when
                     # heads < tp (common/config.py:265-273).
-                    spec = P(None, None, None, None)
+                    spec = P(None, None, None)
                 z = jax.device_put(z, NamedSharding(self.mesh, spec))
             return z
 
@@ -145,10 +152,10 @@ class CacheEngine:
         for layer, (k_pages, v_pages) in enumerate(self.kv_caches):
             # One bulk gather per side, then a single host transfer in
             # the page dtype (no f32 inflation).
-            k_host = np.asarray(jnp.take(k_pages, src, axis=1))
-            v_host = np.asarray(jnp.take(v_pages, src, axis=1))
-            self._host_pool[layer][0][:, dst] = k_host
-            self._host_pool[layer][1][:, dst] = v_host
+            k_host = np.asarray(jnp.take(k_pages, src, axis=0))
+            v_host = np.asarray(jnp.take(v_pages, src, axis=0))
+            self._host_pool[layer][0][dst] = k_host
+            self._host_pool[layer][1][dst] = v_host
 
     def swap_in(self, mapping: Dict[int, int]) -> None:
         """Host pool -> device pages (reference swap_in :136)."""
@@ -159,12 +166,12 @@ class CacheEngine:
         dst = np.fromiter(mapping.values(), dtype=np.int64)
         new_caches: List[KVCache] = []
         for layer, (k_pages, v_pages) in enumerate(self.kv_caches):
-            k_in = jnp.asarray(self._host_pool[layer][0][:, src],
+            k_in = jnp.asarray(self._host_pool[layer][0][src],
                                dtype=self.dtype)
-            v_in = jnp.asarray(self._host_pool[layer][1][:, src],
+            v_in = jnp.asarray(self._host_pool[layer][1][src],
                                dtype=self.dtype)
-            k_pages = k_pages.at[:, dst].set(k_in)
-            v_pages = v_pages.at[:, dst].set(v_in)
+            k_pages = k_pages.at[dst].set(k_in)
+            v_pages = v_pages.at[dst].set(v_in)
             new_caches.append((k_pages, v_pages))
         self.kv_caches = new_caches
 

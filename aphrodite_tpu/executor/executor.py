@@ -38,7 +38,10 @@ _FALLBACK_CACHE_BYTES = 256 << 20
 
 def build_mesh(parallel_config: ParallelConfig,
                device_config: DeviceConfig):
-    """Construct the (dp, pp, tp) mesh, or None for a single device."""
+    """Construct the (dp, pp, sp, tp) mesh, or None for one device.
+
+    sp (sequence parallel) sits next to tp on the fast axis ordering so
+    ring-attention ppermute hops ride ICI neighbours."""
     if parallel_config.world_size == 1:
         return None
     from jax.sharding import Mesh
@@ -49,10 +52,11 @@ def build_mesh(parallel_config: ParallelConfig,
             f"devices ({len(devices)}).")
     shape = (parallel_config.data_parallel_size,
              parallel_config.pipeline_parallel_size,
+             parallel_config.sequence_parallel_size,
              parallel_config.tensor_parallel_size)
     mesh_devices = np.asarray(
         devices[:parallel_config.world_size]).reshape(shape)
-    return Mesh(mesh_devices, ("dp", "pp", "tp"))
+    return Mesh(mesh_devices, ("dp", "pp", "sp", "tp"))
 
 
 class TPUExecutor:
@@ -81,12 +85,17 @@ class TPUExecutor:
         self._profile_and_size_cache()
         self.cache_engine = CacheEngine(cache_config, model_config,
                                         parallel_config, self.mesh)
+        sp = None
+        if self.mesh is not None and \
+                parallel_config.sequence_parallel_size > 1:
+            sp = (self.mesh, parallel_config.sp_prefill_threshold)
         self.model_runner = ModelRunner(
             self.model, self.params, model_config, scheduler_config,
             page_size=cache_config.block_size,
             num_slots=self.cache_engine.num_slots,
             mesh=self.mesh,
-            kv_scale=self.cache_engine.kv_scale)
+            kv_scale=self.cache_engine.kv_scale,
+            sp=sp)
 
         self.lora_manager = None
         if lora_config is not None:

@@ -74,6 +74,7 @@ class ModelRunner:
         num_slots: int,
         mesh=None,
         kv_scale: float = 1.0,
+        sp: Optional[tuple] = None,         # (Mesh, threshold) or None
     ) -> None:
         self.model = model
         self.params = params
@@ -83,6 +84,7 @@ class ModelRunner:
         self.num_slots = num_slots          # OOB pad value for slots
         self.mesh = mesh
         self.kv_scale = kv_scale            # int8 KV dequant scale
+        self.sp = sp                        # ring-prefill routing
         self.sampler = Sampler(model_config.get_vocab_size())
 
         # LoRA: bucket keys carrying slot-stacked adapter tensors, and a
@@ -307,6 +309,7 @@ class ModelRunner:
             context_lens=jnp.asarray(ctx_lens),
             prompt_lens=jnp.asarray(plens),
             kv_scale=self.kv_scale,
+            sp=self.sp,
         )
         prompt_offsets = [int(c) for c in ctx_lens[:batch]]
         sampling = SamplingMetadata(

@@ -41,3 +41,9 @@ class InputMetadata:
     # caches. Static so every jit / Pallas compile cache keys on it —
     # the scale is a trace-time constant folded into kernel epilogues.
     kv_scale: float = struct.field(pytree_node=False, default=1.0)
+    # Sequence-parallel prefill routing: (Mesh, threshold_tokens) when
+    # the engine runs with --sequence-parallel-size > 1, else None.
+    # Static (Mesh is hashable): prompts at/above the threshold shard
+    # their prefill attention over the mesh's "sp" axis via ring
+    # attention (ops/ring_attention.py).
+    sp: object = struct.field(pytree_node=False, default=None)

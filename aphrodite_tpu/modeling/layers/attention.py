@@ -87,12 +87,24 @@ class PagedAttention:
                 flat_v = jnp.pad(flat_v, pad)
             k_pages, v_pages = write_to_kv_cache(
                 flat_k, flat_v, k_pages, v_pages, metadata.slot_mapping,
-                kv_scale=metadata.kv_scale)
-            # Keep the scatter un-fused from its readers: fusing the
-            # in-place page update into the attention gather forces XLA to
-            # materialize a full temp copy of the cache (multi-GB/step).
-            k_pages, v_pages = jax.lax.optimization_barrier(
-                (k_pages, v_pages))
+                kv_scale=metadata.kv_scale,
+                # Decode: one token per sequence, pages are
+                # sequence-exclusive -> the pipelined page writer is safe.
+                distinct_pages=not metadata.is_prompt)
+            from aphrodite_tpu.ops.pallas.kv_write import (
+                can_use_pallas_writer)
+            if not (jax.default_backend() == "tpu" and
+                    can_use_pallas_writer(k_pages.dtype,
+                                          k_pages.shape[1],
+                                          k_pages.shape[2])):
+                # XLA-scatter path only: keep the scatter un-fused from
+                # its readers — fusing the in-place page update into the
+                # attention gather forces XLA to materialize a full temp
+                # copy of the cache (multi-GB/step). The Pallas writer
+                # needs no barrier: input_output_aliases pins its
+                # in-place semantics regardless of fusion decisions.
+                k_pages, v_pages = jax.lax.optimization_barrier(
+                    (k_pages, v_pages))
 
         if metadata.is_prompt:
             out = self._prefill(q, k, v, k_pages, v_pages, metadata)
@@ -114,8 +126,10 @@ class PagedAttention:
             # (reference prefix path, triton context_attention_fwd).
             from aphrodite_tpu.ops.kv_quant import dequant_scale
             kv_s = dequant_scale(k_pages.dtype, metadata.kv_scale)
-            kv_k = gather_pages(k_pages, metadata.block_tables)
-            kv_v = gather_pages(v_pages, metadata.block_tables)
+            kv_k = gather_pages(k_pages, metadata.block_tables,
+                                self.num_kv_heads)
+            kv_v = gather_pages(v_pages, metadata.block_tables,
+                                self.num_kv_heads)
             if self.padded_head != self.head_size:
                 kv_k = kv_k[..., :self.head_size]
                 kv_v = kv_v[..., :self.head_size]
@@ -131,11 +145,42 @@ class PagedAttention:
             kv_k, kv_v = k, v
             context_lens = jnp.zeros((batch,), dtype=jnp.int32)
             kv_valid = prompt_lens
+            if self._ring_eligible(metadata, seq_len):
+                return self._ring_prefill(q, k, v, metadata)
 
         return prefill_attention(
             q, kv_k, kv_v, context_lens, kv_valid, self.scale,
             sliding_window=self.sliding_window,
             alibi_slopes=self.alibi_slopes)
+
+    def _ring_eligible(self, metadata: InputMetadata,
+                       seq_len: int) -> bool:
+        """Static (trace-time) routing decision for sequence-parallel
+        prefill: plain causal prefill at/above the threshold, padded
+        length divisible by the sp axis. ALiBi and windows narrower
+        than the prompt keep the dense path (the ring kernel implements
+        plain causality only)."""
+        if metadata.sp is None or self.alibi_slopes is not None:
+            return False
+        mesh, threshold = metadata.sp
+        sp_size = mesh.shape.get("sp", 1)
+        if sp_size <= 1 or seq_len < threshold or seq_len % sp_size:
+            return False
+        if self.sliding_window is not None and \
+                seq_len > self.sliding_window:
+            return False
+        return True
+
+    def _ring_prefill(self, q, k, v, metadata: InputMetadata):
+        """Prefill attention sharded over the sp mesh axis: K/V shards
+        rotate via ppermute while each device accumulates its queries'
+        online softmax (ops/ring_attention.py). Right-pad tokens only
+        pollute pad q rows (causal mask), which downstream never reads
+        — same contract as the dense path. GQA K/V rotate at Hkv heads
+        (the group broadcast happens inside the score einsum)."""
+        from aphrodite_tpu.ops.ring_attention import make_ring_fn
+        mesh, _ = metadata.sp
+        return make_ring_fn(mesh, self.scale)(q, k, v)
 
     def _decode(self, q, k_pages, v_pages,
                 metadata: InputMetadata) -> jax.Array:
@@ -153,24 +198,24 @@ class PagedAttention:
         from aphrodite_tpu.ops.kv_quant import dequant_scale
         quant_ok = k_pages.dtype in (jnp.bfloat16, jnp.float32) or (
             k_pages.dtype in (jnp.int8, jnp.float8_e5m2) and
-            k_pages.shape[2] % 32 == 0)     # 8-bit sublane tile
+            k_pages.shape[1] % 32 == 0)     # 8-bit sublane tile
         if self.use_pallas and jax.default_backend() == "tpu" and \
                 quant_ok:
             from aphrodite_tpu.ops.pallas.paged_attention import (
-                paged_decode_attention, paged_decode_attention_allheads)
+                paged_decode_attention)
             slopes = None if self.alibi_slopes is None else \
                 jnp.asarray(self.alibi_slopes, dtype=jnp.float32)
             # Padded table entries hold an out-of-range page id (the XLA
             # gather's fill convention); the kernel DMAs pages raw, so
             # clamp pads to a valid page — masked off by context_lens.
             tables = jnp.minimum(metadata.block_tables,
-                                 k_pages.shape[1] - 1)
+                                 k_pages.shape[0] - 1)
             # Bigger chunks amortize the per-chunk loop/DMA overhead for
             # long contexts; largest power-of-two <= 32 dividing the
             # (bucketed) table width, >= 512 tokens per chunk when the
             # context allows.
             pps = tables.shape[1]
-            page_size = k_pages.shape[2]
+            page_size = k_pages.shape[1]
             batch = q3.shape[0]
             ppc = 8
             # Bigger chunks only for SMALL batches: the table width is
@@ -184,28 +229,12 @@ class PagedAttention:
                     ppc *= 2
             if pps % ppc != 0:
                 ppc = 1
-            # All-heads-per-cell variant wins for GQA at LARGE batch and
-            # short-to-medium context (it amortizes per-cell instruction
-            # overhead but its masked cross-head score tile wastes
-            # H x the VPU work, which scales with context). Few long
-            # sequences keep the per-(seq, head) kernel.
-            if self.num_kv_heads <= 8 and \
-                    self.num_heads >= 2 * self.num_kv_heads and \
-                    self.num_heads <= 64 and batch >= 32 and \
-                    pps * page_size <= 2048:
-                out = paged_decode_attention_allheads(
-                    q3, k_pages, v_pages, tables,
-                    metadata.context_lens, slopes, scale=self.scale,
-                    kv_scale=dequant_scale(k_pages.dtype,
-                                           metadata.kv_scale),
-                    pages_per_chunk=ppc)
-            else:
-                out = paged_decode_attention(
-                    q3, k_pages, v_pages, tables,
-                    metadata.context_lens, slopes, scale=self.scale,
-                    kv_scale=dequant_scale(k_pages.dtype,
-                                           metadata.kv_scale),
-                    pages_per_chunk=ppc)
+            out = paged_decode_attention(
+                q3, k_pages, v_pages, tables,
+                metadata.context_lens, slopes, scale=self.scale,
+                kv_scale=dequant_scale(k_pages.dtype,
+                                       metadata.kv_scale),
+                pages_per_chunk=ppc)
         else:
             out = paged_decode_attention_ref(
                 q3, k_pages, v_pages, metadata.block_tables,

@@ -71,6 +71,8 @@ def get_model(model_config: ModelConfig,
 
     model = model_cls(model_config.hf_config, dtype=dtype,
                       linear_method=linear_method)
+    if mesh is not None and mesh.shape.get("tp", 1) > 1:
+        _mark_moe_sharded(model)
 
     if model_config.load_format == "dummy":
         params = initialize_dummy_params(model, seed=model_config.seed)
@@ -88,6 +90,36 @@ def get_model(model_config: ModelConfig,
         _add_empty_lora_params(model, params_np)
     params = shard_params(params_np, model.param_specs(), mesh, dtype)
     return model, params
+
+
+def _mark_moe_sharded(model) -> None:
+    """Flag every FusedMoE layer that its expert axis is mesh-partitioned
+    (selects the dense GSPMD combine over the single-chip ragged-dot
+    dispatch — see layers/fused_moe.py)."""
+    from aphrodite_tpu.modeling.layers.fused_moe import FusedMoE
+    seen = set()
+
+    def walk(obj, depth=0):
+        if id(obj) in seen or depth > 12:
+            return
+        seen.add(id(obj))
+        if isinstance(obj, FusedMoE):
+            obj.sharded = True
+            return
+        if isinstance(obj, dict):
+            for it in obj.values():
+                walk(it, depth + 1)
+            return
+        if isinstance(obj, (list, tuple)):
+            for it in obj:
+                walk(it, depth + 1)
+            return
+        d = getattr(obj, "__dict__", None)
+        if d:
+            for it in d.values():
+                walk(it, depth + 1)
+
+    walk(model)
 
 
 def _add_empty_lora_params(model, params_np) -> None:

@@ -102,7 +102,7 @@ def prefill_attention(
 
 def paged_decode_attention_ref(
     q: jax.Array,              # [batch, num_q_heads, head_dim]
-    k_pages: jax.Array,        # [num_kv_heads, num_pages, page_size, dim]
+    k_pages: jax.Array,        # [num_pages, page_size, Hkv * head_dim]
     v_pages: jax.Array,
     block_tables: jax.Array,   # [batch, pages_per_seq] int32 (OOB padded)
     context_lens: jax.Array,   # [batch]
@@ -119,12 +119,12 @@ def paged_decode_attention_ref(
     from aphrodite_tpu.ops.kv_cache import gather_pages
     from aphrodite_tpu.ops.kv_quant import dequant_scale
     b, num_q_heads, d = q.shape
-    num_kv_heads = k_pages.shape[0]
+    num_kv_heads = k_pages.shape[2] // d
     group = num_q_heads // num_kv_heads
     kv_s = dequant_scale(k_pages.dtype, kv_scale)  # int8 stores value/S
 
-    k = gather_pages(k_pages, block_tables)  # [b, Hkv, ctx, d]
-    v = gather_pages(v_pages, block_tables)
+    k = gather_pages(k_pages, block_tables, num_kv_heads)  # [b,Hkv,ctx,d]
+    v = gather_pages(v_pages, block_tables, num_kv_heads)
     ctx = k.shape[2]
 
     qg = q.reshape(b, num_kv_heads, group, d)

@@ -2,14 +2,22 @@
 
 TPU-native equivalents of the reference CUDA cache kernels
 (`kernels/cache_kernels.cu:14,88,221` — swap_blocks/copy_blocks/
-reshape_and_cache). Layout choice: per layer the cache is a pair of page
-arrays
+reshape_and_cache). Layout choice (round-3 "token-major"): per layer
+the cache is a pair of page arrays
 
-    k_pages, v_pages: [num_kv_heads, num_pages, page_size, head_dim]
+    k_pages, v_pages: [num_pages, page_size, num_kv_heads * head_dim]
 
-so that (page_size, head_dim) tiles DMA contiguously into VMEM, the
-kv-head axis shards cleanly over the TP mesh axis, and one page is one
-natural unit for the Pallas decode kernel's scalar-prefetched gather.
+i.e. heads COLLAPSED INTO LANES. Rationale, from the PROFILE_r03
+attribution: the previous head-major [H, pages, page, d] layout forced
+the decode kernel into pages_per_chunk x H x 2 separate 4 KB DMAs per
+sequence (210 GB/s effective KV bandwidth) and the page writer into
+per-head read-modify-writes. Token-major makes one page a contiguous
+[page_size, H*d] slab (one DMA descriptor, 32 KB-class), keeps any
+aligned head sub-block an aligned LANE slice (hb*d is always a
+multiple of 128), has no Mosaic tile padding for any head count (even
+one local head under tp=heads sharding, where a 4-D [P, page, 1, d]
+array would pad its sublane dim 8x), and shards over TP as a plain
+lane-dimension partition (contiguous head blocks).
 (The reference's [blocks, heads, head/x, block, x] layout is a CUDA
 coalescing trick with no TPU analog.)
 
@@ -45,10 +53,11 @@ def padded_head_size(head_size: int) -> int:
 def write_to_kv_cache(
     key: jax.Array,        # [num_tokens, num_kv_heads, head_dim]
     value: jax.Array,      # [num_tokens, num_kv_heads, head_dim]
-    k_pages: jax.Array,    # [num_kv_heads, num_pages, page_size, head_dim]
-    v_pages: jax.Array,    # [num_kv_heads, num_pages, page_size, head_dim]
+    k_pages: jax.Array,    # [num_pages, page_size, H * head_dim]
+    v_pages: jax.Array,
     slot_mapping: jax.Array,  # [num_tokens] int32; pad with num_slots (OOB)
     kv_scale: float = 1.0,    # int8 quantization scale (trace-time const)
+    distinct_pages: bool = False,  # decode batches: 1 token/page
 ) -> Tuple[jax.Array, jax.Array]:
     """Scatter freshly computed K/V for each token into its cache slot.
 
@@ -56,7 +65,10 @@ def write_to_kv_cache(
     slot = page_index * page_size + page_offset; padded entries must be
     >= num_pages*page_size so mode='drop' discards them.
     """
-    num_kv_heads, num_pages, page_size, head_dim = k_pages.shape
+    num_pages, page_size, hd = k_pages.shape
+    num_tokens = key.shape[0]
+    key = key.reshape(num_tokens, hd)       # heads -> lanes
+    value = value.reshape(num_tokens, hd)
 
     # TPU: Pallas kernel with input_output_aliases — guaranteed in-place
     # HBM update. The XLA scatter below is semantically identical but XLA
@@ -66,20 +78,20 @@ def write_to_kv_cache(
     if jax.default_backend() == "tpu":
         from aphrodite_tpu.ops.pallas.kv_write import (
             can_use_pallas_writer, write_kv_pages)
-        if can_use_pallas_writer(k_pages.dtype, page_size, head_dim):
+        if can_use_pallas_writer(k_pages.dtype, page_size, hd):
             return write_kv_pages(key, value, k_pages, v_pages,
-                                  slot_mapping)
+                                  slot_mapping,
+                                  distinct_pages=distinct_pages)
 
-    k_flat = k_pages.reshape(num_kv_heads, num_pages * page_size, head_dim)
-    v_flat = v_pages.reshape(num_kv_heads, num_pages * page_size, head_dim)
+    k_flat = k_pages.reshape(num_pages * page_size, hd)
+    v_flat = v_pages.reshape(num_pages * page_size, hd)
 
     from aphrodite_tpu.ops.kv_quant import quantize_kv
-    # [num_tokens, heads, dim] -> [heads, num_tokens, dim]
-    key_ht = quantize_kv(key, k_pages.dtype, kv_scale).swapaxes(0, 1)
-    value_ht = quantize_kv(value, v_pages.dtype, kv_scale).swapaxes(0, 1)
+    key_q = quantize_kv(key, k_pages.dtype, kv_scale)
+    value_q = quantize_kv(value, v_pages.dtype, kv_scale)
 
-    k_flat = k_flat.at[:, slot_mapping, :].set(key_ht, mode="drop")
-    v_flat = v_flat.at[:, slot_mapping, :].set(value_ht, mode="drop")
+    k_flat = k_flat.at[slot_mapping, :].set(key_q, mode="drop")
+    v_flat = v_flat.at[slot_mapping, :].set(value_q, mode="drop")
     return (k_flat.reshape(k_pages.shape), v_flat.reshape(v_pages.shape))
 
 
@@ -95,28 +107,28 @@ def copy_blocks(
     as one gather + one scatter per cache side instead of a kernel launch
     per pair.
     """
-    src_k = jnp.take(k_pages, src_indices, axis=1, mode="fill",
+    src_k = jnp.take(k_pages, src_indices, axis=0, mode="fill",
                      fill_value=0)
-    src_v = jnp.take(v_pages, src_indices, axis=1, mode="fill",
+    src_v = jnp.take(v_pages, src_indices, axis=0, mode="fill",
                      fill_value=0)
-    k_pages = k_pages.at[:, dst_indices].set(src_k, mode="drop")
-    v_pages = v_pages.at[:, dst_indices].set(src_v, mode="drop")
+    k_pages = k_pages.at[dst_indices].set(src_k, mode="drop")
+    v_pages = v_pages.at[dst_indices].set(src_v, mode="drop")
     return k_pages, v_pages
 
 
 def gather_pages(
-    pages: jax.Array,         # [num_kv_heads, num_pages, page_size, head_dim]
+    pages: jax.Array,         # [num_pages, page_size, H * head_dim]
     page_indices: jax.Array,  # [num_seqs, pages_per_seq]; pad with OOB
+    num_kv_heads: int,
 ) -> jax.Array:
     """Gather each sequence's pages: -> [num_seqs, num_kv_heads,
     pages_per_seq * page_size, head_dim]. Used by the jnp reference
     attention path and by host-side swap staging."""
-    num_kv_heads, _, page_size, head_dim = pages.shape
+    _, page_size, hd = pages.shape
+    head_dim = hd // num_kv_heads
     num_seqs, pages_per_seq = page_indices.shape
-    # [heads, seqs, pages_per_seq, page_size, dim]
-    gathered = jnp.take(pages, page_indices.reshape(-1), axis=1, mode="fill",
-                        fill_value=0)
-    gathered = gathered.reshape(num_kv_heads, num_seqs, pages_per_seq,
-                                page_size, head_dim)
-    return gathered.transpose(1, 0, 2, 3, 4).reshape(
-        num_seqs, num_kv_heads, pages_per_seq * page_size, head_dim)
+    gathered = jnp.take(pages, page_indices.reshape(-1), axis=0,
+                        mode="fill", fill_value=0)
+    gathered = gathered.reshape(num_seqs, pages_per_seq * page_size,
+                                num_kv_heads, head_dim)
+    return gathered.transpose(0, 2, 1, 3)

@@ -1,4 +1,4 @@
-"""Pallas TPU kernel: in-place KV-cache page writes.
+"""Pallas TPU kernel: in-place KV-cache page writes (token-major).
 
 TPU-native equivalent of the reference's `reshape_and_cache` CUDA kernel
 (`kernels/cache_kernels.cu:221`). The XLA scatter version
@@ -10,12 +10,14 @@ kernel updates the HBM page arrays directly via async DMAs and declares
 `input_output_aliases`, so the update is guaranteed in place regardless
 of program structure.
 
-TPU detail: HBM/VMEM buffers are tiled (8, 128) on their last two dims,
-so a single page row (one token's slot) cannot be DMA'd alone. The
-kernel therefore read-modify-writes the token's aligned 8-row window:
-DMA window in, insert the row with a vector select (iota mask — no
-sub-tile slicing), DMA window back. Grid cells run sequentially on the
-TPU core, so same-window tokens in one batch serialize correctly.
+Layout: pages are [num_pages, page_size, H * d] (token-major, heads in
+lanes — see ops/kv_cache.py). One token's K or V is one full lane row,
+but a single row is a sub-tile write (the (8, 128) VMEM tile spans 8
+page slots), so the kernel read-modify-writes the token's aligned 8-row
+window: DMA window in, insert the row with a vector select, DMA window
+back. Grid cells run sequentially on the TPU core, so same-window
+tokens in one batch serialize correctly. K and V windows pipeline
+against each other (both reads start before either wait).
 
 Slot convention matches the scatter path: slot = page * page_size +
 offset; out-of-range slots (>= num_pages * page_size) are skipped — the
@@ -37,17 +39,17 @@ def _write_kernel(
     # scalar prefetch
     slots_ref,      # [num_tokens] int32 (SMEM)
     # inputs
-    knew_ref,       # [1, num_kv_heads, head_dim] VMEM (token i's k)
-    vnew_ref,
-    k_in,           # [H, P, S, D] ANY/HBM (aliased with k_out)
+    knew_ref,       # [1, 1, H*d] VMEM (token i's k, heads in lanes;
+    vnew_ref,       #  rank-3 so the block's last two dims are legal)
+    k_in,           # [P, S, H*d] ANY/HBM (aliased with k_out)
     v_in,
     # outputs (aliased)
     k_out,
     v_out,
     # scratch
-    kwin,           # [num_kv_heads, _WIN, head_dim] VMEM
+    kwin,           # [_WIN, H*d] VMEM
     vwin,
-    sem,
+    sems,
     *,
     page_size: int,
     num_slots: int,
@@ -62,67 +64,176 @@ def _write_kernel(
         off = slot % page_size
         j = jax.lax.rem(off, _WIN)
         mask = jax.lax.broadcasted_iota(
-            jnp.int32, (1, _WIN, 1), 1) == j
+            jnp.int32, (_WIN, 1), 0) == j
 
         for wi in range(page_size // _WIN):   # static unroll per window
             @pl.when(off // _WIN == wi)
             def _():
-                dst_k = k_out.at[:, page, pl.ds(wi * _WIN, _WIN), :]
-                dst_v = v_out.at[:, page, pl.ds(wi * _WIN, _WIN), :]
-                ck = pltpu.make_async_copy(dst_k, kwin, sem)
-                cv = pltpu.make_async_copy(dst_v, vwin, sem)
+                dst_k = k_out.at[page, pl.ds(wi * _WIN, _WIN), :]
+                dst_v = v_out.at[page, pl.ds(wi * _WIN, _WIN), :]
+                ck = pltpu.make_async_copy(dst_k, kwin, sems.at[0])
+                cv = pltpu.make_async_copy(dst_v, vwin, sems.at[1])
                 ck.start()
                 cv.start()
                 ck.wait()
                 cv.wait()
-                kwin[...] = jnp.where(mask, knew_ref[0][:, None, :],
-                                      kwin[...])
-                vwin[...] = jnp.where(mask, vnew_ref[0][:, None, :],
-                                      vwin[...])
-                wk = pltpu.make_async_copy(kwin, dst_k, sem)
-                wv = pltpu.make_async_copy(vwin, dst_v, sem)
+                kwin[...] = jnp.where(mask, knew_ref[0], kwin[...])
+                vwin[...] = jnp.where(mask, vnew_ref[0], vwin[...])
+                wk = pltpu.make_async_copy(kwin, dst_k, sems.at[0])
+                wv = pltpu.make_async_copy(vwin, dst_v, sems.at[1])
                 wk.start()
                 wv.start()
                 wk.wait()
                 wv.wait()
 
 
-def can_use_pallas_writer(dtype, page_size: int, head_dim: int) -> bool:
-    """f32/bf16 pages, 8-aligned page_size, lane-aligned head_dim
-    (int8/fp8 tile at 32 sublanes; head_dim<128 hits Mosaic shape-cast
-    limits — those fall back to the XLA scatter)."""
+def _decode_write_kernel(
+    # scalar prefetch
+    slots_ref,      # [num_tokens] int32 (SMEM)
+    # inputs
+    knew_ref,       # [num_tokens, 1, H*d] VMEM (all tokens' k rows)
+    vnew_ref,
+    k_in,           # [P, S, H*d] ANY/HBM (aliased with k_out)
+    v_in,
+    # outputs (aliased)
+    k_out,
+    v_out,
+    # scratch
+    kbuf,           # [2, page_size, H*d] VMEM
+    vbuf,
+    rsem,           # [2, 2] read semaphores (slot, k/v)
+    wsem,           # [2, 2] writeback semaphores
+    *,
+    page_size: int,
+    num_slots: int,
+):
+    """Pipelined decode-path writer: whole-page read-modify-write with a
+    2-slot double buffer ACROSS grid cells — cell i waits cell i-1's
+    writeback of the shared slot, starts cell i+1's page read, then
+    modifies its own already-resident page. Requires every token to
+    target a DISTINCT page (true for decode batches: one token per
+    sequence, pages are sequence-exclusive after CoW), so in-flight
+    writebacks never alias a pending read."""
+    del k_in, v_in
+    i = pl.program_id(0)
+    n = pl.num_programs(0)
+
+    def ok(j):
+        return slots_ref[j] < num_slots
+
+    def page_of(j):
+        return slots_ref[j] // page_size
+
+    def copies(j, slot, to_hbm):
+        pg = page_of(j)
+        if to_hbm:
+            return (pltpu.make_async_copy(kbuf.at[slot], k_out.at[pg],
+                                          wsem.at[slot, 0]),
+                    pltpu.make_async_copy(vbuf.at[slot], v_out.at[pg],
+                                          wsem.at[slot, 1]))
+        return (pltpu.make_async_copy(k_out.at[pg], kbuf.at[slot],
+                                      rsem.at[slot, 0]),
+                pltpu.make_async_copy(v_out.at[pg], vbuf.at[slot],
+                                      rsem.at[slot, 1]))
+
+    s = jax.lax.rem(i, 2)
+    sn = jax.lax.rem(i + 1, 2)
+
+    @pl.when((i == 0) & ok(0))
+    def _():
+        for c in copies(0, 0, False):
+            c.start()
+
+    # Free the next slot: cell i-1's writeback used it.
+    @pl.when((i >= 1) & ok(i - 1))
+    def _():
+        for c in copies(i - 1, sn, True):
+            c.wait()
+
+    # Prefetch cell i+1's page while this cell computes.
+    @pl.when((i + 1 < n) & ok(i + 1))
+    def _():
+        for c in copies(i + 1, sn, False):
+            c.start()
+
+    @pl.when(ok(i))
+    def _():
+        for c in copies(i, s, False):
+            c.wait()
+        off = jax.lax.rem(slots_ref[i], page_size)
+        mask = jax.lax.broadcasted_iota(
+            jnp.int32, (page_size, 1), 0) == off
+        kbuf[s] = jnp.where(mask, knew_ref[0], kbuf[s])
+        vbuf[s] = jnp.where(mask, vnew_ref[0], vbuf[s])
+        for c in copies(i, s, True):
+            c.start()
+
+    # Last cell drains its own writeback (everyone else's is waited by
+    # the following cell).
+    @pl.when((i == n - 1) & ok(i))
+    def _():
+        for c in copies(i, s, True):
+            c.wait()
+
+
+def can_use_pallas_writer(dtype, page_size: int, hd: int) -> bool:
+    """f32/bf16 pages, 8-aligned page_size, lane-aligned H*d rows
+    (int8/fp8 tile at 32 sublanes — those fall back to the XLA
+    scatter)."""
     return (dtype in (jnp.bfloat16, jnp.float32)
-            and page_size % _WIN == 0 and head_dim % 128 == 0)
+            and page_size % _WIN == 0 and hd % 128 == 0)
 
 
 def write_kv_pages(
-    knew: jax.Array,      # [num_tokens, num_kv_heads, head_dim]
+    knew: jax.Array,      # [num_tokens, H*d] (heads collapsed in lanes)
     vnew: jax.Array,
-    k_pages: jax.Array,   # [num_kv_heads, num_pages, page_size, head_dim]
+    k_pages: jax.Array,   # [num_pages, page_size, H*d]
     v_pages: jax.Array,
     slots: jax.Array,     # [num_tokens] int32; >= num_slots skips
     *,
+    distinct_pages: bool = False,
     interpret: bool = False,
 ):
-    """In-place paged KV write; returns the (aliased) updated pages."""
-    num_tokens, num_kv_heads, head_dim = knew.shape
-    _, num_pages, page_size, _ = k_pages.shape
-    num_slots = num_pages * page_size
+    """In-place paged KV write; returns the (aliased) updated pages.
 
-    kernel = functools.partial(
-        _write_kernel,
-        page_size=page_size,
-        num_slots=num_slots,
-    )
+    distinct_pages=True (decode batches: one token per sequence, pages
+    sequence-exclusive) selects the cross-cell pipelined whole-page
+    writer; the default serialized window writer handles same-page
+    tokens (prefill)."""
+    num_tokens, hd = knew.shape
+    num_pages, page_size, _ = k_pages.shape
+    num_slots = num_pages * page_size
     dtype = k_pages.dtype
+
+    if distinct_pages:
+        kernel = functools.partial(
+            _decode_write_kernel,
+            page_size=page_size,
+            num_slots=num_slots,
+        )
+        scratch = [
+            pltpu.VMEM((2, page_size, hd), dtype),
+            pltpu.VMEM((2, page_size, hd), dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ]
+    else:
+        kernel = functools.partial(
+            _write_kernel,
+            page_size=page_size,
+            num_slots=num_slots,
+        )
+        scratch = [
+            pltpu.VMEM((_WIN, hd), dtype),
+            pltpu.VMEM((_WIN, hd), dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(num_tokens,),
         in_specs=[
-            pl.BlockSpec((1, num_kv_heads, head_dim),
-                         lambda i, *_: (i, 0, 0)),
-            pl.BlockSpec((1, num_kv_heads, head_dim),
-                         lambda i, *_: (i, 0, 0)),
+            pl.BlockSpec((1, 1, hd), lambda i, *_: (i, 0, 0)),
+            pl.BlockSpec((1, 1, hd), lambda i, *_: (i, 0, 0)),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
@@ -130,11 +241,7 @@ def write_kv_pages(
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((num_kv_heads, _WIN, head_dim), dtype),
-            pltpu.VMEM((num_kv_heads, _WIN, head_dim), dtype),
-            pltpu.SemaphoreType.DMA,
-        ],
+        scratch_shapes=scratch,
     )
     return pl.pallas_call(
         kernel,
@@ -147,4 +254,5 @@ def write_kv_pages(
         # 0=slots, 1=knew, 2=vnew, 3=k_pages, 4=v_pages
         input_output_aliases={3: 0, 4: 1},
         interpret=interpret,
-    )(slots, knew.astype(dtype), vnew.astype(dtype), k_pages, v_pages)
+    )(slots, knew.astype(dtype).reshape(num_tokens, 1, hd),
+      vnew.astype(dtype).reshape(num_tokens, 1, hd), k_pages, v_pages)

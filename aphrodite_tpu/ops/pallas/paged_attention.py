@@ -2,26 +2,38 @@
 
 TPU-native replacement for the reference's PagedAttention V1/V2 CUDA
 kernels (`kernels/attention/attention_kernels.cu:717,907`, 951 lines of
-FasterTransformer-derived CUDA). Design differences, not a translation:
+FasterTransformer-derived CUDA). Design (round-3 "token-major" layout,
+chosen from the PROFILE_r03 attribution — the previous head-major
+layout's 4 KB-per-(head,page) DMAs capped attention at 210 GB/s):
 
-- One grid cell per (sequence, kv_head); GQA query groups ride along as
-  the sublane dimension so every MXU matmul is [group, d] x [d, chunk].
-- The block table is a **scalar-prefetch** argument: page indices are
-  known before the kernel body runs, so pages DMA directly from HBM into
-  a double-buffered VMEM scratch (chunk c+1 streams in while chunk c is
-  computed) — the analog of V2's 512-token sequence partitioning is the
-  chunked online softmax, but without the separate reduce kernel: the
-  running (m, l, acc) state never leaves VMEM.
-- Sequences shorter than the padded page count cost only their true
-  length: the chunk loop bound is ceil(context_len / chunk_tokens),
-  computed per sequence from the prefetched scalars.
+- KV pages are TOKEN-MAJOR with heads collapsed into lanes:
+      k_pages, v_pages: [num_pages, page_size, H * d]
+  One token's K (all heads) is contiguous; one page is a contiguous
+  [page_size, H*d] slab. A grid cell DMAs a whole page (or an aligned
+  hb*d lane slice of it) in ONE descriptor — 32 KB-class transfers
+  instead of 4 KB — and the layout has no Mosaic tile padding for ANY
+  head count (lanes = H*d >= 128 always), so it survives tp-sharding
+  down to one local head.
+- Grid: (batch, H // hb) with head-block hb = min(8, largest divisor).
+  The cell's hb kv-heads ride as a LANE block: scores come from one
+  MXU dot [group*hb, hb*d] x [hb*d, chunk] where q is packed
+  block-diagonally (row r holds q in its own head's d lanes, zeros
+  elsewhere) — cross-head products are exactly zero, so no masked
+  score tile and no H-times VPU exp waste (the round-2 allheads
+  kernel's documented flaw).
+- The block table is scalar-prefetched; pages double-buffer into VMEM
+  (chunk c+1 streams while c computes). When every sequence fits one
+  chunk, cells prefetch ACROSS the grid instead (cell i starts cell
+  i+1's loads), hiding page-DMA latency behind compute.
+- p@V lands as [rows, hb*d]; each row's own head block is extracted
+  with hb static lane-slices (masked adds) — no in-register reshape.
 
 Padded block-table entries must point at any valid page (use 0); padded
 positions are masked to -inf before the online-softmax update, and the
 cache is zero-initialized, so garbage pages never produce NaNs.
 
-ALiBi models use the jnp reference path for now
-(`ops/attention.py:paged_decode_attention_ref`).
+int8/fp8 KV pages dequant in-kernel: the scale folds into the score
+scale (q·k·S == (q·S)·k) and the output epilogue.
 """
 from __future__ import annotations
 
@@ -35,124 +47,25 @@ from jax.experimental.pallas import tpu as pltpu
 _NEG_INF = -2.0**30  # large-but-finite: avoids inf-inf NaNs in corrections
 
 
-def _decode_kernel(
+def head_block(num_kv_heads: int) -> int:
+    """Largest divisor of H that is <= 8: the per-grid-cell head count.
+    8 bounds the q-packing redundancy (scores cost hb x the minimal
+    FLOPs, on an otherwise idle MXU) and the VMEM chunk footprint."""
+    for hb in (8, 7, 6, 5, 4, 3, 2):
+        if num_kv_heads % hb == 0:
+            return hb
+    return 1
+
+
+def _decode_kernel_tm(
     # scalar prefetch
     block_tables_ref,   # [batch, pages_per_seq] int32 (SMEM)
     context_lens_ref,   # [batch] int32 (SMEM)
-    # inputs (slopes_ref [group, 128] present only with has_alibi)
+    # inputs (slopes_ref [n_hb, rows, 128] present only with has_alibi)
     *refs,
-    pages_per_chunk: int,
-    page_size: int,
-    scale: float,
-    kv_scale: float,
-    has_alibi: bool = False,
-):
-    if has_alibi:
-        (q_ref, k_hbm, v_hbm, slopes_ref, out_ref,
-         k_buf, v_buf, sems, acc_scr, m_scr, l_scr) = refs
-    else:
-        (q_ref, k_hbm, v_hbm, out_ref,
-         k_buf, v_buf, sems, acc_scr, m_scr, l_scr) = refs
-        slopes_ref = None
-    b = pl.program_id(0)
-    h = pl.program_id(1)
-    chunk_tokens = pages_per_chunk * page_size
-    ctx = context_lens_ref[b]
-    num_chunks = (ctx + chunk_tokens - 1) // chunk_tokens
-
-    def chunk_dmas(c, slot):
-        copies = []
-        for p in range(pages_per_chunk):  # static unroll
-            page_idx = block_tables_ref[b, c * pages_per_chunk + p]
-            dst = pl.ds(p * page_size, page_size)
-            copies.append(
-                pltpu.make_async_copy(k_hbm.at[h, page_idx],
-                                      k_buf.at[slot, dst, :],
-                                      sems.at[slot, 0]))
-            copies.append(
-                pltpu.make_async_copy(v_hbm.at[h, page_idx],
-                                      v_buf.at[slot, dst, :],
-                                      sems.at[slot, 1]))
-        return copies
-
-    def start_chunk(c, slot):
-        for dma in chunk_dmas(c, slot):
-            dma.start()
-
-    def wait_chunk(c, slot):
-        for dma in chunk_dmas(c, slot):
-            dma.wait()
-
-    acc_scr[...] = jnp.zeros_like(acc_scr)
-    m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
-    l_scr[...] = jnp.zeros_like(l_scr)
-
-    q = q_ref[0, 0].astype(jnp.float32) * (scale * kv_scale)
-
-    # Padded batch rows may have ctx == 0: no DMA may start, because the
-    # matching wait never runs and scratch semaphores persist across grid
-    # cells on hardware.
-    @pl.when(num_chunks > 0)
-    def _():
-        start_chunk(0, 0)
-
-    def body(c, _):
-        slot = jax.lax.rem(c, 2)
-
-        @pl.when(c + 1 < num_chunks)
-        def _():
-            start_chunk(c + 1, jax.lax.rem(c + 1, 2))
-
-        wait_chunk(c, slot)
-
-        k = k_buf[slot].astype(jnp.float32)  # [chunk, d]
-        v = v_buf[slot].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1, ), (1, )), ((), ())),
-            preferred_element_type=jnp.float32)  # [group, chunk]
-
-        pos = c * chunk_tokens + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 1)
-        if slopes_ref is not None:
-            # ALiBi: bias grows with kv ABSOLUTE position (reference
-            # make_alibi_bias, layers/attention.py:196).
-            s = s + slopes_ref[:, :1] * pos.astype(jnp.float32)
-        s = jnp.where(pos < ctx, s, _NEG_INF)
-
-        m_prev = m_scr[:, :1]                        # [group, 1]
-        m_cur = jnp.max(s, axis=1, keepdims=True)    # [group, 1]
-        m_new = jnp.maximum(m_prev, m_cur)
-        corr = jnp.exp(m_prev - m_new)               # [group, 1]
-        p_exp = jnp.exp(s - m_new)                   # [group, chunk]
-        # Re-mask: padded lanes got exp(NEG_INF - m) which underflows to 0
-        # already, but keep it explicit for the all-padded-chunk case.
-        p_exp = jnp.where(pos < ctx, p_exp, 0.0)
-
-        l_prev = l_scr[:, :1]
-        l_new = l_prev * corr + jnp.sum(p_exp, axis=1, keepdims=True)
-        pv = jax.lax.dot_general(
-            p_exp, v, (((1, ), (0, )), ((), ())),
-            preferred_element_type=jnp.float32)      # [group, d]
-        acc_scr[...] = acc_scr[...] * corr + pv
-        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
-        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
-
-    jax.lax.fori_loop(0, num_chunks, body, None)
-
-    l_final = l_scr[:, :1]
-    l_safe = jnp.where(l_final == 0.0, 1.0, l_final)
-    out_ref[0, 0] = (acc_scr[...] * (kv_scale / l_safe)).astype(
-        out_ref.dtype)
-
-
-def _decode_kernel_allheads(
-    # scalar prefetch
-    block_tables_ref,   # [batch, pages_per_seq] int32 (SMEM)
-    context_lens_ref,   # [batch] int32 (SMEM)
-    # inputs (slopes_ref [H*group, 128] present only with has_alibi)
-    *refs,
-    num_kv_heads: int,
+    hb: int,
     group: int,
+    head_dim: int,
     pages_per_chunk: int,
     page_size: int,
     scale: float,
@@ -160,12 +73,6 @@ def _decode_kernel_allheads(
     has_alibi: bool = False,
     single_chunk: bool = False,
 ):
-    """All-kv-heads-per-cell flash decoding: one grid cell handles every
-    kv head of one sequence, so the online-softmax runs on
-    [H*group, chunk] tiles (32 sublanes for Llama/Mistral GQA) instead
-    of 8 separate [group=4, chunk] cells. Decode attention here is
-    instruction-issue-bound, not bandwidth-bound — tiny tiles waste the
-    VPU/MXU on per-op overhead, so merging heads is worth ~4x."""
     if has_alibi:
         (q_ref, k_hbm, v_hbm, slopes_ref, out_ref,
          k_buf, v_buf, sems, acc_scr, m_scr, l_scr) = refs
@@ -174,60 +81,81 @@ def _decode_kernel_allheads(
          k_buf, v_buf, sems, acc_scr, m_scr, l_scr) = refs
         slopes_ref = None
     b = pl.program_id(0)
-    H = num_kv_heads
+    j = pl.program_id(1)
+    n_hb = pl.num_programs(1)
+    d = head_dim
+    rows = group * hb
     chunk_tokens = pages_per_chunk * page_size
     ctx = context_lens_ref[b]
     num_chunks = (ctx + chunk_tokens - 1) // chunk_tokens
 
-    def chunk_dmas(c, slot, cell=None):
-        cell = b if cell is None else cell
+    def chunk_dmas(c, slot, cell_b=None, cell_j=None):
+        cell_b = b if cell_b is None else cell_b
+        cell_j = j if cell_j is None else cell_j
+        lanes = pl.ds(cell_j * hb * d, hb * d)
         copies = []
         for p in range(pages_per_chunk):  # static unroll
-            page_idx = block_tables_ref[cell, c * pages_per_chunk + p]
+            page_idx = block_tables_ref[cell_b, c * pages_per_chunk + p]
             dst = pl.ds(p * page_size, page_size)
-            for h in range(H):            # static unroll
-                copies.append(
-                    pltpu.make_async_copy(k_hbm.at[h, page_idx],
-                                          k_buf.at[slot, h, dst, :],
-                                          sems.at[slot, 0]))
-                copies.append(
-                    pltpu.make_async_copy(v_hbm.at[h, page_idx],
-                                          v_buf.at[slot, h, dst, :],
-                                          sems.at[slot, 1]))
+            copies.append(
+                pltpu.make_async_copy(k_hbm.at[page_idx, :, lanes],
+                                      k_buf.at[slot, dst, :],
+                                      sems.at[slot, 0]))
+            copies.append(
+                pltpu.make_async_copy(v_hbm.at[page_idx, :, lanes],
+                                      v_buf.at[slot, dst, :],
+                                      sems.at[slot, 1]))
         return copies
 
-    def start_chunk(c, slot, cell=None):
-        for dma in chunk_dmas(c, slot, cell):
+    def start_chunk(c, slot, cell_b=None, cell_j=None):
+        for dma in chunk_dmas(c, slot, cell_b, cell_j):
             dma.start()
 
     acc_scr[...] = jnp.zeros_like(acc_scr)
     m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
     l_scr[...] = jnp.zeros_like(l_scr)
 
+    # Block-diagonal q packing: row r (serving q head j*hb*group + r,
+    # kv head hh = r // group of this cell's block) carries q in lanes
+    # [hh*d, (hh+1)*d) and zeros elsewhere, so the single
+    # [rows, hb*d] x [hb*d, chunk] dot yields exact per-head scores.
+    q = q_ref[0, 0].astype(jnp.float32) * (scale * kv_scale)  # [rows, d]
+    q_rep = jax.lax.concatenate([q] * hb, 1)                  # [rows, hb*d]
+    lane_head = jax.lax.broadcasted_iota(
+        jnp.int32, (rows, hb * d), 1) // d
+    row_head = jax.lax.broadcasted_iota(
+        jnp.int32, (rows, hb * d), 0) // group
+    q_packed = jnp.where(lane_head == row_head, q_rep, 0.0)
+
     if single_chunk:
-        # Every sequence fits one chunk (table width == chunk): pipeline
-        # ACROSS grid cells instead — cell b starts cell b+1's loads
-        # before waiting on its own, so the ~page-DMA latency chain
-        # overlaps the previous cell's compute. Scratch (and its
-        # semaphores) persist across cells, alternating slots by cell
-        # parity (body() derives the slot from b).
+        # Every sequence fits one chunk: pipeline ACROSS grid cells —
+        # cell i starts cell i+1's loads before waiting on its own, so
+        # page-DMA latency overlaps the previous cell's compute.
+        # Scratch/semaphores persist across cells, alternating slots by
+        # cell-index parity.
+        cell = b * n_hb + j
 
-        @pl.when(b == 0)
+        @pl.when(cell == 0)
         def _():
-            start_chunk(0, 0, cell=0)
+            start_chunk(0, 0, cell_b=0, cell_j=0)
 
-        @pl.when(b + 1 < pl.num_programs(0))
+        @pl.when(cell + 1 < pl.num_programs(0) * n_hb)
         def _():
-            start_chunk(0, jax.lax.rem(b + 1, 2), cell=b + 1)
+            nb = jnp.where(j + 1 < n_hb, b, b + 1)
+            nj = jnp.where(j + 1 < n_hb, j + 1, 0)
+            start_chunk(0, jax.lax.rem(cell + 1, 2), cell_b=nb,
+                        cell_j=nj)
     else:
         @pl.when(num_chunks > 0)
         def _():
             start_chunk(0, 0)
 
     def body(c, _):
-        slot = jax.lax.rem(b, 2) if single_chunk else jax.lax.rem(c, 2)
+        if single_chunk:
+            slot = jax.lax.rem(b * n_hb + j, 2)
+        else:
+            slot = jax.lax.rem(c, 2)
 
-        if not single_chunk:
             @pl.when(c + 1 < num_chunks)
             def _():
                 start_chunk(c + 1, jax.lax.rem(c + 1, 2))
@@ -235,142 +163,54 @@ def _decode_kernel_allheads(
         for dma in chunk_dmas(c, slot):
             dma.wait()
 
-        # ONE q@K dot across all heads: [H*group, d] x [d, H*chunk].
-        # Cross-head score blocks are junk; the block-diagonal mask
-        # kills them, and their p_exp zeros make the single p@V dot
-        # produce exactly sum_h p_h v_h per row. 8x redundant MXU FLOPs
-        # buy ~8x fewer serialized dot latencies — decode attention here
-        # is instruction-latency-bound, the MXU is idle either way.
-        # int8 pages store value/kv_scale: fold it into the score
-        # scale; the V side is restored once in the epilogue.
-        q_all = q_ref[0].astype(jnp.float32) * (scale * kv_scale)
-        k_flat = k_buf[slot].reshape(
-            H * chunk_tokens, q_all.shape[1]).astype(jnp.float32)
+        k = k_buf[slot].astype(jnp.float32)          # [chunk, hb*d]
         s = jax.lax.dot_general(
-            q_all, k_flat, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)           # [Hg, H*chunk]
-        col_head = jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 1) // chunk_tokens
-        row_head = jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 0) // group
+            q_packed, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)      # [rows, chunk]
         pos = c * chunk_tokens + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 1) % chunk_tokens
+            jnp.int32, s.shape, 1)
         if slopes_ref is not None:
-            s = s + slopes_ref[:, :1] * pos.astype(jnp.float32)
-        live = (col_head == row_head) & (pos < ctx)
+            # ALiBi bias grows with kv absolute position (reference
+            # make_alibi_bias, layers/attention.py:196).
+            s = s + slopes_ref[0, :, :1] * pos.astype(jnp.float32)
+        live = pos < ctx
         s = jnp.where(live, s, _NEG_INF)
-        m_prev = m_scr[:, :1]
+
+        m_prev = m_scr[:, :1]                        # [rows, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         corr = jnp.exp(m_prev - m_new)
         p_exp = jnp.where(live, jnp.exp(s - m_new), 0.0)
         l_prev = l_scr[:, :1]
         l_new = l_prev * corr + jnp.sum(p_exp, axis=1, keepdims=True)
-        v_flat = v_buf[slot].reshape(
-            H * chunk_tokens, q_all.shape[1]).astype(jnp.float32)
+
+        v = v_buf[slot].astype(jnp.float32)          # [chunk, hb*d]
         pv = jax.lax.dot_general(
-            p_exp, v_flat, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)           # [Hg, d]
-        acc_scr[...] = acc_scr[...] * corr + pv
+            p_exp, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)      # [rows, hb*d]
+        # Extract each row's own head block: hb static lane slices,
+        # masked adds (no in-register reshape).
+        rh = jax.lax.broadcasted_iota(jnp.int32, (rows, d), 0) // group
+        pv_sel = jnp.zeros((rows, d), jnp.float32)
+        for h in range(hb):
+            pv_sel = pv_sel + jnp.where(rh == h,
+                                        pv[:, h * d:(h + 1) * d], 0.0)
+        acc_scr[...] = acc_scr[...] * corr + pv_sel
         m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
 
     if single_chunk:
         # Unconditional: this cell's DMAs were started by the previous
-        # cell (or above for b == 0) and MUST be waited even for ctx==0
-        # padding rows (masking zeroes their contribution).
+        # cell (or above for cell 0) and MUST be waited even for
+        # ctx==0 padding rows (masking zeroes their contribution).
         body(0, None)
     else:
         jax.lax.fori_loop(0, num_chunks, body, None)
 
     l_final = l_scr[:, :1]
     l_safe = jnp.where(l_final == 0.0, 1.0, l_final)
-    out_ref[0] = (acc_scr[...] * (kv_scale / l_safe)).astype(
+    out_ref[0, 0] = (acc_scr[...] * (kv_scale / l_safe)).astype(
         out_ref.dtype)
-
-
-@functools.partial(
-    jax.jit,
-    static_argnames=("scale", "kv_scale", "pages_per_chunk",
-                     "interpret"))
-def paged_decode_attention_allheads(
-    q: jax.Array,             # [batch, num_q_heads, head_dim]
-    k_pages: jax.Array,
-    v_pages: jax.Array,
-    block_tables: jax.Array,  # [batch, pages_per_seq] int32, 0-padded
-    context_lens: jax.Array,  # [batch] int32
-    alibi_slopes: jax.Array = None,   # [num_q_heads] f32, optional
-    *,
-    scale: float,
-    kv_scale: float = 1.0,
-    pages_per_chunk: int = 8,
-    interpret: bool = False,
-) -> jax.Array:
-    """All-heads-per-cell flash decoding (see kernel docstring).
-
-    q layout note: q[:, qh] belongs to kv head qh // group, and inside
-    the kernel rows are stacked kv-head-major — which IS q's natural
-    [num_q_heads, head_dim] order."""
-    batch, num_q_heads, head_dim = q.shape
-    num_kv_heads, num_pages, page_size, _ = k_pages.shape
-    pages_per_seq = block_tables.shape[1]
-    group = num_q_heads // num_kv_heads
-    if num_q_heads % num_kv_heads != 0:
-        raise ValueError(f"{num_q_heads=} % {num_kv_heads=}")
-    if pages_per_seq % pages_per_chunk != 0:
-        raise ValueError(f"{pages_per_seq=} % {pages_per_chunk=}")
-    chunk_tokens = pages_per_chunk * page_size
-
-    kernel = functools.partial(
-        _decode_kernel_allheads,
-        num_kv_heads=num_kv_heads,
-        group=group,
-        pages_per_chunk=pages_per_chunk,
-        page_size=page_size,
-        scale=scale,
-        kv_scale=kv_scale,
-        has_alibi=alibi_slopes is not None,
-        single_chunk=pages_per_seq == pages_per_chunk,
-    )
-    in_specs = [
-        pl.BlockSpec((1, num_q_heads, head_dim),
-                     lambda b, *_: (b, 0, 0)),
-        pl.BlockSpec(memory_space=pl.ANY),
-        pl.BlockSpec(memory_space=pl.ANY),
-    ]
-    inputs = [block_tables, context_lens, q, k_pages, v_pages]
-    if alibi_slopes is not None:
-        in_specs.append(
-            pl.BlockSpec((num_q_heads, 128), lambda b, *_: (0, 0)))
-        inputs.append(jnp.broadcast_to(
-            alibi_slopes.astype(jnp.float32)[:, None],
-            (num_q_heads, 128)))
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(batch,),
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, num_q_heads, head_dim),
-                               lambda b, *_: (b, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((2, num_kv_heads, chunk_tokens, head_dim),
-                       k_pages.dtype),
-            pltpu.VMEM((2, num_kv_heads, chunk_tokens, head_dim),
-                       v_pages.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
-            pltpu.VMEM((num_q_heads, head_dim), jnp.float32),
-            pltpu.VMEM((num_q_heads, 128), jnp.float32),
-            pltpu.VMEM((num_q_heads, 128), jnp.float32),
-        ],
-    )
-    out = pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((batch, num_q_heads, head_dim),
-                                       q.dtype),
-        interpret=interpret,
-    )(*inputs)
-    return out
-
 
 
 @functools.partial(
@@ -379,7 +219,7 @@ def paged_decode_attention_allheads(
                      "interpret"))
 def paged_decode_attention(
     q: jax.Array,             # [batch, num_q_heads, head_dim]
-    k_pages: jax.Array,       # [num_kv_heads, num_pages, page_size, d]
+    k_pages: jax.Array,       # [num_pages, page_size, H * head_dim]
     v_pages: jax.Array,
     block_tables: jax.Array,  # [batch, pages_per_seq] int32, 0-padded
     context_lens: jax.Array,  # [batch] int32
@@ -390,67 +230,73 @@ def paged_decode_attention(
     pages_per_chunk: int = 8,
     interpret: bool = False,
 ) -> jax.Array:
-    """Flash-decoding attention over HBM KV pages. See module docstring."""
+    """Token-major flash-decoding attention (see module docstring)."""
     batch, num_q_heads, head_dim = q.shape
-    num_kv_heads, num_pages, page_size, _ = k_pages.shape
+    num_pages, page_size, hd = k_pages.shape
+    if hd % head_dim != 0:
+        raise ValueError(f"{hd=} not a multiple of {head_dim=}")
+    num_kv_heads = hd // head_dim
     pages_per_seq = block_tables.shape[1]
     if num_q_heads % num_kv_heads != 0:
-        raise ValueError(f"{num_q_heads=} not divisible by {num_kv_heads=}")
+        raise ValueError(f"{num_q_heads=} % {num_kv_heads=}")
     group = num_q_heads // num_kv_heads
     if pages_per_seq % pages_per_chunk != 0:
         raise ValueError(
             f"{pages_per_seq=} must be a multiple of {pages_per_chunk=} "
             "(pad the block table).")
+    hb = head_block(num_kv_heads)
+    n_hb = num_kv_heads // hb
+    rows = group * hb
     chunk_tokens = pages_per_chunk * page_size
 
-    grid = (batch, num_kv_heads)
-    # q viewed as [batch, num_kv_heads, group, head_dim]
-    q_grouped = q.reshape(batch, num_kv_heads, group, head_dim)
-
     kernel = functools.partial(
-        _decode_kernel,
+        _decode_kernel_tm,
+        hb=hb,
+        group=group,
+        head_dim=head_dim,
         pages_per_chunk=pages_per_chunk,
         page_size=page_size,
         scale=scale,
         kv_scale=kv_scale,
         has_alibi=alibi_slopes is not None,
+        single_chunk=pages_per_seq == pages_per_chunk,
     )
-
+    # q rows are kv-head-major, so the rows for head block j are the
+    # contiguous slice [j*rows, (j+1)*rows).
+    q_blocked = q.reshape(batch, n_hb, rows, head_dim)
     in_specs = [
-        pl.BlockSpec((1, 1, group, head_dim),
-                     lambda b, h, *_: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, rows, head_dim),
+                     lambda b, j, *_: (b, j, 0, 0)),
         pl.BlockSpec(memory_space=pl.ANY),
         pl.BlockSpec(memory_space=pl.ANY),
     ]
-    inputs = [block_tables, context_lens, q_grouped, k_pages, v_pages]
+    inputs = [block_tables, context_lens, q_blocked, k_pages, v_pages]
     if alibi_slopes is not None:
-        # Rows h*group..(h+1)*group of the [Hq, 128] tile per grid head.
         in_specs.append(
-            pl.BlockSpec((group, 128), lambda b, h, *_: (h, 0)))
+            pl.BlockSpec((1, rows, 128), lambda b, j, *_: (j, 0, 0)))
         inputs.append(jnp.broadcast_to(
-            alibi_slopes.astype(jnp.float32)[:, None],
-            (num_q_heads, 128)))
+            alibi_slopes.astype(jnp.float32).reshape(n_hb, rows, 1),
+            (n_hb, rows, 128)))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=grid,
+        grid=(batch, n_hb),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, 1, group, head_dim),
-                               lambda b, h, *_: (b, h, 0, 0)),
+        out_specs=pl.BlockSpec((1, 1, rows, head_dim),
+                               lambda b, j, *_: (b, j, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((2, chunk_tokens, head_dim), k_pages.dtype),
-            pltpu.VMEM((2, chunk_tokens, head_dim), v_pages.dtype),
+            pltpu.VMEM((2, chunk_tokens, hb * head_dim), k_pages.dtype),
+            pltpu.VMEM((2, chunk_tokens, hb * head_dim), v_pages.dtype),
             pltpu.SemaphoreType.DMA((2, 2)),
-            pltpu.VMEM((group, head_dim), jnp.float32),
-            pltpu.VMEM((group, 128), jnp.float32),
-            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((rows, head_dim), jnp.float32),
+            pltpu.VMEM((rows, 128), jnp.float32),
+            pltpu.VMEM((rows, 128), jnp.float32),
         ],
     )
-
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct(
-            (batch, num_kv_heads, group, head_dim), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((batch, n_hb, rows, head_dim),
+                                       q.dtype),
         interpret=interpret,
     )(*inputs)
     return out.reshape(batch, num_q_heads, head_dim)

@@ -32,7 +32,7 @@ def main() -> None:
 
     from aphrodite_tpu.ops.attention import paged_decode_attention_ref
     from aphrodite_tpu.ops.pallas.paged_attention import (
-        paged_decode_attention, paged_decode_attention_allheads)
+        paged_decode_attention)
 
     B, ctx, page = args.batch, args.ctx, args.page_size
     Hq, Hkv, d = args.heads, args.kv_heads, args.head_dim
@@ -42,8 +42,9 @@ def main() -> None:
     dtype = jnp.bfloat16 if jax.default_backend() == "tpu" \
         else jnp.float32
     q = jnp.asarray(rs.randn(B, Hq, d) * 0.05, dtype)
-    kp = jnp.asarray(rs.randn(Hkv, num_pages, page, d) * 0.05, dtype)
-    vp = jnp.asarray(rs.randn(Hkv, num_pages, page, d) * 0.05, dtype)
+    # Token-major pages: [num_pages, page_size, Hkv * d].
+    kp = jnp.asarray(rs.randn(num_pages, page, Hkv * d) * 0.05, dtype)
+    vp = jnp.asarray(rs.randn(num_pages, page, Hkv * d) * 0.05, dtype)
     bt = jnp.asarray(
         rs.permutation(B * pps).reshape(B, pps).astype(np.int32))
     cl = jnp.full((B,), ctx, jnp.int32)
@@ -55,11 +56,8 @@ def main() -> None:
             c, kp, vp, bt, cl, scale),
     }
     if jax.default_backend() == "tpu" and d % 128 == 0:
-        variants["pallas_v1"] = lambda c: paged_decode_attention(
+        variants["pallas_tm"] = lambda c: paged_decode_attention(
             c, kp, vp, bt, cl, scale=scale)
-        variants["pallas_allheads"] = \
-            lambda c: paged_decode_attention_allheads(
-                c, kp, vp, bt, cl, scale=scale)
 
     for name, fn in variants.items():
         @jax.jit

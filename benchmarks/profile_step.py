@@ -90,7 +90,7 @@ def main() -> None:
     import jax.numpy as jnp
     from aphrodite_tpu.ops.pallas.quant_matmul import gptq_matmul
     from aphrodite_tpu.ops.pallas.paged_attention import (
-        paged_decode_attention, paged_decode_attention_allheads)
+        paged_decode_attention)
     from aphrodite_tpu.ops.kv_cache import write_to_kv_cache
 
     B, ctx = args.batch, args.ctx
@@ -101,6 +101,12 @@ def main() -> None:
     def row(name, per_call_ms, calls_per_step, note=""):
         rows.append((name, per_call_ms, calls_per_step,
                      per_call_ms * calls_per_step, note))
+        # Stream each measurement as it lands (a later section crashing
+        # must not lose earlier numbers).
+        print(f"[measured] {name}: {per_call_ms * 1e3:.1f} us/call "
+              f"x{calls_per_step} = "
+              f"{per_call_ms * calls_per_step:.3f} ms/step  {note}",
+              file=sys.stderr, flush=True)
 
     # --- quantized matmuls (the four per-layer GEMMs) ---
     qkv_out = (HEADS + 2 * KV_HEADS) * HEAD_DIM        # 6144
@@ -152,16 +158,15 @@ def main() -> None:
     pages_per_seq = -(-max(8, -(-ctx // PAGE)) // 8) * 8
     num_pages = B * pages_per_seq + 1
     kp = jax.random.normal(
-        key, (KV_HEADS, num_pages, PAGE, HEAD_DIM), dtype=jnp.bfloat16)
+        key, (num_pages, PAGE, KV_HEADS * HEAD_DIM), dtype=jnp.bfloat16)
     vp = jax.random.normal(
-        key, (KV_HEADS, num_pages, PAGE, HEAD_DIM), dtype=jnp.bfloat16)
+        key, (num_pages, PAGE, KV_HEADS * HEAD_DIM), dtype=jnp.bfloat16)
     tables = jnp.asarray(
         np.random.randint(0, num_pages, (B, pages_per_seq)), jnp.int32)
     ctx_lens = jnp.full((B,), ctx, dtype=jnp.int32)
     q3 = jax.random.normal(key, (B, HEADS, HEAD_DIM), dtype=jnp.bfloat16)
     kv_bytes = 2 * B * KV_HEADS * ctx * HEAD_DIM * 2
-    for fname, fn in ((("allheads", paged_decode_attention_allheads),
-                       ("per-head", paged_decode_attention))
+    for fname, fn in ((("tokenmajor", paged_decode_attention),)
                       if want("attn") else []):
 
         def astep(c, i, fn=fn):
@@ -177,18 +182,24 @@ def main() -> None:
     # --- KV page write ---
     fk = jax.random.normal(key, (B, KV_HEADS, HEAD_DIM),
                            dtype=jnp.bfloat16)
-    slots = jnp.asarray(np.random.permutation(num_pages * PAGE)[:B],
-                        jnp.int32)
+    # One slot per page (the decode contract: pages sequence-exclusive).
+    slots = jnp.asarray(
+        np.random.permutation(num_pages)[:B] * PAGE +
+        np.random.randint(0, PAGE, B), jnp.int32)
 
     if want("kv"):
-        def wstep(c, i):
-            kpp, vpp, f = c
-            kpp, vpp = write_to_kv_cache(f, f, kpp, vpp, slots)
-            return (kpp, vpp,
-                    f + kpp[0, 0, 0, :1] * jnp.bfloat16(1e-30))
-        s, rtt = device_bench(wstep, (kp + 0, vp + 0, fk), slow=True)
-        rtts.append(rtt)
-        row(f"kv_write b={B}", s * 1e3, LAYERS, "")
+        for variant, distinct in (("decode-pipelined", True),
+                                  ("prefill-window", False)):
+            def wstep(c, i, distinct=distinct):
+                kpp, vpp, f = c
+                kpp, vpp = write_to_kv_cache(f, f, kpp, vpp, slots,
+                                             distinct_pages=distinct)
+                return (kpp, vpp,
+                        f + kpp[0, 0, :1] * jnp.bfloat16(1e-30))
+            s, rtt = device_bench(wstep, (kp + 0, vp + 0, fk),
+                                  slow=True)
+            rtts.append(rtt)
+            row(f"kv_write {variant} b={B}", s * 1e3, LAYERS, "")
 
     # --- lm_head ---
     hid = jax.random.normal(key, (B, HIDDEN), dtype=jnp.bfloat16)
@@ -241,6 +252,105 @@ def main() -> None:
         rtts.append(rtt)
         row("fused_sample (greedy)", s * 1e3, 1, "")
 
+    # --- prefill-shape quant matmul (one scheduling round: 4096 toks) ---
+    if want("prefill"):
+        M = 4096
+        for name, K, N in shapes:
+            x = jax.random.normal(key, (M, K), dtype=jnp.bfloat16)
+            qw = jax.random.randint(key, (K // 8, N), 0, 2**31 - 1,
+                                    dtype=jnp.int32)
+            qz = jax.random.randint(key, (K // GROUP, N // 8), 0,
+                                    2**31 - 1, dtype=jnp.int32)
+            sc = jnp.ones((K // GROUP, N), dtype=jnp.bfloat16) * 0.01
+
+            def pstep(c, i, qw=qw, qz=qz, sc=sc):
+                xx = c
+                o = gptq_matmul(xx, qw, qz, sc, bits=4,
+                                group_size=GROUP)
+                return xx + o[:, :1] * jnp.bfloat16(1e-30)
+            s, rtt = device_bench(pstep, x, slow=True)
+            rtts.append(rtt)
+            flops = 2 * M * K * N
+            row(f"PREFILL gptq_matmul {name} m={M}", s * 1e3, LAYERS,
+                f"{flops / s / 1e12:.1f} TF/s")
+
+        # prefill dense attention + KV write at one round's shape
+        from aphrodite_tpu.ops.attention import prefill_attention
+        pb, ps = 128, 32                     # 128 seqs x 32 tokens
+        qp = jax.random.normal(key, (pb, ps, HEADS, HEAD_DIM),
+                               dtype=jnp.bfloat16)
+        kvp = jax.random.normal(key, (pb, ps, KV_HEADS, HEAD_DIM),
+                                dtype=jnp.bfloat16)
+        plens = jnp.full((pb,), ps, jnp.int32)
+
+        def prefstep(c, i):
+            qq = c
+            o = prefill_attention(qq, kvp, kvp,
+                                  jnp.zeros((pb,), jnp.int32), plens,
+                                  0.0884)
+            return qq + o * jnp.bfloat16(1e-30)
+        s, rtt = device_bench(prefstep, qp, slow=True)
+        rtts.append(rtt)
+        row(f"PREFILL attention b={pb} s={ps}", s * 1e3, LAYERS, "")
+
+        fkp = jax.random.normal(key, (pb * ps, KV_HEADS, HEAD_DIM),
+                                dtype=jnp.bfloat16)
+        pslots = jnp.asarray(np.arange(pb * ps), jnp.int32)
+
+        def pwstep(c, i):
+            kpp, vpp, f = c
+            kpp, vpp = write_to_kv_cache(f, f, kpp, vpp, pslots,
+                                         distinct_pages=False)
+            return (kpp, vpp, f + kpp[0, 0, :1] * jnp.bfloat16(1e-30))
+        s, rtt = device_bench(pwstep, (kp + 0, vp + 0, fkp), slow=True)
+        rtts.append(rtt)
+        row(f"PREFILL kv_write {pb * ps} toks", s * 1e3, LAYERS, "")
+
+    # --- one full decoder layer (GPTQ), as the engine runs it ---
+    if want("layer"):
+        from types import SimpleNamespace
+        from aphrodite_tpu.modeling.models.llama import LlamaDecoderLayer
+        from aphrodite_tpu.modeling.layers.quantization.gptq import (
+            GPTQConfig)
+        from aphrodite_tpu.modeling.hf_loader import (
+            initialize_dummy_params)
+        from aphrodite_tpu.modeling.input_metadata import InputMetadata
+        cfg = SimpleNamespace(
+            hidden_size=HIDDEN, intermediate_size=INTER,
+            num_attention_heads=HEADS, num_key_value_heads=KV_HEADS,
+            rms_norm_eps=1e-5, rope_theta=10000.0,
+            max_position_embeddings=4096, hidden_act="silu",
+            sliding_window=None, rope_scaling=None)
+        layer = LlamaDecoderLayer(
+            cfg, 0, dtype=jnp.bfloat16,
+            linear_method=GPTQConfig(4, 128).get_linear_method())
+
+        class _M:                      # initialize_dummy_params surface
+            def __init__(self, lyr):
+                self._lyr = lyr
+
+            def init_params(self):
+                return self._lyr.init()
+        lparams = initialize_dummy_params(_M(layer), seed=0)
+        hid3 = jax.random.normal(key, (B, 1, HIDDEN),
+                                 dtype=jnp.bfloat16)
+        pos = jnp.full((B, 1), ctx - 1, dtype=jnp.int32)
+        meta = InputMetadata(
+            slot_mapping=slots,
+            block_tables=tables,
+            context_lens=ctx_lens,
+            is_prompt=False)
+
+        def lyrstep(c, i):
+            h, res, kpp, vpp = c
+            out, res, (kpp, vpp) = layer(lparams, pos, h, res,
+                                         (kpp, vpp), meta)
+            return (hid3 + out * jnp.bfloat16(1e-30), res, kpp, vpp)
+        s, rtt = device_bench(
+            lyrstep, (hid3, jnp.zeros_like(hid3), kp + 0, vp + 0))
+        rtts.append(rtt)
+        row(f"FULL decoder layer (gptq) b={B}", s * 1e3, LAYERS, "")
+
     # --- elementwise glue: rmsnorm x2 + silu_and_mul per layer ---
     if want("glue"):
         from aphrodite_tpu.modeling.layers.layernorm import rms_norm
@@ -266,12 +376,18 @@ def main() -> None:
           f"rtt~{np.median(rtts) * 1e3:.0f}ms) ===")
     print(f"{'component':54s} {'us/call':>9s} {'xN':>4s} "
           f"{'ms/step':>8s}  note")
+    # SUM counts each component of one real decode step exactly once:
+    # the bf16-dense roofline rows, the prefill-variant writer, and the
+    # FULL-layer cross-check (which already contains the components)
+    # are reference rows, not addends.
+    excluded = ("bf16 dense", "kv_write prefill-window", "FULL decoder",
+                "PREFILL")
     for name, ms_call, n, ms_step, note in rows:
         print(f"{name:54s} {ms_call * 1e3:9.1f} {n:4d} {ms_step:8.3f}  "
               f"{note}")
-        if not name.startswith("bf16 dense") and "per-head" not in name:
+        if not any(name.startswith(e) for e in excluded):
             total_attr += ms_step
-    print(f"{'SUM (attributed, allheads attn)':54s} {'':9s} {'':4s} "
+    print(f"{'SUM (attributed, decode step)':54s} {'':9s} {'':4s} "
           f"{total_attr:8.3f}")
     ideal = 2 * 7.24e9 * B / 197e12 * 1e3
     print(f"roofline: {ideal:.1f} ms/step for {B} tok "

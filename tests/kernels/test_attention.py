@@ -1,7 +1,10 @@
 """Attention op tests: jnp implementations vs a numpy oracle that walks
 block tables in Python (mirrors the reference's
 ref_single_query_cached_kv_attention, tests/kernels/test_attention.py:45-99),
-plus the Pallas kernel in interpret mode vs the jnp reference."""
+plus the Pallas kernel in interpret mode vs the jnp reference.
+
+KV pages are TOKEN-MAJOR: [num_pages, page_size, Hkv * head_dim]
+(heads collapsed into lanes — see ops/kv_cache.py)."""
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -15,7 +18,8 @@ def numpy_paged_attention(q, k_pages, v_pages, block_tables, context_lens,
                           scale, alibi_slopes=None):
     """Oracle: per-sequence python loop over the block table."""
     batch, num_q_heads, dim = q.shape
-    num_kv_heads, _, page_size, _ = k_pages.shape
+    _, page_size, hd = k_pages.shape
+    num_kv_heads = hd // dim
     group = num_q_heads // num_kv_heads
     out = np.zeros_like(q, dtype=np.float32)
     for b in range(batch):
@@ -24,8 +28,8 @@ def numpy_paged_attention(q, k_pages, v_pages, block_tables, context_lens,
         for pos in range(ctx):
             page = block_tables[b][pos // page_size]
             off = pos % page_size
-            keys.append(k_pages[:, page, off])    # [Hkv, dim]
-            values.append(v_pages[:, page, off])
+            keys.append(k_pages[page, off].reshape(num_kv_heads, dim))
+            values.append(v_pages[page, off].reshape(num_kv_heads, dim))
         keys = np.stack(keys, axis=1)     # [Hkv, ctx, dim]
         values = np.stack(values, axis=1)
         for h in range(num_q_heads):
@@ -43,10 +47,10 @@ def make_problem(batch=3, num_q_heads=4, num_kv_heads=2, dim=32,
                  pages=16, page_size=4, pages_per_seq=8, seed=0):
     rng = np.random.default_rng(seed)
     q = rng.normal(size=(batch, num_q_heads, dim)).astype(np.float32)
-    k_pages = rng.normal(size=(num_kv_heads, pages, page_size,
-                               dim)).astype(np.float32)
-    v_pages = rng.normal(size=(num_kv_heads, pages, page_size,
-                               dim)).astype(np.float32)
+    k_pages = rng.normal(size=(pages, page_size,
+                               num_kv_heads * dim)).astype(np.float32)
+    v_pages = rng.normal(size=(pages, page_size,
+                               num_kv_heads * dim)).astype(np.float32)
     context_lens = rng.integers(1, pages_per_seq * page_size,
                                 size=(batch, )).astype(np.int32)
     block_tables = np.zeros((batch, pages_per_seq), dtype=np.int32)
@@ -84,9 +88,12 @@ def test_paged_decode_ref_alibi():
 
 
 @pytest.mark.parametrize("num_q_heads,num_kv_heads,pages_per_chunk",
-                         [(4, 4, 2), (4, 2, 4), (8, 1, 8), (8, 2, 1)])
-def test_pallas_decode_matches_ref(num_q_heads, num_kv_heads,
-                                   pages_per_chunk):
+                         [(4, 4, 2), (4, 2, 4), (8, 1, 8), (8, 2, 1),
+                          (32, 8, 4), (32, 32, 4), (12, 12, 2)])
+def test_pallas_decode_matches_oracle(num_q_heads, num_kv_heads,
+                                      pages_per_chunk):
+    """The token-major kernel across GQA/MHA/head-block shapes
+    (hb = 8 for H=8/32, hb = 6 for H=12, hb = H for small H)."""
     q, k_pages, v_pages, bt, ctx = make_problem(num_q_heads=num_q_heads,
                                                 num_kv_heads=num_kv_heads,
                                                 dim=128, page_size=8,
@@ -103,7 +110,7 @@ def test_pallas_decode_matches_ref(num_q_heads, num_kv_heads,
 
 
 def test_pallas_decode_short_context():
-    """ctx=1 (single token) exercises the single-chunk path."""
+    """ctx=1 (single token) exercises the masked single-page case."""
     q, k_pages, v_pages, bt, ctx = make_problem(dim=128, page_size=8,
                                                 pages_per_seq=8, pages=32)
     ctx = np.ones_like(ctx)
@@ -113,6 +120,30 @@ def test_pallas_decode_short_context():
                                  jnp.array(ctx), scale=0.1,
                                  pages_per_chunk=2, interpret=True)
     np.testing.assert_allclose(np.array(got), expected, rtol=2e-3, atol=2e-3)
+
+
+def test_pallas_decode_single_chunk_cross_cell():
+    """pages_per_seq == pages_per_chunk triggers the cross-cell
+    prefetch pipeline; ctx == 0 rows must stay zero (their DMAs are
+    started by the previous cell and must still be waited)."""
+    q, k_pages, v_pages, bt, ctx = make_problem(batch=5, num_q_heads=8,
+                                                num_kv_heads=2, dim=128,
+                                                page_size=8,
+                                                pages_per_seq=8, pages=32)
+    ctx = ctx.copy()
+    ctx[1] = 0
+    expected = numpy_paged_attention(q, k_pages, v_pages, bt,
+                                     np.maximum(ctx, 1), 0.1)
+    expected[1] = 0.0
+    got = paged_decode_attention(jnp.array(q), jnp.array(k_pages),
+                                 jnp.array(v_pages), jnp.array(bt),
+                                 jnp.array(ctx), scale=0.1,
+                                 pages_per_chunk=8, interpret=True)
+    got = np.array(got)
+    np.testing.assert_allclose(got[1], 0.0, atol=1e-6)
+    mask = np.arange(len(ctx)) != 1
+    np.testing.assert_allclose(got[mask], expected[mask], rtol=2e-3,
+                               atol=2e-3)
 
 
 def numpy_prefill(q, k, v, context_lens, kv_valid, scale, window=None,
@@ -186,32 +217,9 @@ def test_prefill_with_prefix_context():
                                atol=2e-5)
 
 
-@pytest.mark.parametrize("num_q_heads,num_kv_heads,pages_per_chunk",
-                         [(4, 4, 2), (4, 2, 4), (8, 1, 8), (8, 2, 1),
-                          (32, 8, 4)])
-def test_pallas_decode_allheads_matches_oracle(num_q_heads, num_kv_heads,
-                                               pages_per_chunk):
-    from aphrodite_tpu.ops.pallas.paged_attention import (
-        paged_decode_attention_allheads)
-    q, k_pages, v_pages, bt, ctx = make_problem(num_q_heads=num_q_heads,
-                                                num_kv_heads=num_kv_heads,
-                                                dim=128, page_size=8,
-                                                pages_per_seq=8, pages=32)
-    scale = 1.0 / np.sqrt(128)
-    expected = numpy_paged_attention(q, k_pages, v_pages, bt, ctx, scale)
-    got = paged_decode_attention_allheads(
-        jnp.array(q), jnp.array(k_pages), jnp.array(v_pages),
-        jnp.array(bt), jnp.array(ctx), scale=scale,
-        pages_per_chunk=pages_per_chunk, interpret=True)
-    np.testing.assert_allclose(np.array(got), expected, rtol=2e-3,
-                               atol=2e-3)
-
-
 def test_pallas_decode_int8_kv_scale():
     """int8 KV pages with the scale folded into score/epilogue must
     match the float oracle on the dequantized values."""
-    from aphrodite_tpu.ops.pallas.paged_attention import (
-        paged_decode_attention, paged_decode_attention_allheads)
     q, k_pages, v_pages, bt, ctx = make_problem(num_q_heads=8,
                                                 num_kv_heads=2,
                                                 dim=128, page_size=8,
@@ -223,19 +231,16 @@ def test_pallas_decode_int8_kv_scale():
     expected = numpy_paged_attention(q, k_int.astype(np.float32) * S,
                                      v_int.astype(np.float32) * S,
                                      bt, ctx, scale)
-    for fn in (paged_decode_attention, paged_decode_attention_allheads):
-        got = fn(jnp.array(q), jnp.array(k_int), jnp.array(v_int),
-                 jnp.array(bt), jnp.array(ctx), scale=scale, kv_scale=S,
-                 pages_per_chunk=4, interpret=True)
-        np.testing.assert_allclose(np.array(got), expected, rtol=2e-3,
-                                   atol=2e-3)
+    got = paged_decode_attention(
+        jnp.array(q), jnp.array(k_int), jnp.array(v_int),
+        jnp.array(bt), jnp.array(ctx), scale=scale, kv_scale=S,
+        pages_per_chunk=4, interpret=True)
+    np.testing.assert_allclose(np.array(got), expected, rtol=2e-3,
+                               atol=2e-3)
 
 
-@pytest.mark.parametrize("kernel_name", ["v1", "allheads"])
-def test_pallas_decode_alibi(kernel_name):
+def test_pallas_decode_alibi():
     """In-kernel ALiBi bias matches the numpy oracle."""
-    from aphrodite_tpu.ops.pallas.paged_attention import (
-        paged_decode_attention, paged_decode_attention_allheads)
     q, k_pages, v_pages, bt, ctx = make_problem(num_q_heads=8,
                                                 num_kv_heads=2,
                                                 dim=128, page_size=8,
@@ -245,11 +250,12 @@ def test_pallas_decode_alibi(kernel_name):
     scale = 1.0 / np.sqrt(128)
     expected = numpy_paged_attention(q, k_pages, v_pages, bt, ctx, scale,
                                      alibi_slopes=slopes)
-    fn = paged_decode_attention if kernel_name == "v1" else \
-        paged_decode_attention_allheads
-    got = fn(jnp.array(q), jnp.array(k_pages), jnp.array(v_pages),
-             jnp.array(bt), jnp.array(ctx), jnp.array(slopes),
-             scale=scale, pages_per_chunk=4, interpret=True)
+    got = paged_decode_attention(jnp.array(q), jnp.array(k_pages),
+                                 jnp.array(v_pages),
+                                 jnp.array(bt), jnp.array(ctx),
+                                 jnp.array(slopes),
+                                 scale=scale, pages_per_chunk=4,
+                                 interpret=True)
     np.testing.assert_allclose(np.array(got), expected, rtol=2e-3,
                                atol=2e-3)
 
@@ -259,25 +265,35 @@ def test_pallas_decode_padded_head(d_true):
     """Head sizes below the 128-lane tile run with zero-padded pages
     (ops/kv_cache.padded_head_size): pad lanes are inert in scores and
     sliced off the output."""
-    from aphrodite_tpu.ops.pallas.paged_attention import (
-        paged_decode_attention, paged_decode_attention_allheads)
-    q, k_pages, v_pages, bt, ctx = make_problem(num_q_heads=8,
-                                                num_kv_heads=2,
-                                                dim=d_true, page_size=8,
-                                                pages_per_seq=8,
-                                                pages=32)
-    scale = 1.0 / np.sqrt(d_true)
-    expected = numpy_paged_attention(q, k_pages, v_pages, bt, ctx, scale)
     dp = 128
+    rng = np.random.default_rng(7)
+    batch, Hq, Hkv = 3, 8, 2
+    pages, page_size, pps = 32, 8, 8
+    q = rng.normal(size=(batch, Hq, d_true)).astype(np.float32)
+    k4 = rng.normal(size=(pages, page_size, Hkv, d_true)).astype(
+        np.float32)
+    v4 = rng.normal(size=(pages, page_size, Hkv, d_true)).astype(
+        np.float32)
+    ctx = rng.integers(1, pps * page_size, size=(batch,)).astype(np.int32)
+    bt = np.zeros((batch, pps), dtype=np.int32)
+    for b in range(batch):
+        n = -(-int(ctx[b]) // page_size)
+        bt[b, :n] = rng.choice(pages, n, replace=False)
+    scale = 1.0 / np.sqrt(d_true)
+    expected = numpy_paged_attention(
+        q, k4.reshape(pages, page_size, -1),
+        v4.reshape(pages, page_size, -1), bt, ctx, scale)
     qp = np.pad(q, ((0, 0), (0, 0), (0, dp - d_true)))
-    kp = np.pad(k_pages, ((0, 0), (0, 0), (0, 0), (0, dp - d_true)))
-    vp = np.pad(v_pages, ((0, 0), (0, 0), (0, 0), (0, dp - d_true)))
-    for fn in (paged_decode_attention, paged_decode_attention_allheads):
-        got = fn(jnp.array(qp), jnp.array(kp), jnp.array(vp),
-                 jnp.array(bt), jnp.array(ctx), scale=scale,
-                 pages_per_chunk=4, interpret=True)
-        np.testing.assert_allclose(np.array(got)[..., :d_true], expected,
-                                   rtol=2e-3, atol=2e-3)
+    kp = np.pad(k4, ((0, 0), (0, 0), (0, 0), (0, dp - d_true))).reshape(
+        pages, page_size, -1)
+    vp = np.pad(v4, ((0, 0), (0, 0), (0, 0), (0, dp - d_true))).reshape(
+        pages, page_size, -1)
+    got = paged_decode_attention(jnp.array(qp), jnp.array(kp),
+                                 jnp.array(vp), jnp.array(bt),
+                                 jnp.array(ctx), scale=scale,
+                                 pages_per_chunk=4, interpret=True)
+    np.testing.assert_allclose(np.array(got)[..., :d_true], expected,
+                               rtol=2e-3, atol=2e-3)
 
 
 def test_paged_attention_layer_pads_small_heads():
@@ -292,8 +308,8 @@ def test_paged_attention_layer_pads_small_heads():
     assert dp == 128
     page_size, num_pages = 8, 16
     layer = PagedAttention(H, d, d ** -0.5, num_kv_heads=Hkv)
-    k_pages = jnp.zeros((Hkv, num_pages, page_size, dp), jnp.float32)
-    v_pages = jnp.zeros((Hkv, num_pages, page_size, dp), jnp.float32)
+    k_pages = jnp.zeros((num_pages, page_size, Hkv * dp), jnp.float32)
+    v_pages = jnp.zeros((num_pages, page_size, Hkv * dp), jnp.float32)
 
     # Prefill 5 tokens, then decode 1: compare against the ref decode
     # over an unpadded cache.
@@ -314,12 +330,13 @@ def test_paged_attention_layer_pads_small_heads():
     out, k_pages, v_pages = layer(jnp.asarray(q), jnp.asarray(k),
                                   jnp.asarray(v), k_pages, v_pages, meta)
     assert out.shape == (B, seq, H * d)
-    assert k_pages.shape[-1] == dp
-    # Written pages hold the true values in the first d lanes, zeros in
-    # the pad lanes.
-    kp_np = np.asarray(k_pages)
+    assert k_pages.shape[-1] == Hkv * dp
+    # Written pages hold the true values in each head's first d lanes,
+    # zeros in the pad lanes.
+    kp_np = np.asarray(k_pages).reshape(num_pages, page_size, Hkv, dp)
     assert np.allclose(kp_np[..., d:], 0.0)
-    assert np.allclose(kp_np[0, 1, :seq, :d], k[0, :, :d], atol=1e-6)
+    k_true = k.reshape(B, seq, Hkv, d)
+    assert np.allclose(kp_np[1, :seq, :, :d], k_true[0], atol=1e-6)
 
     # Decode step matches the unpadded jnp reference.
     qd = rng.normal(size=(B, 1, H * d)).astype(np.float32) * 0.1
@@ -336,9 +353,16 @@ def test_paged_attention_layer_pads_small_heads():
                                     jnp.asarray(vd), k_pages, v_pages,
                                     meta_d)
     assert out_d.shape == (B, 1, H * d)
+    # Build unpadded pages for the reference.
+    kp_un = np.asarray(k_pages).reshape(
+        num_pages, page_size, Hkv, dp)[..., :d].reshape(
+        num_pages, page_size, -1)
+    vp_un = np.asarray(v_pages).reshape(
+        num_pages, page_size, Hkv, dp)[..., :d].reshape(
+        num_pages, page_size, -1)
     ref = paged_decode_attention_ref(
         jnp.asarray(qd.reshape(B, H, d)),
-        k_pages[..., :d], v_pages[..., :d],
+        jnp.asarray(kp_un), jnp.asarray(vp_un),
         jnp.asarray(tables), jnp.full((B,), seq + 1, jnp.int32),
         d ** -0.5)
     np.testing.assert_allclose(np.asarray(out_d).reshape(B, H, d),

@@ -1,5 +1,7 @@
 """KV-cache op tests vs numpy oracles (reference test model:
-tests/kernels/test_cache.py walks block tables in Python)."""
+tests/kernels/test_cache.py walks block tables in Python).
+
+Pages are token-major: [num_pages, page_size, HEADS * DIM]."""
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -12,8 +14,8 @@ HEADS, PAGES, PAGE_SIZE, DIM = 2, 8, 4, 8
 
 def make_pages(seed=0):
     rng = np.random.default_rng(seed)
-    k = rng.normal(size=(HEADS, PAGES, PAGE_SIZE, DIM)).astype(np.float32)
-    v = rng.normal(size=(HEADS, PAGES, PAGE_SIZE, DIM)).astype(np.float32)
+    k = rng.normal(size=(PAGES, PAGE_SIZE, HEADS * DIM)).astype(np.float32)
+    v = rng.normal(size=(PAGES, PAGE_SIZE, HEADS * DIM)).astype(np.float32)
     return jnp.array(k), jnp.array(v)
 
 
@@ -28,15 +30,15 @@ def test_write_to_kv_cache():
     new_k, new_v = write_to_kv_cache(jnp.array(key), jnp.array(value),
                                      k_pages, v_pages, jnp.array(slots))
 
-    expected_k = np.array(k_pages).reshape(HEADS, -1, DIM)
-    expected_v = np.array(v_pages).reshape(HEADS, -1, DIM)
+    expected_k = np.array(k_pages).reshape(-1, HEADS * DIM)
+    expected_v = np.array(v_pages).reshape(-1, HEADS * DIM)
     for i, slot in enumerate(slots[:-1]):  # last is OOB padding -> dropped
-        expected_k[:, slot] = key[i]
-        expected_v[:, slot] = value[i]
+        expected_k[slot] = key[i].reshape(-1)
+        expected_v[slot] = value[i].reshape(-1)
     np.testing.assert_allclose(
-        np.array(new_k), expected_k.reshape(HEADS, PAGES, PAGE_SIZE, DIM))
+        np.array(new_k), expected_k.reshape(PAGES, PAGE_SIZE, HEADS * DIM))
     np.testing.assert_allclose(
-        np.array(new_v), expected_v.reshape(HEADS, PAGES, PAGE_SIZE, DIM))
+        np.array(new_v), expected_v.reshape(PAGES, PAGE_SIZE, HEADS * DIM))
 
 
 def test_write_oob_dropped():
@@ -56,10 +58,10 @@ def test_copy_blocks():
     new_k, new_v = copy_blocks(k_pages, v_pages, src, dst)
     expected_k = np.array(k_pages)
     expected_v = np.array(v_pages)
-    expected_k[:, 6] = expected_k[:, 1]
-    expected_k[:, 7] = expected_k[:, 3]
-    expected_v[:, 6] = expected_v[:, 1]
-    expected_v[:, 7] = expected_v[:, 3]
+    expected_k[6] = expected_k[1]
+    expected_k[7] = expected_k[3]
+    expected_v[6] = expected_v[1]
+    expected_v[7] = expected_v[3]
     np.testing.assert_allclose(np.array(new_k), expected_k)
     np.testing.assert_allclose(np.array(new_v), expected_v)
 
@@ -68,11 +70,73 @@ def test_gather_pages():
     k_pages, _ = make_pages()
     tables = jnp.array([[2, 0, PAGES, PAGES], [5, 6, 7, PAGES]],
                        dtype=jnp.int32)
-    out = gather_pages(k_pages, tables)
+    out = gather_pages(k_pages, tables, HEADS)
     assert out.shape == (2, HEADS, 4 * PAGE_SIZE, DIM)
-    np.testing.assert_allclose(np.array(out[0, :, :PAGE_SIZE]),
-                               np.array(k_pages[:, 2]))
-    np.testing.assert_allclose(np.array(out[1, :, PAGE_SIZE:2 * PAGE_SIZE]),
-                               np.array(k_pages[:, 6]))
+    np.testing.assert_allclose(
+        np.array(out[0, :, :PAGE_SIZE]),
+        np.array(k_pages[2]).reshape(PAGE_SIZE, HEADS, DIM)
+        .transpose(1, 0, 2))
+    np.testing.assert_allclose(
+        np.array(out[1, :, PAGE_SIZE:2 * PAGE_SIZE]),
+        np.array(k_pages[6]).reshape(PAGE_SIZE, HEADS, DIM)
+        .transpose(1, 0, 2))
     # OOB-padded pages fill with zeros.
     np.testing.assert_allclose(np.array(out[0, :, 2 * PAGE_SIZE:]), 0.0)
+
+
+@pytest.mark.parametrize("distinct", [False, True])
+def test_pallas_writer_interpret(distinct):
+    """Token-major Pallas page writers (serialized window RMW and the
+    pipelined distinct-page variant) match the XLA scatter path."""
+    from aphrodite_tpu.ops.pallas.kv_write import write_kv_pages
+    rng = np.random.default_rng(5)
+    pages, page_size, hd = 8, 16, 2 * 128
+    k_pages = jnp.asarray(
+        rng.normal(size=(pages, page_size, hd)), jnp.float32)
+    v_pages = jnp.asarray(
+        rng.normal(size=(pages, page_size, hd)), jnp.float32)
+    num_tokens = 6
+    knew = jnp.asarray(rng.normal(size=(num_tokens, hd)), jnp.float32)
+    vnew = jnp.asarray(rng.normal(size=(num_tokens, hd)), jnp.float32)
+    if distinct:
+        # One token per page (the decode contract).
+        slots = np.array([0, 17, 39, 111, 64, pages * page_size],
+                         dtype=np.int32)
+    else:
+        slots = np.array([0, 17, 18, 127, 64, pages * page_size],
+                         dtype=np.int32)
+    got_k, got_v = write_kv_pages(knew, vnew, k_pages, v_pages,
+                                  jnp.asarray(slots),
+                                  distinct_pages=distinct,
+                                  interpret=True)
+    exp_k = np.array(k_pages).reshape(-1, hd)
+    exp_v = np.array(v_pages).reshape(-1, hd)
+    for i, s in enumerate(slots[:-1]):
+        exp_k[s] = knew[i]
+        exp_v[s] = vnew[i]
+    np.testing.assert_allclose(
+        np.array(got_k), exp_k.reshape(pages, page_size, hd))
+    np.testing.assert_allclose(
+        np.array(got_v), exp_v.reshape(pages, page_size, hd))
+
+
+def test_pallas_decode_writer_oob_first_and_last():
+    """OOB (padding) tokens at the pipeline edges must not deadlock or
+    corrupt: first, middle, and last positions padded."""
+    from aphrodite_tpu.ops.pallas.kv_write import write_kv_pages
+    rng = np.random.default_rng(6)
+    pages, page_size, hd = 6, 8, 128
+    k_pages = jnp.asarray(
+        rng.normal(size=(pages, page_size, hd)), jnp.float32)
+    num_tokens = 5
+    knew = jnp.asarray(rng.normal(size=(num_tokens, hd)), jnp.float32)
+    oob = pages * page_size
+    slots = np.array([oob, 9, oob, 33, oob], dtype=np.int32)
+    got_k, _ = write_kv_pages(knew, knew, k_pages, k_pages + 1,
+                              jnp.asarray(slots), distinct_pages=True,
+                              interpret=True)
+    exp_k = np.array(k_pages).reshape(-1, hd)
+    exp_k[9] = knew[1]
+    exp_k[33] = knew[3]
+    np.testing.assert_allclose(
+        np.array(got_k), exp_k.reshape(pages, page_size, hd))

@@ -9,6 +9,10 @@ from jax.sharding import Mesh
 
 
 def dense_causal(q, k, v, scale):
+    group = q.shape[2] // k.shape[2]
+    if group > 1:                       # GQA: broadcast kv heads
+        k = np.repeat(k, group, axis=2)
+        v = np.repeat(v, group, axis=2)
     s = np.einsum("bqhd,bkhd->bhqk", q.astype(np.float64),
                   k.astype(np.float64)) * scale
     n = q.shape[1]
@@ -31,6 +35,25 @@ def test_ring_matches_dense(n_dev, cpu_devices):
     v = rs.randn(b, seq, H, d).astype(np.float32) * 0.3
     scale = d ** -0.5
 
+    mesh = Mesh(np.asarray(jax.devices()[:n_dev]), ("sp",))
+    got = np.asarray(ring_prefill_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), mesh,
+        scale=scale))
+    want = dense_causal(q, k, v, scale)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_ring_gqa_rotates_kv_heads(cpu_devices):
+    """GQA: K/V carry Hkv heads around the ring (the group broadcast
+    lives in the score einsum) and results match dense GQA attention."""
+    from aphrodite_tpu.ops.ring_attention import ring_prefill_attention
+
+    rs = np.random.RandomState(3)
+    n_dev, b, seq, Hq, Hkv, d = 4, 2, 32, 8, 2, 16
+    q = rs.randn(b, seq, Hq, d).astype(np.float32) * 0.3
+    k = rs.randn(b, seq, Hkv, d).astype(np.float32) * 0.3
+    v = rs.randn(b, seq, Hkv, d).astype(np.float32) * 0.3
+    scale = d ** -0.5
     mesh = Mesh(np.asarray(jax.devices()[:n_dev]), ("sp",))
     got = np.asarray(ring_prefill_attention(
         jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), mesh,

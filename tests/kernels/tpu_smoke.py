@@ -24,7 +24,7 @@ def main() -> int:
         GPTQConfig, GPTQLinearMethod)
     from aphrodite_tpu.ops.attention import paged_decode_attention_ref
     from aphrodite_tpu.ops.pallas.paged_attention import (
-        paged_decode_attention, paged_decode_attention_allheads)
+        paged_decode_attention)
     from aphrodite_tpu.ops.pallas.quant_matmul import gptq_matmul
 
     rs = np.random.RandomState(0)
@@ -33,8 +33,8 @@ def main() -> int:
     # -- decode attention kernels, bf16 + int8 KV, alibi --
     Hq, Hkv, d, page, pps, pages, B = 32, 8, 128, 32, 4, 256, 24
     q = jnp.asarray(rs.randn(B, Hq, d) * 0.1, jnp.bfloat16)
-    kp = jnp.asarray(rs.randn(Hkv, pages, page, d) * 0.1, jnp.bfloat16)
-    vp = jnp.asarray(rs.randn(Hkv, pages, page, d) * 0.1, jnp.bfloat16)
+    kp = jnp.asarray(rs.randn(pages, page, Hkv * d) * 0.1, jnp.bfloat16)
+    vp = jnp.asarray(rs.randn(pages, page, Hkv * d) * 0.1, jnp.bfloat16)
     bt = jnp.asarray(rs.randint(0, pages, (B, pps)), jnp.int32)
     ctx_np = rs.randint(1, pps * page, (B,)).astype(np.int32)
     ctx_np[0] = 0          # padded row: single-chunk path must still
@@ -56,13 +56,11 @@ def main() -> int:
 
     ref = oracle(q, kp, vp, bt, ctx, scale)
 
-    for name, fn, ppc in (("v1", paged_decode_attention, 2),
-                          ("allheads", paged_decode_attention_allheads,
-                           2),
-                          ("allheads single-chunk",
-                           paged_decode_attention_allheads, 4)):
-        got = np.asarray(fn(q, kp, vp, bt, ctx, scale=scale,
-                            pages_per_chunk=ppc), np.float32)
+    for name, ppc in (("tokenmajor", 2),
+                      ("tokenmajor single-chunk", 4)):
+        got = np.asarray(paged_decode_attention(
+            q, kp, vp, bt, ctx, scale=scale,
+            pages_per_chunk=ppc), np.float32)
         check(f"{name} bf16", ref, got)
 
     S = 0.05
@@ -72,37 +70,38 @@ def main() -> int:
                    127).astype(jnp.int8)
     ref8 = oracle(q, kp8.astype(jnp.float32) * S,
                   vp8.astype(jnp.float32) * S, bt, ctx, scale)
-    got8 = np.asarray(paged_decode_attention_allheads(
+    got8 = np.asarray(paged_decode_attention(
         q, kp8, vp8, bt, ctx, scale=scale, kv_scale=S,
         pages_per_chunk=2), np.float32)
-    check("allheads int8 KV", ref8, got8)
+    check("tokenmajor int8 KV", ref8, got8)
 
     slopes = jnp.asarray([2.0 ** -(i / 4 + 1) for i in range(Hq)],
                          jnp.float32)
     refa = oracle(q, kp, vp, bt, ctx, scale, alibi_slopes=slopes)
-    gota = np.asarray(paged_decode_attention_allheads(
+    gota = np.asarray(paged_decode_attention(
         q, kp, vp, bt, ctx, slopes, scale=scale, pages_per_chunk=2),
         np.float32)
-    check("allheads alibi", refa, gota)
+    check("tokenmajor alibi", refa, gota)
 
     # -- head 64/80: padded-lane decode (pages pad head_dim to 128) --
     for d_true in (64, 80):
         dp = 128
         qs = jnp.asarray(rs.randn(B, Hq, d_true) * 0.1, jnp.bfloat16)
-        kps = jnp.asarray(rs.randn(Hkv, pages, page, d_true) * 0.1,
-                          jnp.bfloat16)
-        vps = jnp.asarray(rs.randn(Hkv, pages, page, d_true) * 0.1,
-                          jnp.bfloat16)
+        k4 = rs.randn(pages, page, Hkv, d_true) * 0.1
+        v4 = rs.randn(pages, page, Hkv, d_true) * 0.1
+        kps = jnp.asarray(k4.reshape(pages, page, -1), jnp.bfloat16)
+        vps = jnp.asarray(v4.reshape(pages, page, -1), jnp.bfloat16)
         pad3 = ((0, 0), (0, 0), (0, dp - d_true))
         pad4 = ((0, 0), (0, 0), (0, 0), (0, dp - d_true))
         refs = oracle(qs, kps, vps, bt, ctx, scale)
-        for name, fn in (("v1", paged_decode_attention),
-                         ("allheads", paged_decode_attention_allheads)):
-            got = np.asarray(fn(
-                jnp.pad(qs, pad3), jnp.pad(kps, pad4),
-                jnp.pad(vps, pad4), bt, ctx, scale=scale,
-                pages_per_chunk=2), np.float32)[..., :d_true]
-            check(f"{name} head{d_true} padded", refs, got)
+        kpp = jnp.asarray(np.pad(k4, pad4).reshape(pages, page, -1),
+                          jnp.bfloat16)
+        vpp = jnp.asarray(np.pad(v4, pad4).reshape(pages, page, -1),
+                          jnp.bfloat16)
+        got = np.asarray(paged_decode_attention(
+            jnp.pad(qs, pad3), kpp, vpp, bt, ctx, scale=scale,
+            pages_per_chunk=2), np.float32)[..., :d_true]
+        check(f"tokenmajor head{d_true} padded", refs, got)
 
     # -- fused GPTQ dequant matmul --
     bits, gs, K, N, m = 4, 128, 4096, 14336, 256

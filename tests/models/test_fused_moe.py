@@ -1,0 +1,88 @@
+"""FusedMoE dispatch tests: the ragged grouped-GEMM path
+(jax.lax.ragged_dot over token-sorted expert bins — the TPU analog of
+the reference's moe_align_block_size + fused expert GEMM,
+`triton_kernel/fused_moe.py:142,234`) must match the dense all-experts
+combine exactly, and the dense path stays for sharded/small configs."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from aphrodite_tpu.modeling.layers.fused_moe import FusedMoE
+
+
+def make_moe(num_experts, top_k, hidden=32, inter=48, seed=0):
+    rs = np.random.RandomState(seed)
+    moe = FusedMoE(num_experts, top_k, hidden, inter,
+                   dtype=jnp.float32)
+    params = {
+        "gate": jnp.asarray(rs.randn(hidden, num_experts) * 0.3,
+                            jnp.float32),
+        "w_gate": jnp.asarray(rs.randn(num_experts, hidden, inter) * 0.1,
+                              jnp.float32),
+        "w_up": jnp.asarray(rs.randn(num_experts, hidden, inter) * 0.1,
+                            jnp.float32),
+        "w_down": jnp.asarray(rs.randn(num_experts, inter, hidden) * 0.1,
+                              jnp.float32),
+    }
+    return moe, params
+
+
+@pytest.mark.parametrize("num_experts,top_k,tokens", [
+    (8, 2, 17),        # Mixtral shape: ragged path engages
+    (8, 2, 1),         # single token
+    (16, 4, 33),       # Deepseek-ish
+])
+def test_ragged_matches_dense(num_experts, top_k, tokens):
+    moe, params = make_moe(num_experts, top_k)
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.randn(tokens, 32) * 0.5, jnp.float32)
+
+    assert not moe.sharded
+    ragged = np.asarray(moe(params, x))        # default: ragged (E > 4)
+    moe.sharded = True
+    dense = np.asarray(moe(params, x))         # forced dense combine
+    np.testing.assert_allclose(ragged, dense, rtol=2e-5, atol=2e-5)
+
+
+def test_small_expert_count_uses_dense():
+    """E <= 4 keeps the dense combine (ragged overhead not worth it);
+    result sanity-checked against a python per-token loop."""
+    moe, params = make_moe(4, 2)
+    rs = np.random.RandomState(2)
+    x = rs.randn(5, 32).astype(np.float32) * 0.5
+    out = np.asarray(moe(params, jnp.asarray(x)))
+
+    gate_w = np.asarray(params["gate"])
+    logits = x @ gate_w
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    expected = np.zeros_like(x)
+    for t in range(x.shape[0]):
+        top = np.argsort(-probs[t])[:2]
+        w = probs[t][top] / probs[t][top].sum()
+        for e, we in zip(top, w):
+            g = x[t] @ np.asarray(params["w_gate"][e])
+            u = x[t] @ np.asarray(params["w_up"][e])
+            act = g / (1 + np.exp(-g)) * u
+            expected[t] += we * (act @ np.asarray(params["w_down"][e]))
+    np.testing.assert_allclose(out, expected, rtol=2e-4, atol=2e-4)
+
+
+def test_loader_marks_sharded(tmp_path):
+    """Under a tp mesh the loader flags every FusedMoE layer so the
+    GSPMD dense combine runs (ragged dispatch needs an all-to-all that
+    isn't built yet)."""
+    from aphrodite_tpu.modeling.loader import _mark_moe_sharded
+
+    class Block:
+        def __init__(self):
+            self.moe = FusedMoE(8, 2, 32, 48)
+
+    class Model:
+        def __init__(self):
+            self.layers = [Block(), Block()]
+
+    m = Model()
+    _mark_moe_sharded(m)
+    assert all(b.moe.sharded for b in m.layers)

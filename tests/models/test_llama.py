@@ -40,10 +40,10 @@ def make_caches(model, dtype=jnp.float32):
     head_dim = padded_head_size(
         cfg.hidden_size // cfg.num_attention_heads)
     return [
-        (jnp.zeros((cfg.num_key_value_heads, NUM_PAGES, PAGE_SIZE,
-                    head_dim), dtype=dtype),
-         jnp.zeros((cfg.num_key_value_heads, NUM_PAGES, PAGE_SIZE,
-                    head_dim), dtype=dtype))
+        (jnp.zeros((NUM_PAGES, PAGE_SIZE,
+                    cfg.num_key_value_heads * head_dim), dtype=dtype),
+         jnp.zeros((NUM_PAGES, PAGE_SIZE,
+                    cfg.num_key_value_heads * head_dim), dtype=dtype))
         for _ in range(cfg.num_hidden_layers)
     ]
 
