@@ -231,6 +231,7 @@ class AphroditeEngine:
         if self.model_config.get_sliding_window() is not None:
             return 1
         remaining = []
+        hard_cap = max_steps
         for md in seq_group_metadata_list:
             p = md.sampling_params
             if (len(md.seq_data) != 1 or p.use_beam_search
@@ -243,16 +244,34 @@ class AphroditeEngine:
             data = next(iter(md.seq_data.values()))
             if p.max_tokens is not None:
                 remaining.append(p.max_tokens - data.get_output_len())
-            remaining.append(self.scheduler_config.max_model_len -
-                             data.get_len())
-        want = max(1, min([max_steps] + remaining))
+            # Positions/pages must exist for EVERY burst step of EVERY
+            # sequence (the device loop walks the block table), so the
+            # model-length bound is a hard per-seq cap even though
+            # max_tokens is not (see overshoot below).
+            hard_cap = min(hard_cap,
+                           self.scheduler_config.max_model_len -
+                           data.get_len())
+        want = max(1, min(max_steps, hard_cap,
+                          max(remaining) if remaining else max_steps))
         if want <= 1:
             return 1
         # Bucket to powers of two: each burst length is its own compiled
-        # scan program, and compiles are expensive. Blocks reserved
-        # beyond the bucketed length stay on the sequences' block tables
-        # and satisfy the next round's reservation.
-        want = 1 << (want.bit_length() - 1)
+        # scan program, and compiles are expensive. Round UP when the
+        # overshoot is small (a finished group's extra tokens are
+        # dropped by _process_burst_outputs and its pages are reserved):
+        # e.g. 31 remaining runs one 32-burst instead of the
+        # 16+8+4+2+1 ladder of ever-worse per-step rates. Round DOWN
+        # when the waste would exceed the per-burst overhead (~2-3
+        # steps' worth of device time).
+        up = 1 << (want - 1).bit_length()
+        if up - want <= max(2, up // 8) and up <= max_steps and \
+                up <= hard_cap:
+            want = up
+        else:
+            want = 1 << (want.bit_length() - 1)
+        # Blocks reserved beyond the bucketed length stay on the
+        # sequences' block tables and satisfy the next round's
+        # reservation.
         granted = self.scheduler.reserve_decode_burst(
             seq_group_metadata_list, want - 1)
         return 1 << ((1 + granted).bit_length() - 1)

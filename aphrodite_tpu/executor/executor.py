@@ -157,10 +157,27 @@ class TPUExecutor:
             budget = _FALLBACK_CACHE_BYTES
         else:
             # Weights are already resident; reserve headroom for compiled
-            # programs + transient activations (at least 512 MB — prefill
-            # scratch at 7B scale needs it), then give the cache the
-            # configured fraction of the rest.
-            headroom = min(free // 2, 512 << 20)
+            # programs + transient activations, then give the cache the
+            # configured fraction of the rest. The dominant transient is
+            # the prefill round at max_num_batched_tokens: roughly the
+            # gate_up output + silu_mul + qkv/residual streams, ~1.5x
+            # overlap (measured: an 8192-token Mistral-7B round peaks
+            # ~1.1 GB; 512 MB headroom OOMed by exactly that delta).
+            cfg = self.model_config.hf_config
+            inter = getattr(cfg, "intermediate_size",
+                            4 * cfg.hidden_size)
+            tokens = self.scheduler_config.max_num_batched_tokens
+            act_bytes = int(tokens * (2 * inter + 4 * cfg.hidden_size) *
+                            2 * 1.5)
+            # MoE ragged dispatch materializes f32 gate/up/act tensors
+            # at [tokens * top_k, moe_inter] (layers/fused_moe.py) —
+            # for Mixtral shapes that dwarfs the dense estimate.
+            top_k = getattr(cfg, "num_experts_per_tok", 0)
+            if top_k:
+                moe_inter = getattr(cfg, "moe_intermediate_size", inter)
+                act_bytes = max(act_bytes, int(
+                    tokens * top_k * moe_inter * 4 * 3 * 1.2))
+            headroom = min(free // 2, max(512 << 20, act_bytes))
             budget = int((free - headroom) *
                          self.cache_config.gpu_memory_utilization)
             # The in-place KV scatter keeps a temp copy of one layer's

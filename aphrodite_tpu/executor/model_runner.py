@@ -289,11 +289,13 @@ class ModelRunner:
             plens[i] = n
             table = md.block_tables.get(seq_id, [])
             tables[i, :len(table)] = table
-            for j in range(n):
-                abs_pos = ctx + j
-                page = table[abs_pos // self.page_size]
-                slots[i * padded_len + j] = (page * self.page_size +
-                                             abs_pos % self.page_size)
+            # Vectorized slot computation (a per-token Python loop here
+            # costs ~100 ms per 16k-token prefill round).
+            abs_pos = np.arange(ctx, ctx + n)
+            table_arr = np.asarray(table, dtype=np.int64)
+            slots[i * padded_len:i * padded_len + n] = (
+                table_arr[abs_pos // self.page_size] * self.page_size +
+                abs_pos % self.page_size)
             # Sampler rows: all prompt positions if prompt_logprobs else
             # just the last (reference _prepare_sample, :372-451).
             if md.sampling_params.prompt_logprobs is not None:
@@ -516,14 +518,27 @@ class ModelRunner:
 
         ids, pos, meta = (inputs["input_ids"], inputs["positions"],
                           inputs["metadata"])
+        import os as _os
+        import time as _time
+        timing = _os.environ.get("APHRODITE_BURST_TIMING")
+        t0 = _time.perf_counter() if timing else 0.0
         packed, kv_caches = self._burst_scan_fn(
             params, ids, pos, kv_caches, meta, tensors, bases, salt1,
             salt2, greedy_mask, num_steps=num_steps,
             max_best_of=plan.max_best_of, num_topk=plan.num_topk)
+        t1 = _time.perf_counter() if timing else 0.0
 
         all_packed = np.asarray(packed)                    # ONE sync
+        t2 = _time.perf_counter() if timing else 0.0
         outputs = [
             self.sampler.finalize(sampling, plan, all_packed[t], None)
             for t in range(num_steps)
         ]
+        if timing:
+            t3 = _time.perf_counter()
+            print(f"[burst {num_steps} steps] dispatch "
+                  f"{(t1 - t0) * 1e3:.0f} ms, device+sync "
+                  f"{(t2 - t1) * 1e3:.0f} ms "
+                  f"({(t2 - t1) / num_steps * 1e3:.1f}/step), finalize "
+                  f"{(t3 - t2) * 1e3:.0f} ms", flush=True)
         return outputs, kv_caches

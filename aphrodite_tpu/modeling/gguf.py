@@ -394,13 +394,64 @@ def _reverse_hf_permute(w: np.ndarray, n_heads: int) -> np.ndarray:
             .reshape(rows, cols))
 
 
-def gguf_weights_iterator(path: str) -> Iterator[Tuple[str, np.ndarray]]:
-    """Yield (hf_name, float numpy tensor) for every tensor in the file,
-    dequantizing block formats on the fly."""
+class RawGGUF:
+    """A still-quantized tensor handed to GGUFLinearMethod: the packed
+    ggml blocks plus enough metadata to repack for the at-rest Pallas
+    matmuls (layers/quantization/gguf.py)."""
+
+    __slots__ = ("type_name", "blocks", "shape")
+
+    def __init__(self, type_name: str, blocks: np.ndarray,
+                 shape: Tuple[int, int]) -> None:
+        self.type_name = type_name
+        self.blocks = blocks          # [n_blocks, bytes_per_block] u8
+        self.shape = shape            # (out_features, in_features)
+
+
+# ggml formats the at-rest kernels handle; weight name fragments that
+# route through a LinearMethod (projection matmuls only — embeddings,
+# norms, lm_head always dequantize).
+_AT_REST_TYPES = ("Q4_K", "Q8_0")
+_PROJ_FRAGMENTS = ("q_proj", "k_proj", "v_proj", "o_proj",
+                   "gate_proj", "up_proj", "down_proj")
+# Shards merged into one matmul must agree on representation: a merged
+# layer can't be half packed, half dense (apply() dispatches on the
+# bucket's param names). llama.cpp mixes types inside qkv (attn_v is
+# often Q6_K in Q4_K_M files), so at-rest routing is per GROUP.
+_STACKED_SIBLINGS = {
+    "q_proj": ("q_proj", "k_proj", "v_proj"),
+    "k_proj": ("q_proj", "k_proj", "v_proj"),
+    "v_proj": ("q_proj", "k_proj", "v_proj"),
+    "gate_proj": ("gate_proj", "up_proj"),
+    "up_proj": ("gate_proj", "up_proj"),
+}
+
+
+def gguf_weights_iterator(path: str, at_rest: bool = False
+                          ) -> Iterator[Tuple[str, np.ndarray]]:
+    """Yield (hf_name, tensor) for every tensor in the file. Block
+    formats dequantize on the fly; with `at_rest`, Q4_K/Q8_0 projection
+    weights instead yield RawGGUF packed blocks for the quantized
+    execution path."""
     reader = GGUFReader(path)
     n_heads = int(reader.fields.get("llama.attention.head_count", 0))
     n_kv = int(reader.fields.get("llama.attention.head_count_kv",
                                  n_heads))
+
+    type_of = {}
+    for info in reader.tensors:
+        try:
+            type_of[_hf_name(info.name)] = GGML_TYPES[info.ggml_type][0]
+        except ValueError:
+            pass
+
+    def group_at_rest(name: str, frag: str) -> bool:
+        """Every sibling merged into the same matmul must be an
+        at-rest type AND the same type (one packed form per bucket)."""
+        sibs = _STACKED_SIBLINGS.get(frag, (frag,))
+        types = {type_of.get(name.replace(frag, s)) for s in sibs}
+        return len(types) == 1 and types <= set(_AT_REST_TYPES)
+
     for info in reader.tensors:
         try:
             name = _hf_name(info.name)
@@ -409,12 +460,45 @@ def gguf_weights_iterator(path: str) -> Iterator[Tuple[str, np.ndarray]]:
             # carry no model weights.
             logger.debug("Skipping GGUF tensor %s", info.name)
             continue
+        tname, block, bpb = GGML_TYPES[info.ggml_type]
+        frag = next((f for f in _PROJ_FRAGMENTS if f".{f}." in name),
+                    None)
+        if (at_rest and tname in _AT_REST_TYPES and
+                len(info.shape) == 2 and frag is not None and
+                group_at_rest(name, frag)):
+            with open(reader.path, "rb") as f:
+                f.seek(reader.data_start + info.offset)
+                raw = np.frombuffer(f.read(info.n_bytes), np.uint8)
+            blocks = raw.reshape(-1, bpb)
+            out_f, in_f = info.shape
+            if name.endswith("self_attn.q_proj.weight") and n_heads:
+                blocks = _permute_raw_rows(blocks, out_f, in_f, block,
+                                           n_heads)
+            elif name.endswith("self_attn.k_proj.weight") and n_kv:
+                blocks = _permute_raw_rows(blocks, out_f, in_f, block,
+                                           n_kv)
+            yield name, RawGGUF(tname, blocks, (out_f, in_f))
+            continue
         arr = reader.load(info)
         if name.endswith("self_attn.q_proj.weight") and n_heads:
             arr = _reverse_hf_permute(arr, n_heads)
         elif name.endswith("self_attn.k_proj.weight") and n_kv:
             arr = _reverse_hf_permute(arr, n_kv)
         yield name, arr
+
+
+def _permute_raw_rows(blocks: np.ndarray, out_f: int, in_f: int,
+                      block_elems: int, n_heads: int) -> np.ndarray:
+    """Apply _reverse_hf_permute's OUT-row permutation to packed blocks:
+    blocks are row-major over [out, in/block], so permuting out rows
+    permutes whole groups of in/block blocks."""
+    per_row = in_f // block_elems
+    b = blocks.reshape(out_f, per_row, blocks.shape[1])
+    b = (b.reshape(n_heads, out_f // n_heads // 2, 2, per_row,
+                   blocks.shape[1])
+         .swapaxes(1, 2)
+         .reshape(out_f, per_row, blocks.shape[1]))
+    return np.ascontiguousarray(b.reshape(-1, blocks.shape[1]))
 
 
 def extract_gguf_config(path: str):
@@ -429,7 +513,11 @@ def extract_gguf_config(path: str):
     cfg = {
         "architectures": ["LlamaForCausalLM"],
         "model_type": "llama",
-        "vocab_size": len(f["tokenizer.ggml.tokens"]),
+        # tokenizer-less files (tests, raw conversions) fall back to
+        # llama.vocab_size.
+        "vocab_size": (len(f["tokenizer.ggml.tokens"])
+                       if "tokenizer.ggml.tokens" in f
+                       else int(f["llama.vocab_size"])),
         "hidden_size": int(f["llama.embedding_length"]),
         "intermediate_size": int(f["llama.feed_forward_length"]),
         "max_position_embeddings": int(f["llama.context_length"]),

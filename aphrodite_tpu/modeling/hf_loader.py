@@ -180,15 +180,19 @@ def _np_cache_iterator(model_path: str
 def hf_model_weights_iterator(
     model_path: str,
     load_format: str = "auto",
+    gguf_at_rest: bool = False,
 ) -> Iterator[Tuple[str, np.ndarray]]:
     """Yield (name, numpy array) for every checkpoint tensor
     (reference `hf_downloader.py:285-352`)."""
     model_path = resolve_model_path(model_path)
     if model_path.endswith(".gguf") and os.path.isfile(model_path):
-        # GGUF single-file checkpoint: dequantize blocks at load
-        # (reference `hf_downloader.py:293-295`).
+        # GGUF single-file checkpoint: with quantization="gguf" the
+        # Q4_K/Q8_0 projections stay packed (RawGGUF) for the at-rest
+        # kernels; everything else dequantizes at load (reference
+        # `hf_downloader.py:293-295`).
         from aphrodite_tpu.modeling.gguf import gguf_weights_iterator
-        yield from gguf_weights_iterator(model_path)
+        yield from gguf_weights_iterator(model_path,
+                                         at_rest=gguf_at_rest)
         return
 
     has_safetensors = bool(glob.glob(os.path.join(model_path,

@@ -205,8 +205,24 @@ class MergedColumnParallelLinear(_ShardedLoadMixin, ColumnParallelLinear):
     def weight_loader(self, params: Dict[str, np.ndarray], name: str,
                       hf_tensor: np.ndarray, shard_id=None) -> None:
         converted = self.linear_method.load_weight(params, name, hf_tensor)
+        # Methods may store under a different param name (GGUF's raw
+        # blocks repack into qweight/qs) — same contract as
+        # LinearBase.weight_loader.
+        rename = getattr(self.linear_method, "pending_rename", None)
+        if rename:
+            name = rename
+            self.linear_method.pending_rename = None
         if shard_id is None:
+            # Whole-tensor load (pre-fused checkpoints): the sidecar
+            # params are whole too — store them directly, don't leave
+            # them pending (they'd leak into the NEXT layer's shard
+            # placement).
             params[name] = converted
+            sidecar = getattr(self.linear_method, "pending_sidecar",
+                              None)
+            if sidecar:
+                params.update(sidecar)
+                self.linear_method.pending_sidecar = None
             return
         offset = sum(self.output_sizes[:shard_id])
         self._write_with_sidecar(params, name, converted,
@@ -243,8 +259,20 @@ class QKVParallelLinear(_ShardedLoadMixin, ColumnParallelLinear):
     def weight_loader(self, params: Dict[str, np.ndarray], name: str,
                       hf_tensor: np.ndarray, shard_id=None) -> None:
         converted = self.linear_method.load_weight(params, name, hf_tensor)
+        rename = getattr(self.linear_method, "pending_rename", None)
+        if rename:
+            name = rename
+            self.linear_method.pending_rename = None
         if shard_id is None:
+            # Whole-tensor load (fused qkv checkpoints, e.g. GPT-NeoX):
+            # consume the sidecar here too — see
+            # MergedColumnParallelLinear.weight_loader.
             params[name] = converted
+            sidecar = getattr(self.linear_method, "pending_sidecar",
+                              None)
+            if sidecar:
+                params.update(sidecar)
+                self.linear_method.pending_sidecar = None
             return
         offset, size = self.shard_offsets()[shard_id]
         self._write_with_sidecar(params, name, converted, offset, size)

@@ -16,6 +16,7 @@ from typing import Type
 from aphrodite_tpu.modeling.layers.quantization.awq import AWQConfig
 from aphrodite_tpu.modeling.layers.quantization.base_config import (
     QuantizationConfig)
+from aphrodite_tpu.modeling.layers.quantization.gguf import GGUFConfig
 from aphrodite_tpu.modeling.layers.quantization.gptq import GPTQConfig
 from aphrodite_tpu.modeling.layers.quantization.int8 import Int8Config
 from aphrodite_tpu.modeling.layers.quantization.quip import QuipConfig
@@ -24,6 +25,7 @@ from aphrodite_tpu.modeling.layers.quantization.squeezellm import (
 
 _QUANTIZATION_CONFIG_REGISTRY = {
     "awq": AWQConfig,
+    "gguf": GGUFConfig,
     "gptq": GPTQConfig,
     "squeezellm": SqueezeLLMConfig,
     "int8": Int8Config,

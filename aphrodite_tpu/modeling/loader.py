@@ -83,8 +83,9 @@ def get_model(model_config: ModelConfig,
             params = shard_params(host, model.param_specs(), mesh, dtype)
         return model, params
 
-    weights_iter = hf_model_weights_iterator(model_config.model,
-                                             model_config.load_format)
+    weights_iter = hf_model_weights_iterator(
+        model_config.model, model_config.load_format,
+        gguf_at_rest=model_config.quantization == "gguf")
     params_np = model.load_weights(weights_iter)
     if lora_config is not None:
         _add_empty_lora_params(model, params_np)

@@ -232,9 +232,12 @@ class PhiForCausalLM:
                                                shard_id)
                     break
             else:
-                if name.endswith((".weight", ".bias")):
-                    key, pname = name.rsplit(".", 1)
-                    if key in loaders:
-                        loaders[key].weight_loader(bucket(key), pname,
-                                                   tensor)
+                # Any param of a known linear loads (quantized
+                # checkpoints carry qweight/qzeros/scales/g_idx — a
+                # ".weight" suffix gate silently dropped them for
+                # non-stacked projections).
+                key, pname = name.rsplit(".", 1)
+                if key in loaders:
+                    loaders[key].weight_loader(bucket(key), pname,
+                                               tensor)
         return params

@@ -74,6 +74,28 @@ def plane_permutation(K: int, block_k: int, bits: int) -> np.ndarray:
     return (blocks + within[None, :]).reshape(-1)
 
 
+
+def _tile_mn(m: int, N: int, dtype, min_bn: int = 128):
+    """Shared M/N tile sizing for the dequant-matmul kernels:
+    (block_m, block_n, padded_m), honoring the APHRODITE_QMM_BLOCK_M/N
+    env knobs (A/B-tuned in round 2). min_bn is the kernel's smallest
+    legal lane tile (AWQ's plane unpack needs 1024)."""
+    import os
+    sublane = 16 if dtype == jnp.bfloat16 else 8
+    bm_cap = int(os.environ.get("APHRODITE_QMM_BLOCK_M", "512"))
+    bm_cap = max(sublane, bm_cap // sublane * sublane)
+    block_m = min(bm_cap, -(-m // sublane) * sublane)
+    bn_cap = int(os.environ.get("APHRODITE_QMM_BLOCK_N", "0")) or (
+        1024 if block_m >= 512 else 4096)
+    block_n = max((bn for bn in (2048, 1024, 512, 256, 128)
+                   if N % bn == 0), default=0)
+    if block_n < min_bn:
+        raise ValueError(f"{N=} must be a multiple of {min_bn}")
+    while block_n > min_bn and (block_n > bn_cap or N % block_n != 0):
+        block_n //= 2           # keep N % block_n == 0 under any cap
+    padded_m = -(-m // block_m) * block_m
+    return block_m, block_n, padded_m
+
 def _kernel(x_ref, qw_ref, z_ref, s_ref, o_ref, acc_ref, *,
             bits: int, k_tiles: int, group_size: int):
     """One (m, n, k) grid step: dequant a [block_k, block_n] weight tile
@@ -139,22 +161,10 @@ def gptq_matmul(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
     # small, so spend VMEM on big tiles — block_k spans several quant
     # groups (the kernel dequants each group chunk separately) and
     # block_n goes up to 2048 lanes.
-    import os
     block_k = gs
     while block_k < 512 and K % (block_k * 2) == 0:
         block_k *= 2
-    block_n = max(
-        (bn for bn in (2048, 1024, 512, 256, 128) if N % bn == 0),
-        key=lambda bn: bn)
-    sublane = 16 if x.dtype == jnp.bfloat16 else 8
-    bm_cap = int(os.environ.get("APHRODITE_QMM_BLOCK_M", "512"))
-    bm_cap = max(sublane, bm_cap // sublane * sublane)
-    block_m = min(bm_cap, -(-m // sublane) * sublane)
-    bn_cap = int(os.environ.get("APHRODITE_QMM_BLOCK_N", "0")) or (
-        1024 if block_m >= 512 else 4096)
-    while block_n > 128 and (block_n > bn_cap or N % block_n != 0):
-        block_n //= 2           # keep N % block_n == 0 under any cap
-    padded_m = -(-m // block_m) * block_m
+    block_m, block_n, padded_m = _tile_mn(m, N, x.dtype)
     # Plane-order unpack (see _unpack_planes): permute x's columns to
     # match — per GROUP, since the kernel unpacks each group chunk
     # separately. The permutation is exactly a blockwise [R, pack]
@@ -270,17 +280,7 @@ def awq_matmul(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
     block_k = gs
     while block_k < 512 and K % (block_k * 2) == 0:
         block_k *= 2
-    import os
-    bn_cap = int(os.environ.get("APHRODITE_QMM_BLOCK_N", "0")) or 2048
-    block_n = max((bn for bn in (2048, 1024) if N % bn == 0 and
-                   bn <= max(bn_cap, 1024)), default=None)
-    if block_n is None:
-        raise ValueError(f"{N=} must be a multiple of 1024")
-    sublane = 16 if x.dtype == jnp.bfloat16 else 8
-    bm_cap = int(os.environ.get("APHRODITE_QMM_BLOCK_M", "512"))
-    bm_cap = max(sublane, bm_cap // sublane * sublane)
-    block_m = min(bm_cap, -(-m // sublane) * sublane)
-    padded_m = -(-m // block_m) * block_m
+    block_m, block_n, padded_m = _tile_mn(m, N, x.dtype, min_bn=1024)
     if padded_m != m:
         x = jnp.pad(x, ((0, padded_m - m), (0, 0)))
 
@@ -334,6 +334,165 @@ def awq_matmul(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
     return y[:m] if padded_m != m else y
 
 
+# -------------------------------------------------- GGUF at-rest ----
+
+def _gguf_q4k_kernel(x_ref, qw_ref, dl_ref, ml_ref, o_ref, acc_ref, *,
+                     k_tiles: int):
+    """Q4_K-at-rest tile: codes packed GPTQ-style (8 nibbles along K),
+    dequant w = q * dl - ml with AFFINE rows per 32-row ggml group.
+    Unpacking runs per 128-row super-chunk (16 int32 rows — the aligned
+    sublane slice); the four 32-row groups inside land interleaved in
+    plane order, so their (dl, ml) rows are gathered with iota masks."""
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    block_k = qw_ref.shape[0] * 8
+    n_sg = dl_ref.shape[0]                   # ggml groups in this tile
+    chunks = []
+    for c in range(block_k // 128):          # 128-row super-chunks
+        q = _unpack_planes(qw_ref[c * 16:(c + 1) * 16], 4)  # [128, bn]
+        # plane-order row j holds original row (j % 16) * 8 + j // 16;
+        # its ggml group is orig // 32 in {0..3} within this chunk.
+        j = jax.lax.broadcasted_iota(jnp.int32, q.shape, 0)
+        sel = ((j % 16) * 8 + j // 16) // 32
+        dl = jnp.zeros(q.shape, jnp.float32)
+        ml = jnp.zeros(q.shape, jnp.float32)
+        for sg in range(4):
+            g = c * 4 + sg
+            if g >= n_sg:
+                break
+            dl = jnp.where(sel == sg,
+                           dl_ref[g].astype(jnp.float32), dl)
+            ml = jnp.where(sel == sg,
+                           ml_ref[g].astype(jnp.float32), ml)
+        chunks.append(
+            (q.astype(jnp.float32) * dl - ml).astype(x_ref.dtype))
+    w = chunks[0] if len(chunks) == 1 else \
+        jax.lax.concatenate(chunks, 0)
+    acc_ref[...] += jnp.dot(x_ref[...], w,
+                            preferred_element_type=jnp.float32)
+
+    @pl.when(k == k_tiles - 1)
+    def _flush():
+        o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+
+def gguf_q4k_supported(in_features: int, out_features: int) -> bool:
+    return (in_features % 256 == 0 and out_features % 128 == 0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def gguf_q4k_matmul(x: jax.Array, qweight: jax.Array, dl: jax.Array,
+                    ml: jax.Array, *,
+                    interpret: bool = False) -> jax.Array:
+    """y[m, N] = x[m, K] @ (q * dl - ml) with Q4_K codes at rest:
+    qweight [K//8, N] int32 (GPTQ plane packing along K), dl/ml
+    [K//32, N] (d*subscale, dmin*submin per ggml 32-row group). The
+    packed blocks never materialize as a dense matrix in HBM — the
+    reference's gguf_kernel.cu fuses dequant the same way."""
+    m, K = x.shape
+    N = qweight.shape[1]
+    G = K // 32
+    block_k = 512 if K % 512 == 0 else 256 if K % 256 == 0 else 128
+    block_m, block_n, padded_m = _tile_mn(m, N, x.dtype)
+    # Plane-order unpack per 128-row span -> same x column permutation
+    # as GPTQ at group_size 128.
+    R = 16
+    x = x.reshape(m, K // 128, R, 8).swapaxes(2, 3).reshape(m, K)
+    if padded_m != m:
+        x = jnp.pad(x, ((0, padded_m - m), (0, 0)))
+    k_tiles = K // block_k
+    grid = (padded_m // block_m, N // block_n, k_tiles)
+    gpt = block_k // 32                      # ggml groups per k-tile
+
+    out = pl.pallas_call(
+        functools.partial(_gguf_q4k_kernel, k_tiles=k_tiles),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_m, block_k), lambda i, n, k: (i, k)),
+            pl.BlockSpec((block_k // 8, block_n),
+                         lambda i, n, k: (k, n)),
+            pl.BlockSpec((gpt, 1, block_n), lambda i, n, k: (k, 0, n)),
+            pl.BlockSpec((gpt, 1, block_n), lambda i, n, k: (k, 0, n)),
+        ],
+        out_specs=pl.BlockSpec((block_m, block_n),
+                               lambda i, n, k: (i, n)),
+        out_shape=jax.ShapeDtypeStruct((padded_m, N), x.dtype),
+        scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(x, qweight, dl.reshape(G, 1, N), ml.reshape(G, 1, N))
+    return out[:m] if padded_m != m else out
+
+
+def _gguf_q8_kernel(x_ref, qs_ref, d_ref, o_ref, acc_ref, *,
+                    k_tiles: int):
+    """Q8_0-at-rest tile: int8 rows, scale per 32-row ggml group
+    (32-row sublane slices are exactly the int8 tile — aligned)."""
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    n_groups = d_ref.shape[0]
+    chunks = []
+    for g in range(n_groups):
+        q = qs_ref[g * 32:(g + 1) * 32].astype(jnp.float32)
+        chunks.append(
+            (q * d_ref[g].astype(jnp.float32)).astype(x_ref.dtype))
+    w = chunks[0] if n_groups == 1 else jax.lax.concatenate(chunks, 0)
+    acc_ref[...] += jnp.dot(x_ref[...], w,
+                            preferred_element_type=jnp.float32)
+
+    @pl.when(k == k_tiles - 1)
+    def _flush():
+        o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+
+def gguf_q8_supported(in_features: int, out_features: int) -> bool:
+    return in_features % 256 == 0 and out_features % 128 == 0
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def gguf_q8_matmul(x: jax.Array, qs: jax.Array, d: jax.Array, *,
+                   interpret: bool = False) -> jax.Array:
+    """y[m, N] = x[m, K] @ (int8 qs[K, N] * d[K//32, N]) with Q8_0
+    blocks at rest (per-32-row scales; HBM only reads int8 + scales)."""
+    m, K = x.shape
+    N = qs.shape[1]
+    G = K // 32
+    block_k = 512 if K % 512 == 0 else 256
+    block_m, block_n, padded_m = _tile_mn(m, N, x.dtype)
+    if padded_m != m:
+        x = jnp.pad(x, ((0, padded_m - m), (0, 0)))
+    k_tiles = K // block_k
+    grid = (padded_m // block_m, N // block_n, k_tiles)
+    gpt = block_k // 32
+
+    out = pl.pallas_call(
+        functools.partial(_gguf_q8_kernel, k_tiles=k_tiles),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_m, block_k), lambda i, n, k: (i, k)),
+            pl.BlockSpec((block_k, block_n), lambda i, n, k: (k, n)),
+            pl.BlockSpec((gpt, 1, block_n), lambda i, n, k: (k, 0, n)),
+        ],
+        out_specs=pl.BlockSpec((block_m, block_n),
+                               lambda i, n, k: (i, n)),
+        out_shape=jax.ShapeDtypeStruct((padded_m, N), x.dtype),
+        scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(x, qs, d.reshape(G, 1, N))
+    return out[:m] if padded_m != m else out
+
+
 # -------------------------------------------------------- int8 dense --
 
 def _int8_kernel(x_ref, w_ref, s_ref, o_ref, acc_ref, *, k_tiles: int):
@@ -365,24 +524,12 @@ def int8_matmul(x: jax.Array, weight: jax.Array, scales: jax.Array, *,
     """y[m, N] = (x[m, K] @ int8 weight[K, N]) * scales[N] with the
     weight read from HBM at int8 width (the XLA fallback's explicit
     astype may materialize a bf16 copy)."""
-    import os
     m, K = x.shape
     N = weight.shape[1]
     block_k = 256
     while block_k < 512 and K % (block_k * 2) == 0:
         block_k *= 2
-    block_n = max(
-        (bn for bn in (2048, 1024, 512, 256, 128) if N % bn == 0),
-        key=lambda bn: bn)
-    sublane = 16 if x.dtype == jnp.bfloat16 else 8
-    bm_cap = int(os.environ.get("APHRODITE_QMM_BLOCK_M", "512"))
-    bm_cap = max(sublane, bm_cap // sublane * sublane)
-    block_m = min(bm_cap, -(-m // sublane) * sublane)
-    bn_cap = int(os.environ.get("APHRODITE_QMM_BLOCK_N", "0")) or (
-        1024 if block_m >= 512 else 4096)
-    while block_n > 128 and (block_n > bn_cap or N % block_n != 0):
-        block_n //= 2
-    padded_m = -(-m // block_m) * block_m
+    block_m, block_n, padded_m = _tile_mn(m, N, x.dtype)
     if padded_m != m:
         x = jnp.pad(x, ((0, padded_m - m), (0, 0)))
     k_tiles = K // block_k

@@ -376,6 +376,15 @@ class Scheduler:
             if needed > free:
                 break
             granted = t
+        import os
+        if granted < max_extra and os.environ.get(
+                "APHRODITE_BURST_TIMING"):
+            need_full = sum(
+                self.block_manager.burst_blocks_needed(seq, max_extra)
+                for seq in seqs)
+            print(f"[burst reserve] want {max_extra} granted {granted}: "
+                  f"free {free} needed(full) {need_full} seqs "
+                  f"{len(seqs)} len0 {seqs[0].get_len()}", flush=True)
         if granted:
             for seq in seqs:
                 self.block_manager.reserve_slots(seq, granted)

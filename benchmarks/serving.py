@@ -101,9 +101,32 @@ async def run(args) -> dict:
     }
 
 
+def synthetic_7b_dir() -> str:
+    """Mistral-7B-shaped dummy config (bench.py's geometry) so the
+    serving artifact runs hermetically (zero egress)."""
+    import json as _json
+    import os
+    import tempfile
+    tmp = tempfile.mkdtemp(prefix="serving-7b-")
+    with open(os.path.join(tmp, "config.json"), "w") as f:
+        _json.dump({
+            "architectures": ["LlamaForCausalLM"],
+            "model_type": "llama", "vocab_size": 32000,
+            "hidden_size": 4096, "intermediate_size": 14336,
+            "num_hidden_layers": 32, "num_attention_heads": 32,
+            "num_key_value_heads": 8,
+            "max_position_embeddings": 4096, "rms_norm_eps": 1e-5,
+            "rope_theta": 10000.0, "tie_word_embeddings": False,
+            "torch_dtype": "bfloat16", "bos_token_id": 1,
+            "eos_token_id": 2}, f)
+    return tmp
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--model", required=True)
+    parser.add_argument("--model", required=True,
+                        help="path, or 'synthetic-7b' for the dummy "
+                             "Mistral-7B-shaped bench model")
     parser.add_argument("--load-format", default="auto")
     parser.add_argument("--dtype", default="bfloat16")
     parser.add_argument("--quantization", default=None)
@@ -117,6 +140,9 @@ def main() -> None:
     parser.add_argument("--prompt-len", type=int, default=128)
     parser.add_argument("--output-len", type=int, default=64)
     args = parser.parse_args()
+    if args.model == "synthetic-7b":
+        args.model = synthetic_7b_dir()
+        args.load_format = "dummy"
     print(json.dumps(asyncio.run(run(args))))
 
 

@@ -148,6 +148,45 @@ def main() -> int:
     if rel > 3e-2:
         failures.append(("awq", rel))
 
+    # -- GGUF at-rest matmuls (Q4_K affine, Q8_0 grouped int8) --
+    from aphrodite_tpu.modeling.layers.quantization.gguf import (
+        GGUFConfig, GGUFLinearMethod, q4k_to_kernel)
+    from aphrodite_tpu.ops.pallas.quant_matmul import (gguf_q4k_matmul,
+                                                       gguf_q8_matmul)
+    Kg, Ng, mg = 4096, 4096, 256
+    nblk = Ng * Kg // 256
+    blkb = np.zeros((nblk, 144), np.uint8)
+    dscale = (rs.rand(nblk).astype(np.float16) * 0.01 + 1e-3)
+    blkb[:, 0:2] = dscale.view(np.uint8).reshape(nblk, 2)
+    blkb[:, 2:4] = dscale.view(np.uint8).reshape(nblk, 2)
+    blkb[:, 4:16] = rs.randint(0, 256, (nblk, 12), dtype=np.uint8)
+    blkb[:, 16:144] = rs.randint(0, 256, (nblk, 128), dtype=np.uint8)
+    qwg, dlg, mlg = q4k_to_kernel(blkb, Ng, Kg)
+    gmethod = GGUFLinearMethod(GGUFConfig())
+    wg = gmethod.dequantize(
+        {"qweight": jnp.asarray(qwg), "dl": jnp.asarray(dlg),
+         "ml": jnp.asarray(mlg)}, jnp.bfloat16)
+    xg = jnp.asarray(rs.randn(mg, Kg), jnp.bfloat16)
+    refg = np.asarray(xg @ wg, np.float32)
+    gotg = np.asarray(gguf_q4k_matmul(
+        xg, jnp.asarray(qwg), jnp.asarray(dlg.astype(np.float32)),
+        jnp.asarray(mlg.astype(np.float32))), np.float32)
+    rel = np.abs(refg - gotg).max() / (np.abs(refg).max() + 1e-9)
+    print(f"gguf_q4k_matmul: rel err {rel:.2e}")
+    if rel > 3e-2:
+        failures.append(("gguf_q4k", rel))
+
+    qs8 = jnp.asarray(rs.randint(-128, 128, (Kg, Ng), dtype=np.int8))
+    dg8 = jnp.asarray(rs.rand(Kg // 32, Ng) * 0.01 + 1e-3, jnp.float32)
+    ref8m = np.asarray((xg.astype(jnp.float32) @
+                        (qs8.astype(jnp.float32) *
+                         jnp.repeat(dg8, 32, axis=0))), np.float32)
+    got8m = np.asarray(gguf_q8_matmul(xg, qs8, dg8), np.float32)
+    rel = np.abs(ref8m - got8m).max() / (np.abs(ref8m).max() + 1e-9)
+    print(f"gguf_q8_matmul: rel err {rel:.2e}")
+    if rel > 3e-2:
+        failures.append(("gguf_q8", rel))
+
     # -- int8 dense matmul --
     w8 = jnp.asarray(rs.randint(-128, 128, (K, N), dtype=np.int8))
     s8 = jnp.asarray(rs.rand(N) * 0.01 + 1e-3, jnp.float32)

@@ -1,0 +1,221 @@
+"""GGUF quantized-at-rest tests: the repacked kernel layouts must
+reproduce the numpy block-dequant oracles exactly (same tensors the
+round-2 load-time path produced), the Pallas matmuls must match the
+dense fallback, and a Q8_0 gguf file must serve end-to-end through the
+engine with quantization='gguf'. Reference:
+`kernels/quantization/gguf/gguf_kernel.cu` (blocks stay quantized in
+device memory; dequant fused into the matmul)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from aphrodite_tpu.modeling.gguf import (_deq_q4_k, _deq_q8_0, RawGGUF)
+from aphrodite_tpu.modeling.layers.quantization.gguf import (
+    GGUFConfig, GGUFLinearMethod, q4k_to_kernel, q8_0_to_kernel)
+
+rs = np.random.RandomState(11)
+
+
+def random_q4k_blocks(out_f, in_f):
+    """Valid random superblocks: finite small f16 d/dmin, random 6-bit
+    scales/mins and 4-bit codes (fully-random bytes yield NaN/inf f16
+    scales, which poison relative-error comparisons)."""
+    n = out_f * in_f // 256
+    blocks = np.zeros((n, 144), dtype=np.uint8)
+    d = (rs.rand(n).astype(np.float16) * 0.01 + 1e-3)
+    dmin = (rs.rand(n).astype(np.float16) * 0.01 + 1e-3)
+    blocks[:, 0:2] = d.view(np.uint8).reshape(n, 2)
+    blocks[:, 2:4] = dmin.view(np.uint8).reshape(n, 2)
+    blocks[:, 4:16] = rs.randint(0, 256, (n, 12), dtype=np.uint8)
+    blocks[:, 16:144] = rs.randint(0, 256, (n, 128), dtype=np.uint8)
+    return blocks
+
+
+def test_q4k_repack_matches_dequant_oracle():
+    out_f, in_f = 8, 512
+    blocks = random_q4k_blocks(out_f, in_f)
+    dense = _deq_q4_k(blocks).reshape(out_f, in_f)      # oracle [out, in]
+    qweight, dl, ml = q4k_to_kernel(blocks, out_f, in_f)
+    method = GGUFLinearMethod(GGUFConfig())
+    w = np.asarray(method.dequantize(
+        {"qweight": jnp.asarray(qweight), "dl": jnp.asarray(dl),
+         "ml": jnp.asarray(ml)}))
+    np.testing.assert_allclose(w, dense.T, rtol=1e-5, atol=1e-5)
+
+
+def test_q8_repack_matches_dequant_oracle():
+    out_f, in_f = 8, 256
+    n = out_f * in_f // 32
+    blocks = rs.randint(0, 256, (n, 34), dtype=np.uint8)
+    dense = _deq_q8_0(blocks).reshape(out_f, in_f)
+    qs, d = q8_0_to_kernel(blocks, out_f, in_f)
+    method = GGUFLinearMethod(GGUFConfig())
+    w = np.asarray(method.dequantize(
+        {"qs": jnp.asarray(qs), "d": jnp.asarray(d)}))
+    np.testing.assert_allclose(w, dense.T, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("K,N,m", [(512, 256, 5), (256, 512, 33)])
+def test_q4k_pallas_matmul_matches_dense(K, N, m):
+    from aphrodite_tpu.ops.pallas.quant_matmul import gguf_q4k_matmul
+    blocks = random_q4k_blocks(N, K)
+    qweight, dl, ml = q4k_to_kernel(blocks, N, K)
+    method = GGUFLinearMethod(GGUFConfig())
+    w = method.dequantize({"qweight": jnp.asarray(qweight),
+                           "dl": jnp.asarray(dl),
+                           "ml": jnp.asarray(ml)})
+    x = rs.randn(m, K).astype(np.float32)
+    ref = np.asarray(jnp.asarray(x) @ w)
+    got = np.asarray(gguf_q4k_matmul(
+        jnp.asarray(x), jnp.asarray(qweight),
+        jnp.asarray(dl.astype(np.float32)),
+        jnp.asarray(ml.astype(np.float32)), interpret=True))
+    rel = np.abs(ref - got).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 2e-5, rel
+
+
+@pytest.mark.parametrize("K,N,m", [(256, 256, 5), (512, 384, 16)])
+def test_q8_pallas_matmul_matches_dense(K, N, m):
+    from aphrodite_tpu.ops.pallas.quant_matmul import gguf_q8_matmul
+    qs = rs.randint(-128, 128, (K, N), dtype=np.int8)
+    d = (rs.rand(K // 32, N).astype(np.float32) * 0.01 + 1e-3)
+    x = rs.randn(m, K).astype(np.float32)
+    ref = (x @ (qs.astype(np.float32) *
+                np.repeat(d, 32, axis=0)))
+    got = np.asarray(gguf_q8_matmul(jnp.asarray(x), jnp.asarray(qs),
+                                    jnp.asarray(d), interpret=True))
+    rel = np.abs(ref - got).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 2e-5, rel
+
+
+def test_gguf_registered():
+    from aphrodite_tpu.modeling.layers.quantization import (
+        get_quantization_config_cls)
+    assert get_quantization_config_cls("gguf") is GGUFConfig
+
+
+def _write_tiny_q8_gguf(path, vocab=96, hidden=64, inter=96, layers=2,
+                        heads=4, kv=2):
+    """Tiny llama gguf with Q8_0 projection weights."""
+    from aphrodite_tpu.modeling.gguf import write_gguf
+    meta = {
+        "general.architecture": "llama",
+        "llama.embedding_length": hidden,
+        "llama.block_count": layers,
+        "llama.feed_forward_length": inter,
+        "llama.attention.head_count": heads,
+        "llama.attention.head_count_kv": kv,
+        "llama.attention.layer_norm_rms_epsilon": 1e-5,
+        "llama.rope.freq_base": 10000.0,
+        "llama.context_length": 256,
+        "llama.vocab_size": vocab,
+    }
+    t = {}
+    t["token_embd.weight"] = (rs.randn(vocab, hidden).astype(np.float32)
+                              * 0.05, "F32")
+    t["output.weight"] = (rs.randn(vocab, hidden).astype(np.float32)
+                          * 0.05, "F32")
+    t["output_norm.weight"] = (np.ones(hidden, np.float32), "F32")
+    hd = hidden // heads
+    for i in range(layers):
+        p = f"blk.{i}"
+        t[f"{p}.attn_norm.weight"] = (np.ones(hidden, np.float32), "F32")
+        t[f"{p}.ffn_norm.weight"] = (np.ones(hidden, np.float32), "F32")
+        for nm, rows in (("attn_q", hidden), ("attn_output", hidden),
+                         ("attn_k", kv * hd), ("attn_v", kv * hd)):
+            t[f"{p}.{nm}.weight"] = (
+                rs.randn(rows, hidden).astype(np.float32) * 0.05, "Q8_0")
+        t[f"{p}.ffn_gate.weight"] = (
+            rs.randn(inter, hidden).astype(np.float32) * 0.05, "Q8_0")
+        t[f"{p}.ffn_up.weight"] = (
+            rs.randn(inter, hidden).astype(np.float32) * 0.05, "Q8_0")
+        t[f"{p}.ffn_down.weight"] = (
+            rs.randn(hidden, inter).astype(np.float32) * 0.05, "Q8_0")
+    write_gguf(path, meta, t)
+
+
+def test_mixed_format_stacked_group_stays_dense(tmp_path):
+    """llama.cpp mixes types inside a merged projection (Q4_K_M stores
+    attn_v at Q6_K next to Q4_K attn_q/attn_k). A merged layer can't be
+    half packed — the whole sibling group must fall back to dense
+    (code-review r3: the v shard silently landed in a separate 'weight'
+    param and apply() returned zeros for it)."""
+    from aphrodite_tpu.modeling.gguf import (RawGGUF, write_gguf,
+                                             gguf_weights_iterator)
+    meta = {
+        "general.architecture": "llama",
+        "llama.embedding_length": 64, "llama.block_count": 1,
+        "llama.feed_forward_length": 96,
+        "llama.attention.head_count": 4,
+        "llama.attention.head_count_kv": 2,
+        "llama.context_length": 128, "llama.vocab_size": 64,
+    }
+    t = {
+        "token_embd.weight": (rs.randn(64, 64).astype(np.float32),
+                              "F32"),
+        "output.weight": (rs.randn(64, 64).astype(np.float32), "F32"),
+        "output_norm.weight": (np.ones(64, np.float32), "F32"),
+        "blk.0.attn_norm.weight": (np.ones(64, np.float32), "F32"),
+        "blk.0.ffn_norm.weight": (np.ones(64, np.float32), "F32"),
+        # q/k quantized, v NOT -> whole qkv group must come back dense
+        "blk.0.attn_q.weight": (rs.randn(64, 64).astype(np.float32),
+                                "Q8_0"),
+        "blk.0.attn_k.weight": (rs.randn(32, 64).astype(np.float32),
+                                "Q8_0"),
+        "blk.0.attn_v.weight": (rs.randn(32, 64).astype(np.float32),
+                                "F32"),
+        # o_proj alone and quantized -> at rest
+        "blk.0.attn_output.weight": (
+            rs.randn(64, 64).astype(np.float32), "Q8_0"),
+        # gate/up both quantized -> at rest
+        "blk.0.ffn_gate.weight": (rs.randn(96, 64).astype(np.float32),
+                                  "Q8_0"),
+        "blk.0.ffn_up.weight": (rs.randn(96, 64).astype(np.float32),
+                                "Q8_0"),
+        "blk.0.ffn_down.weight": (rs.randn(64, 96).astype(np.float32),
+                                  "F32"),
+    }
+    path = str(tmp_path / "mixed.gguf")
+    write_gguf(path, meta, t)
+    kinds = {name: type(arr).__name__
+             for name, arr in gguf_weights_iterator(path, at_rest=True)}
+    assert kinds["model.layers.0.self_attn.q_proj.weight"] == "ndarray"
+    assert kinds["model.layers.0.self_attn.k_proj.weight"] == "ndarray"
+    assert kinds["model.layers.0.self_attn.v_proj.weight"] == "ndarray"
+    assert kinds["model.layers.0.self_attn.o_proj.weight"] == "RawGGUF"
+    assert kinds["model.layers.0.mlp.gate_proj.weight"] == "RawGGUF"
+    assert kinds["model.layers.0.mlp.up_proj.weight"] == "RawGGUF"
+    assert kinds["model.layers.0.mlp.down_proj.weight"] == "ndarray"
+
+
+def test_engine_q8_at_rest_matches_load_dequant(tmp_path):
+    """Engine with quantization='gguf' (Q8_0 at rest) must produce the
+    same greedy tokens as the load-time-dequant path — on CPU both
+    reduce to mathematically identical dense matmuls."""
+    from aphrodite_tpu.common.sampling_params import SamplingParams
+    from aphrodite_tpu.endpoints.llm import LLM
+
+    gpath = str(tmp_path / "tiny-q8.gguf")
+    _write_tiny_q8_gguf(gpath)
+    sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+    prompt = [[5, 9, 11, 3, 7]]
+
+    def run(quant):
+        llm = LLM(model=gpath, load_format="auto", dtype="float32",
+                  max_model_len=128, max_num_seqs=2, swap_space=0.01,
+                  skip_tokenizer_init=True, quantization=quant,
+                  disable_log_stats=True)
+        if quant == "gguf":
+            # at-rest params really are packed (not dense weight)
+            params = llm.engine.executor.params
+            bucket = params["model.layers.0.self_attn.qkv_proj"]
+            assert "qs" in bucket and "d" in bucket, bucket.keys()
+            assert bucket["qs"].dtype == jnp.int8
+        out = llm.generate(prompt_token_ids=prompt, sampling_params=sp)
+        return out[0].outputs[0].token_ids
+
+    assert run("gguf") == run(None)
