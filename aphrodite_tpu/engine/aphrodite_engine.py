@@ -244,6 +244,11 @@ class AphroditeEngine:
             data = next(iter(md.seq_data.values()))
             if p.max_tokens is not None:
                 remaining.append(p.max_tokens - data.get_output_len())
+            else:
+                # Unbounded groups want the full burst; without this a
+                # co-batched short group's remaining would cap them via
+                # max(remaining).
+                remaining.append(max_steps)
             # Positions/pages must exist for EVERY burst step of EVERY
             # sequence (the device loop walks the block table), so the
             # model-length bound is a hard per-seq cap even though

@@ -169,6 +169,14 @@ class TPUExecutor:
             tokens = self.scheduler_config.max_num_batched_tokens
             act_bytes = int(tokens * (2 * inter + 4 * cfg.hidden_size) *
                             2 * 1.5)
+            # Quantized matmuls add XLA-side activation copies on top
+            # of the dense estimate. AWQ and GGUF-Q4K un-permute their
+            # OUTPUT columns ([tokens, 2*inter]-sized copies — AWQ at
+            # 8192-token prefill measured ~2.5 GB over the dense
+            # estimate); GPTQ only permutes x (small).
+            fudge = {"awq": 2.8, "gguf": 2.3}.get(
+                self.model_config.quantization, 1.0)
+            act_bytes = int(act_bytes * fudge)
             # MoE ragged dispatch materializes f32 gate/up/act tensors
             # at [tokens * top_k, moe_inter] (layers/fused_moe.py) —
             # for Mixtral shapes that dwarfs the dense estimate.

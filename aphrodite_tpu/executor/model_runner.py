@@ -305,6 +305,32 @@ class ModelRunner:
                 selected.append(i * padded_len + n - 1)
             sel_offset += n
 
+        # Page-writer cells: when every sequence's chunk starts on a
+        # page boundary and the padded length is page-aligned, prefill
+        # KV writes run as whole-page DMAs (one cell per (seq, page))
+        # instead of per-token read-modify-writes.
+        prefill_cells = None
+        ps = self.page_size
+        if padded_len % ps == 0 and \
+                all(int(c) % ps == 0 for c in ctx_lens[:batch]):
+            ppp = padded_len // ps               # pages per prompt
+            n_cells = padded_batch * ppp
+            pid = np.full((n_cells,), num_pages_oob, dtype=np.int32)
+            sblk = np.zeros((n_cells,), dtype=np.int32)
+            vld = np.zeros((n_cells,), dtype=np.int32)
+            for i, md in enumerate(seq_group_metadata_list):
+                seq_id = next(iter(md.seq_data))
+                table = md.block_tables.get(seq_id, [])
+                n = int(plens[i])
+                ctx_pages = int(ctx_lens[i]) // ps
+                for p in range(-(-n // ps)):
+                    cell = i * ppp + p
+                    pid[cell] = table[ctx_pages + p]
+                    sblk[cell] = (i * padded_len) // ps + p
+                    vld[cell] = min(n - p * ps, ps)
+            prefill_cells = (jnp.asarray(pid), jnp.asarray(sblk),
+                             jnp.asarray(vld))
+
         metadata = InputMetadata(
             slot_mapping=jnp.asarray(slots),
             block_tables=jnp.asarray(tables),
@@ -312,6 +338,7 @@ class ModelRunner:
             prompt_lens=jnp.asarray(plens),
             kv_scale=self.kv_scale,
             sp=self.sp,
+            prefill_cells=prefill_cells,
         )
         prompt_offsets = [int(c) for c in ctx_lens[:batch]]
         sampling = SamplingMetadata(

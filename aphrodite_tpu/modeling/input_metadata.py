@@ -32,6 +32,11 @@ class InputMetadata:
     context_lens: jax.Array
     # [batch] number of valid (non-pad) new tokens per sequence.
     prompt_lens: Optional[jax.Array] = None
+    # Prefill page-writer cell descriptors (page_ids, src_blocks,
+    # valids), one cell per (sequence, page) — present when the prompt
+    # layout is page-aligned so whole pages can be written without
+    # read-modify-write (ops/pallas/kv_write.write_kv_pages_prefill).
+    prefill_cells: Optional[tuple] = None
 
     is_prompt: bool = struct.field(pytree_node=False, default=False)
     # Prefill against a non-empty cached prefix (prefix caching / chunked

@@ -85,18 +85,30 @@ class PagedAttention:
                        (0, self.padded_head - self.head_size))
                 flat_k = jnp.pad(flat_k, pad)
                 flat_v = jnp.pad(flat_v, pad)
-            k_pages, v_pages = write_to_kv_cache(
-                flat_k, flat_v, k_pages, v_pages, metadata.slot_mapping,
-                kv_scale=metadata.kv_scale,
-                # Decode: one token per sequence, pages are
-                # sequence-exclusive -> the pipelined page writer is safe.
-                distinct_pages=not metadata.is_prompt)
             from aphrodite_tpu.ops.pallas.kv_write import (
-                can_use_pallas_writer)
-            if not (jax.default_backend() == "tpu" and
-                    can_use_pallas_writer(k_pages.dtype,
-                                          k_pages.shape[1],
-                                          k_pages.shape[2])):
+                can_use_pallas_writer, write_kv_pages_prefill)
+            hd = k_pages.shape[2]
+            pallas_write = (jax.default_backend() == "tpu" and
+                            can_use_pallas_writer(k_pages.dtype,
+                                                  k_pages.shape[1], hd))
+            if (pallas_write and metadata.is_prompt and
+                    metadata.prefill_cells is not None):
+                # Page-aligned prompt chunks: whole-page writes, no
+                # per-token read-modify-write.
+                pid, sblk, vld = metadata.prefill_cells
+                k_pages, v_pages = write_kv_pages_prefill(
+                    flat_k.reshape(-1, hd), flat_v.reshape(-1, hd),
+                    k_pages, v_pages, pid, sblk, vld)
+            else:
+                k_pages, v_pages = write_to_kv_cache(
+                    flat_k, flat_v, k_pages, v_pages,
+                    metadata.slot_mapping,
+                    kv_scale=metadata.kv_scale,
+                    # Decode: one token per sequence, pages are
+                    # sequence-exclusive -> the pipelined page writer
+                    # is safe.
+                    distinct_pages=not metadata.is_prompt)
+            if not pallas_write:
                 # XLA-scatter path only: keep the scatter un-fused from
                 # its readers — fusing the in-place page update into the
                 # attention gather forces XLA to materialize a full temp

@@ -176,6 +176,155 @@ def _decode_write_kernel(
             c.wait()
 
 
+def _prefill_write_kernel(
+    # scalar prefetch
+    page_ids_ref,   # [cells] int32; >= num_pages skips the cell
+    src_blocks_ref,  # [cells] int32 (consumed by the index map)
+    valids_ref,     # [cells] int32 tokens covered (1..page_size)
+    # inputs
+    kblk_ref,       # [page_size, H*d] VMEM (this cell's k rows)
+    vblk_ref,
+    k_in,           # [P, S, H*d] ANY/HBM (aliased)
+    v_in,
+    # outputs (aliased)
+    k_out,
+    v_out,
+    # scratch
+    kbuf,           # [2, page_size, H*d] VMEM staging
+    vbuf,
+    rsem,
+    wsem,
+    *,
+    page_size: int,
+    num_pages: int,
+):
+    """Prefill page writer: one grid cell per (sequence, page), writing
+    a WHOLE page from the prompt's contiguous token rows — no
+    read-modify-write for full pages, one 32 KB-class DMA per side,
+    writebacks double-buffered across cells (pages are distinct by
+    construction: each cell owns one (seq, page))."""
+    del k_in, v_in, src_blocks_ref
+    i = pl.program_id(0)
+    n = pl.num_programs(0)
+    pg = page_ids_ref[i]
+    valid = valids_ref[i]
+    s = jax.lax.rem(i, 2)
+
+    def wb_copies(j, slot):
+        pj = page_ids_ref[j]
+        return (pltpu.make_async_copy(kbuf.at[slot], k_out.at[pj],
+                                      wsem.at[slot, 0]),
+                pltpu.make_async_copy(vbuf.at[slot], v_out.at[pj],
+                                      wsem.at[slot, 1]))
+
+    # Free this slot: cell i-2 wrote from it.
+    @pl.when((i >= 2) & (page_ids_ref[i - 2] < num_pages))
+    def _():
+        for c in wb_copies(i - 2, s):
+            c.wait()
+
+    @pl.when(pg < num_pages)
+    def _():
+        @pl.when(valid >= page_size)
+        def _full():
+            kbuf[s] = kblk_ref[...]
+            vbuf[s] = vblk_ref[...]
+
+        @pl.when(valid < page_size)
+        def _partial():
+            # Tail page: merge the valid rows over the existing page.
+            ck = pltpu.make_async_copy(k_out.at[pg], kbuf.at[s],
+                                       rsem.at[0])
+            cv = pltpu.make_async_copy(v_out.at[pg], vbuf.at[s],
+                                       rsem.at[1])
+            ck.start()
+            cv.start()
+            ck.wait()
+            cv.wait()
+            rows = jax.lax.broadcasted_iota(
+                jnp.int32, (page_size, 1), 0)
+            kbuf[s] = jnp.where(rows < valid, kblk_ref[...], kbuf[s])
+            vbuf[s] = jnp.where(rows < valid, vblk_ref[...], vbuf[s])
+
+        for c in wb_copies(i, s):
+            c.start()
+
+    # Drain the last two cells' writebacks (n is static).
+    @pl.when(i == n - 1)
+    def _():
+        if n >= 2:
+            @pl.when(page_ids_ref[n - 2] < num_pages)
+            def _():
+                for c in wb_copies(n - 2, (n - 2) % 2):
+                    c.wait()
+
+        @pl.when(pg < num_pages)
+        def _():
+            for c in wb_copies(i, s):
+                c.wait()
+
+
+def write_kv_pages_prefill(
+    knew: jax.Array,      # [B * padded_len, H*d]
+    vnew: jax.Array,
+    k_pages: jax.Array,   # [num_pages, page_size, H*d]
+    v_pages: jax.Array,
+    page_ids: jax.Array,  # [cells] int32; >= num_pages skips
+    src_blocks: jax.Array,  # [cells] int32 block index into knew rows
+    valids: jax.Array,    # [cells] int32 valid rows (1..page_size)
+    *,
+    interpret: bool = False,
+):
+    """Whole-page prefill writer (see _prefill_write_kernel)."""
+    tokens, hd = knew.shape
+    num_pages, page_size, _ = k_pages.shape
+    cells = page_ids.shape[0]
+    dtype = k_pages.dtype
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(cells,),
+        in_specs=[
+            pl.BlockSpec((page_size, hd),
+                         lambda i, pids, sblk, vld: (sblk[i], 0)),
+            pl.BlockSpec((page_size, hd),
+                         lambda i, pids, sblk, vld: (sblk[i], 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, page_size, hd), dtype),
+            pltpu.VMEM((2, page_size, hd), dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    # The src_blocks index map addresses knew in page_size-row blocks;
+    # OOB-skipped cells still need a legal block index (0).
+    kernel = functools.partial(
+        _prefill_write_kernel,
+        page_size=page_size,
+        num_pages=num_pages,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(k_pages.shape, dtype),
+            jax.ShapeDtypeStruct(v_pages.shape, dtype),
+        ],
+        # inputs: 0=page_ids, 1=src_blocks(unused in body), 2=valids,
+        # 3=knew, 4=vnew, 5=k_pages, 6=v_pages
+        input_output_aliases={5: 0, 6: 1},
+        interpret=interpret,
+    )(page_ids, src_blocks, valids, knew.astype(dtype),
+      vnew.astype(dtype), k_pages, v_pages)
+
+
 def can_use_pallas_writer(dtype, page_size: int, hd: int) -> bool:
     """f32/bf16 pages, 8-aligned page_size, lane-aligned H*d rows
     (int8/fp8 tile at 32 sublanes — those fall back to the XLA

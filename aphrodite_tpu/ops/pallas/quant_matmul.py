@@ -280,6 +280,11 @@ def awq_matmul(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
     block_k = gs
     while block_k < 512 and K % (block_k * 2) == 0:
         block_k *= 2
+    # NOTE: pre-refactor AWQ defaulted block_n to 2048 at every m; the
+    # shared sizing caps it at 1024 for block_m >= 512. The 0.93x
+    # vs-baseline bench row (BENCH notes) was measured WITH the shared
+    # sizing, so this is the tuned configuration of record;
+    # APHRODITE_QMM_BLOCK_N=2048 restores the old tiling for A/B runs.
     block_m, block_n, padded_m = _tile_mn(m, N, x.dtype, min_bn=1024)
     if padded_m != m:
         x = jnp.pad(x, ((0, padded_m - m), (0, 0)))

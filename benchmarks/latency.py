@@ -11,9 +11,15 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 
 def main() -> None:
@@ -28,6 +34,10 @@ def main() -> None:
     parser.add_argument("--multi-step", type=int, default=32)
     parser.add_argument("--warmup", type=int, default=1)
     args = parser.parse_args()
+    if args.model == "synthetic-7b":
+        from serving import synthetic_7b_dir
+        args.model = synthetic_7b_dir()
+        args.load_format = "dummy"
 
     from aphrodite_tpu.common.sampling_params import SamplingParams
     from aphrodite_tpu.common.sequence import Sequence, SequenceGroup

@@ -17,9 +17,14 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
+import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 
 async def poisson_arrivals(n: int, rate: float, rng: np.random.RandomState):

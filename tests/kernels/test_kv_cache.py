@@ -120,6 +120,43 @@ def test_pallas_writer_interpret(distinct):
         np.array(got_v), exp_v.reshape(pages, page_size, hd))
 
 
+def test_pallas_prefill_page_writer_interpret():
+    """Whole-page prefill writer: full pages, a partial tail page,
+    prefix-offset pages, and OOB pad cells, vs a numpy oracle."""
+    from aphrodite_tpu.ops.pallas.kv_write import write_kv_pages_prefill
+    rng = np.random.default_rng(9)
+    pages, page_size, hd = 10, 8, 256
+    padded_len = 16                       # 2 page-blocks per sequence
+    B = 3
+    k_pages = jnp.asarray(
+        rng.normal(size=(pages, page_size, hd)), jnp.float32)
+    v_pages = jnp.asarray(
+        rng.normal(size=(pages, page_size, hd)), jnp.float32)
+    knew = rng.normal(size=(B * padded_len, hd)).astype(np.float32)
+    vnew = rng.normal(size=(B * padded_len, hd)).astype(np.float32)
+    # seq 0: 16 tokens -> pages 1,2 (both full)
+    # seq 1: 11 tokens -> page 4 full, page 5 partial (3 rows)
+    # seq 2: padded-out (no cells)
+    pid = np.array([1, 2, 4, 5, pages, pages], dtype=np.int32)
+    sblk = np.array([0, 1, 2, 3, 0, 0], dtype=np.int32)
+    vld = np.array([8, 8, 8, 3, 0, 0], dtype=np.int32)
+    got_k, got_v = write_kv_pages_prefill(
+        jnp.asarray(knew), jnp.asarray(vnew), k_pages, v_pages,
+        jnp.asarray(pid), jnp.asarray(sblk), jnp.asarray(vld),
+        interpret=True)
+    exp_k = np.array(k_pages)
+    exp_v = np.array(v_pages)
+    for c in range(6):
+        if pid[c] >= pages:
+            continue
+        rows = knew[sblk[c] * page_size:(sblk[c] + 1) * page_size]
+        rows_v = vnew[sblk[c] * page_size:(sblk[c] + 1) * page_size]
+        exp_k[pid[c], :vld[c]] = rows[:vld[c]]
+        exp_v[pid[c], :vld[c]] = rows_v[:vld[c]]
+    np.testing.assert_allclose(np.array(got_k), exp_k)
+    np.testing.assert_allclose(np.array(got_v), exp_v)
+
+
 def test_pallas_decode_writer_oob_first_and_last():
     """OOB (padding) tokens at the pipeline edges must not deadlock or
     corrupt: first, middle, and last positions padded."""

@@ -103,6 +103,48 @@ def main() -> int:
             pages_per_chunk=2), np.float32)[..., :d_true]
         check(f"tokenmajor head{d_true} padded", refs, got)
 
+    # -- prefill page writer (whole-page DMA, partial tail, OOB) --
+    from aphrodite_tpu.ops.pallas.kv_write import (write_kv_pages,
+                                                   write_kv_pages_prefill)
+    wp, wps, whd = 16, 16, 1024
+    kpw = jnp.asarray(rs.randn(wp, wps, whd) * 0.1, jnp.bfloat16)
+    vpw = jnp.asarray(rs.randn(wp, wps, whd) * 0.1, jnp.bfloat16)
+    knw = rs.randn(4 * 32, whd).astype(np.float32) * 0.1
+    vnw = rs.randn(4 * 32, whd).astype(np.float32) * 0.1
+    pidw = np.array([1, 2, 4, 5, 7, 8, wp, wp], dtype=np.int32)
+    sblkw = np.array([0, 1, 2, 3, 4, 5, 0, 0], dtype=np.int32)
+    vldw = np.array([16, 16, 16, 5, 16, 9, 0, 0], dtype=np.int32)
+    gk, gv = write_kv_pages_prefill(
+        jnp.asarray(knw, jnp.bfloat16), jnp.asarray(vnw, jnp.bfloat16),
+        kpw, vpw, jnp.asarray(pidw), jnp.asarray(sblkw),
+        jnp.asarray(vldw))
+    ek = np.asarray(kpw, np.float32)
+    for c in range(8):
+        if pidw[c] >= wp:
+            continue
+        rows = np.asarray(jnp.asarray(knw, jnp.bfloat16), np.float32)
+        ek[pidw[c], :vldw[c]] = rows[sblkw[c] * wps:
+                                     sblkw[c] * wps + vldw[c]]
+    errw = np.abs(np.asarray(gk, np.float32) - ek).max()
+    print(f"prefill page writer: max err {errw:.2e}")
+    if not (errw < 1e-6):
+        failures.append(("prefill_writer", errw))
+
+    # decode pipelined writer on-chip
+    slots_d = jnp.asarray(np.array([3 * wps + 2, 9 * wps + 7,
+                                    11 * wps + 1, wp * wps],
+                                   dtype=np.int32))
+    kd = jnp.asarray(rs.randn(4, whd) * 0.1, jnp.bfloat16)
+    gk2, _ = write_kv_pages(kd, kd, gk, gv, slots_d,
+                            distinct_pages=True)
+    ek2 = np.asarray(gk, np.float32)
+    for i, s in enumerate(np.asarray(slots_d)[:3]):
+        ek2[s // wps, s % wps] = np.asarray(kd, np.float32)[i]
+    errd = np.abs(np.asarray(gk2, np.float32) - ek2).max()
+    print(f"decode pipelined writer: max err {errd:.2e}")
+    if not (errd < 1e-6):
+        failures.append(("decode_writer", errd))
+
     # -- fused GPTQ dequant matmul --
     bits, gs, K, N, m = 4, 128, 4096, 14336, 256
     pack, G = 32 // bits, K // gs
