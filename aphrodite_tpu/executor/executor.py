@@ -95,7 +95,8 @@ class TPUExecutor:
             num_slots=self.cache_engine.num_slots,
             mesh=self.mesh,
             kv_scale=self.cache_engine.kv_scale,
-            sp=sp)
+            sp=sp,
+            kv_cache_dtype=self.cache_engine.dtype)
 
         self.lora_manager = None
         if lora_config is not None:

@@ -42,7 +42,13 @@ from aphrodite_tpu.ops.kv_cache import copy_blocks as _copy_blocks_op
 logger = init_logger(__name__)
 
 # Decode batch buckets (reference capture sizes, model_runner.py:31).
-_DECODE_BATCH_BUCKETS = [1, 2, 4] + [8 * i for i in range(1, 33)]
+# Power-of-two-and-a-half spacing: every (batch-bucket, pages-bucket,
+# burst-length) triple is its own compiled program and this platform's
+# remote compiles cost ~20 s, so a fluctuating serving batch must hit
+# FEW buckets (35 multiples-of-8 buckets made cold serving spend more
+# time compiling than decoding); <=33% padding waste per step.
+_DECODE_BATCH_BUCKETS = [1, 2, 4, 8, 12, 16, 24, 32, 48, 64, 96, 128,
+                         192, 256, 384, 512]
 _PREFILL_BATCH_BUCKETS = [1, 2, 4, 8, 16, 32]
 _PAGES_BUCKET = 8          # block-table width granularity (Pallas chunk)
 
@@ -75,6 +81,7 @@ class ModelRunner:
         mesh=None,
         kv_scale: float = 1.0,
         sp: Optional[tuple] = None,         # (Mesh, threshold) or None
+        kv_cache_dtype=jnp.bfloat16,
     ) -> None:
         self.model = model
         self.params = params
@@ -85,6 +92,14 @@ class ModelRunner:
         self.mesh = mesh
         self.kv_scale = kv_scale            # int8 KV dequant scale
         self.sp = sp                        # ring-prefill routing
+        # Whether the Pallas prefill page writer can ever run (TPU +
+        # fp page dtype): gates building its cell descriptors at all —
+        # ineligible configs skip the host loop and keep ONE jit
+        # treedef for aligned and unaligned prompts.
+        self._prefill_writer_ok = (
+            jax.default_backend() == "tpu" and
+            kv_cache_dtype in (jnp.bfloat16, jnp.float32) and
+            page_size % 8 == 0)
         self.sampler = Sampler(model_config.get_vocab_size())
 
         # LoRA: bucket keys carrying slot-stacked adapter tensors, and a
@@ -311,7 +326,7 @@ class ModelRunner:
         # instead of per-token read-modify-writes.
         prefill_cells = None
         ps = self.page_size
-        if padded_len % ps == 0 and \
+        if self._prefill_writer_ok and padded_len % ps == 0 and \
                 all(int(c) % ps == 0 for c in ctx_lens[:batch]):
             ppp = padded_len // ps               # pages per prompt
             n_cells = padded_batch * ppp

@@ -77,7 +77,16 @@ class PagedAttention:
         k = k.reshape(batch, seq_len, self.num_kv_heads, self.head_size)
         v = v.reshape(batch, seq_len, self.num_kv_heads, self.head_size)
 
-        if k_pages is not None:
+        # Sliding-window models write to a ROTATING ring slot
+        # (pos % window, computed host-side in _prepare_decode); the
+        # fused kernel derives the write position as ctx-1, which the
+        # window clamp pins — so windowed models keep the slot-mapped
+        # writer path.
+        fused_decode = (k_pages is not None and
+                        not metadata.is_prompt and
+                        self.sliding_window is None and
+                        self._pallas_decode_ok(k_pages))
+        if k_pages is not None and not fused_decode:
             flat_k = k.reshape(-1, self.num_kv_heads, self.head_size)
             flat_v = v.reshape(-1, self.num_kv_heads, self.head_size)
             if self.padded_head != self.head_size:
@@ -120,11 +129,28 @@ class PagedAttention:
 
         if metadata.is_prompt:
             out = self._prefill(q, k, v, k_pages, v_pages, metadata)
+        elif fused_decode:
+            # The decode kernel injects the current token's K/V into
+            # its page in place and attends over it — no separate
+            # page-writer pass (the page was being DMA'd in anyway).
+            out, k_pages, v_pages = self._decode(
+                q, k_pages, v_pages, metadata,
+                knew=k.reshape(batch, self.num_kv_heads,
+                               self.head_size),
+                vnew=v.reshape(batch, self.num_kv_heads,
+                               self.head_size))
         else:
             out = self._decode(q, k_pages, v_pages, metadata)
         return (out.reshape(batch, seq_len,
                             self.num_heads * self.head_size),
                 k_pages, v_pages)
+
+    def _pallas_decode_ok(self, k_pages) -> bool:
+        quant_ok = k_pages.dtype in (jnp.bfloat16, jnp.float32) or (
+            k_pages.dtype in (jnp.int8, jnp.float8_e5m2) and
+            k_pages.shape[1] % 32 == 0)     # 8-bit sublane tile
+        return (self.use_pallas and jax.default_backend() == "tpu"
+                and quant_ok)
 
     def _prefill(self, q, k, v, k_pages, v_pages,
                  metadata: InputMetadata) -> jax.Array:
@@ -194,25 +220,25 @@ class PagedAttention:
         mesh, _ = metadata.sp
         return make_ring_fn(mesh, self.scale)(q, k, v)
 
-    def _decode(self, q, k_pages, v_pages,
-                metadata: InputMetadata) -> jax.Array:
+    def _decode(self, q, k_pages, v_pages, metadata: InputMetadata,
+                knew=None, vnew=None):
         q3 = q.reshape(q.shape[0], self.num_heads, self.head_size)
         if self.padded_head != self.head_size:
             # Pages pad head_dim to the lane tile; zero q lanes leave
             # scores untouched and the output pad lanes slice off below.
-            q3 = jnp.pad(q3, ((0, 0), (0, 0),
-                              (0, self.padded_head - self.head_size)))
+            hpad = ((0, 0), (0, 0),
+                    (0, self.padded_head - self.head_size))
+            q3 = jnp.pad(q3, hpad)
+            if knew is not None:
+                knew = jnp.pad(knew, hpad)
+                vnew = jnp.pad(vnew, hpad)
         # Sliding window: context_lens are already clamped host-side to the
         # window and block tables wrap (reference model_runner.py:278-293),
         # so the kernels need no window logic in decode.
         # Quantized pages (int8/fp8) run in-kernel: the int8 scale folds
         # into the score scale and output epilogue (see ops/kv_quant.py).
         from aphrodite_tpu.ops.kv_quant import dequant_scale
-        quant_ok = k_pages.dtype in (jnp.bfloat16, jnp.float32) or (
-            k_pages.dtype in (jnp.int8, jnp.float8_e5m2) and
-            k_pages.shape[1] % 32 == 0)     # 8-bit sublane tile
-        if self.use_pallas and jax.default_backend() == "tpu" and \
-                quant_ok:
+        if self._pallas_decode_ok(k_pages):
             from aphrodite_tpu.ops.pallas.paged_attention import (
                 paged_decode_attention)
             slopes = None if self.alibi_slopes is None else \
@@ -241,12 +267,19 @@ class PagedAttention:
                     ppc *= 2
             if pps % ppc != 0:
                 ppc = 1
-            out = paged_decode_attention(
+            result = paged_decode_attention(
                 q3, k_pages, v_pages, tables,
-                metadata.context_lens, slopes, scale=self.scale,
+                metadata.context_lens, slopes, knew, vnew,
+                scale=self.scale,
                 kv_scale=dequant_scale(k_pages.dtype,
                                        metadata.kv_scale),
                 pages_per_chunk=ppc)
+            if knew is not None:
+                out, k_pages, v_pages = result
+                if self.padded_head != self.head_size:
+                    out = out[..., :self.head_size]
+                return out[:, None], k_pages, v_pages
+            out = result
         else:
             out = paged_decode_attention_ref(
                 q3, k_pages, v_pages, metadata.block_tables,

@@ -27,13 +27,28 @@ layout's 4 KB-per-(head,page) DMAs capped attention at 210 GB/s):
   i+1's loads), hiding page-DMA latency behind compute.
 - p@V lands as [rows, hb*d]; each row's own head block is extracted
   with hb static lane-slices (masked adds) — no in-register reshape.
+- FUSED KV WRITE (decode steps): pass knew/vnew [batch, n_hb, hb*d]
+  and the kernel injects the current token's K/V into the loaded chunk
+  in VMEM (position ctx-1) and writes that ONE page back to HBM
+  (lane-sliced per head block, pages aliased in place) — replacing the
+  separate page-writer kernel pass entirely: the page was being DMA'd
+  in for attention anyway, so the write costs two extra page-sized
+  DMAs instead of a whole second kernel's round trips.
+  PRECONDITIONS (the engine's decode contract): pages are
+  sequence-exclusive; position ctx-1 lies within the sequence's
+  RESERVED block-table entries (burst reservation guarantees this —
+  the caller passes pad-clamped tables, so a violation would silently
+  write a valid-but-wrong page rather than fault); sliding-window
+  models must NOT use this (their write slot rotates modulo the
+  window; the layer routes them to the slot-mapped writer).
 
 Padded block-table entries must point at any valid page (use 0); padded
 positions are masked to -inf before the online-softmax update, and the
 cache is zero-initialized, so garbage pages never produce NaNs.
 
 int8/fp8 KV pages dequant in-kernel: the scale folds into the score
-scale (q·k·S == (q·S)·k) and the output epilogue.
+scale (q·k·S == (q·S)·k) and the output epilogue; fused writes
+quantize the injected token into stored units first.
 """
 from __future__ import annotations
 
@@ -57,11 +72,19 @@ def head_block(num_kv_heads: int) -> int:
     return 1
 
 
+def _quantize_row(row, dtype, kv_scale):
+    # The single KV number-format contract lives in ops/kv_quant.py
+    # (pure jnp — legal inside the kernel body).
+    from aphrodite_tpu.ops.kv_quant import quantize_kv
+    return quantize_kv(row, dtype, kv_scale)
+
+
 def _decode_kernel_tm(
     # scalar prefetch
     block_tables_ref,   # [batch, pages_per_seq] int32 (SMEM)
     context_lens_ref,   # [batch] int32 (SMEM)
-    # inputs (slopes_ref [n_hb, rows, 128] present only with has_alibi)
+    # inputs (slopes_ref [n_hb, rows, 128] only with has_alibi;
+    # knew_ref/vnew_ref [1, 1, hb*d] only with fused_write)
     *refs,
     hb: int,
     group: int,
@@ -72,14 +95,29 @@ def _decode_kernel_tm(
     kv_scale: float,
     has_alibi: bool = False,
     single_chunk: bool = False,
+    fused_write: bool = False,
 ):
-    if has_alibi:
-        (q_ref, k_hbm, v_hbm, slopes_ref, out_ref,
-         k_buf, v_buf, sems, acc_scr, m_scr, l_scr) = refs
+    refs = list(refs)
+    q_ref, k_hbm, v_hbm = refs[:3]
+    refs = refs[3:]
+    slopes_ref = refs.pop(0) if has_alibi else None
+    if fused_write:
+        knew_ref, vnew_ref = refs[:2]
+        out_ref, kp_out, vp_out = refs[2:5]
+        scratch = refs[5:]
     else:
-        (q_ref, k_hbm, v_hbm, out_ref,
-         k_buf, v_buf, sems, acc_scr, m_scr, l_scr) = refs
-        slopes_ref = None
+        knew_ref = vnew_ref = kp_out = vp_out = None
+        out_ref = refs[0]
+        scratch = refs[1:]
+    if fused_write:
+        (k_buf, v_buf, sems, acc_scr, m_scr, l_scr,
+         kwb, vwb, wbsem) = scratch
+        # reads and writes go through the aliased OUTPUT refs so in
+        # place semantics hold
+        k_hbm, v_hbm = kp_out, vp_out
+    else:
+        k_buf, v_buf, sems, acc_scr, m_scr, l_scr = scratch
+        kwb = vwb = wbsem = None
     b = pl.program_id(0)
     j = pl.program_id(1)
     n_hb = pl.num_programs(1)
@@ -89,10 +127,13 @@ def _decode_kernel_tm(
     ctx = context_lens_ref[b]
     num_chunks = (ctx + chunk_tokens - 1) // chunk_tokens
 
+    def lanes_of(cell_j):
+        return pl.ds(cell_j * hb * d, hb * d)
+
     def chunk_dmas(c, slot, cell_b=None, cell_j=None):
         cell_b = b if cell_b is None else cell_b
         cell_j = j if cell_j is None else cell_j
-        lanes = pl.ds(cell_j * hb * d, hb * d)
+        lanes = lanes_of(cell_j)
         copies = []
         for p in range(pages_per_chunk):  # static unroll
             page_idx = block_tables_ref[cell_b, c * pages_per_chunk + p]
@@ -126,6 +167,37 @@ def _decode_kernel_tm(
     row_head = jax.lax.broadcasted_iota(
         jnp.int32, (rows, hb * d), 0) // group
     q_packed = jnp.where(lane_head == row_head, q_rep, 0.0)
+
+    # Fused write bookkeeping: the current token sits at position
+    # ctx-1, inside chunk c_star at in-chunk row r_star, page slot
+    # p_star of that chunk (and global page g_star of the table).
+    if fused_write:
+        pos_new = jnp.maximum(ctx - 1, 0)
+        c_star = pos_new // chunk_tokens
+        r_star = jax.lax.rem(pos_new, chunk_tokens)
+        p_star = r_star // page_size
+        g_star = block_tables_ref[b, pos_new // page_size]
+
+        # Free this cell's writeback buffer slot: cell i-2 used it.
+        cell = b * n_hb + j
+        s_wb = jax.lax.rem(cell, 2)
+
+        @pl.when(cell >= 2)
+        def _():
+            pb = (cell - 2) // n_hb
+
+            @pl.when(context_lens_ref[pb] > 0)
+            def _():
+                pj = jax.lax.rem(cell - 2, n_hb)
+                pgs = block_tables_ref[
+                    pb, jnp.maximum(context_lens_ref[pb] - 1, 0)
+                    // page_size]
+                pltpu.make_async_copy(
+                    kwb.at[s_wb], k_hbm.at[pgs, :, lanes_of(pj)],
+                    wbsem.at[s_wb, 0]).wait()
+                pltpu.make_async_copy(
+                    vwb.at[s_wb], v_hbm.at[pgs, :, lanes_of(pj)],
+                    wbsem.at[s_wb, 1]).wait()
 
     if single_chunk:
         # Every sequence fits one chunk: pipeline ACROSS grid cells —
@@ -162,6 +234,31 @@ def _decode_kernel_tm(
 
         for dma in chunk_dmas(c, slot):
             dma.wait()
+
+        if fused_write:
+            # Inject the current token's K/V into the loaded chunk and
+            # write its page back (this cell's head-lane slice only).
+            @pl.when((ctx > 0) & (c == c_star))
+            def _():
+                rows_i = jax.lax.broadcasted_iota(
+                    jnp.int32, k_buf.shape[1:], 0)
+                kq = _quantize_row(knew_ref[0, 0], k_buf.dtype,
+                                   kv_scale)
+                vq = _quantize_row(vnew_ref[0, 0], v_buf.dtype,
+                                   kv_scale)
+                k_buf[slot] = jnp.where(rows_i == r_star, kq,
+                                        k_buf[slot])
+                v_buf[slot] = jnp.where(rows_i == r_star, vq,
+                                        v_buf[slot])
+                pg = pl.ds(p_star * page_size, page_size)
+                kwb[s_wb] = k_buf[slot, pg, :]
+                vwb[s_wb] = v_buf[slot, pg, :]
+                pltpu.make_async_copy(
+                    kwb.at[s_wb], k_hbm.at[g_star, :, lanes_of(j)],
+                    wbsem.at[s_wb, 0]).start()
+                pltpu.make_async_copy(
+                    vwb.at[s_wb], v_hbm.at[g_star, :, lanes_of(j)],
+                    wbsem.at[s_wb, 1]).start()
 
         k = k_buf[slot].astype(jnp.float32)          # [chunk, hb*d]
         s = jax.lax.dot_general(
@@ -207,6 +304,40 @@ def _decode_kernel_tm(
     else:
         jax.lax.fori_loop(0, num_chunks, body, None)
 
+    if fused_write:
+        # Drain: the LAST two cells' writebacks have no successor to
+        # wait them.
+        cell = b * n_hb + j
+        total = pl.num_programs(0) * n_hb
+
+        @pl.when((cell == total - 1) & (ctx > 0))
+        def _():
+            s_wb2 = jax.lax.rem(cell, 2)
+            pltpu.make_async_copy(
+                kwb.at[s_wb2], k_hbm.at[g_star, :, lanes_of(j)],
+                wbsem.at[s_wb2, 0]).wait()
+            pltpu.make_async_copy(
+                vwb.at[s_wb2], v_hbm.at[g_star, :, lanes_of(j)],
+                wbsem.at[s_wb2, 1]).wait()
+
+        @pl.when((cell == total - 1) & (total >= 2))
+        def _():
+            pb = (cell - 1) // n_hb
+
+            @pl.when(context_lens_ref[pb] > 0)
+            def _():
+                pj = jax.lax.rem(cell - 1, n_hb)
+                s_prev = jax.lax.rem(cell - 1, 2)
+                pgs = block_tables_ref[
+                    pb, jnp.maximum(context_lens_ref[pb] - 1, 0)
+                    // page_size]
+                pltpu.make_async_copy(
+                    kwb.at[s_prev], k_hbm.at[pgs, :, lanes_of(pj)],
+                    wbsem.at[s_prev, 0]).wait()
+                pltpu.make_async_copy(
+                    vwb.at[s_prev], v_hbm.at[pgs, :, lanes_of(pj)],
+                    wbsem.at[s_prev, 1]).wait()
+
     l_final = l_scr[:, :1]
     l_safe = jnp.where(l_final == 0.0, 1.0, l_final)
     out_ref[0, 0] = (acc_scr[...] * (kv_scale / l_safe)).astype(
@@ -224,13 +355,21 @@ def paged_decode_attention(
     block_tables: jax.Array,  # [batch, pages_per_seq] int32, 0-padded
     context_lens: jax.Array,  # [batch] int32
     alibi_slopes: jax.Array = None,   # [num_q_heads] f32, optional
+    knew: jax.Array = None,   # [batch, Hkv, head_dim]: fused KV write
+    vnew: jax.Array = None,
     *,
     scale: float,
     kv_scale: float = 1.0,
     pages_per_chunk: int = 8,
     interpret: bool = False,
-) -> jax.Array:
-    """Token-major flash-decoding attention (see module docstring)."""
+):
+    """Token-major flash-decoding attention (see module docstring).
+
+    Without knew/vnew: returns attn_out [batch, Hq, d] over the given
+    pages (read-only). With knew/vnew: ALSO writes the current token
+    (position ctx-1 per sequence) into its page in place and returns
+    (attn_out, k_pages, v_pages) — the aliased, updated page arrays.
+    """
     batch, num_q_heads, head_dim = q.shape
     num_pages, page_size, hd = k_pages.shape
     if hd % head_dim != 0:
@@ -248,6 +387,7 @@ def paged_decode_attention(
     n_hb = num_kv_heads // hb
     rows = group * hb
     chunk_tokens = pages_per_chunk * page_size
+    fused_write = knew is not None
 
     kernel = functools.partial(
         _decode_kernel_tm,
@@ -260,6 +400,7 @@ def paged_decode_attention(
         kv_scale=kv_scale,
         has_alibi=alibi_slopes is not None,
         single_chunk=pages_per_seq == pages_per_chunk,
+        fused_write=fused_write,
     )
     # q rows are kv-head-major, so the rows for head block j are the
     # contiguous slice [j*rows, (j+1)*rows).
@@ -277,26 +418,58 @@ def paged_decode_attention(
         inputs.append(jnp.broadcast_to(
             alibi_slopes.astype(jnp.float32).reshape(n_hb, rows, 1),
             (n_hb, rows, 128)))
+    if fused_write:
+        kn = knew.reshape(batch, n_hb, hb * head_dim)
+        vn = vnew.reshape(batch, n_hb, hb * head_dim)
+        spec_new = pl.BlockSpec((1, 1, hb * head_dim),
+                                lambda b, j, *_: (b, j, 0))
+        in_specs.extend([spec_new, spec_new])
+        inputs.extend([kn, vn])
+
+    scratch = [
+        pltpu.VMEM((2, chunk_tokens, hb * head_dim), k_pages.dtype),
+        pltpu.VMEM((2, chunk_tokens, hb * head_dim), v_pages.dtype),
+        pltpu.SemaphoreType.DMA((2, 2)),
+        pltpu.VMEM((rows, head_dim), jnp.float32),
+        pltpu.VMEM((rows, 128), jnp.float32),
+        pltpu.VMEM((rows, 128), jnp.float32),
+    ]
+    out_shape = [jax.ShapeDtypeStruct((batch, n_hb, rows, head_dim),
+                                      q.dtype)]
+    out_specs = [pl.BlockSpec((1, 1, rows, head_dim),
+                              lambda b, j, *_: (b, j, 0, 0))]
+    io_aliases = {}
+    if fused_write:
+        scratch.extend([
+            pltpu.VMEM((2, page_size, hb * head_dim), k_pages.dtype),
+            pltpu.VMEM((2, page_size, hb * head_dim), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ])
+        out_shape.extend([
+            jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+            jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
+        ])
+        out_specs.extend([pl.BlockSpec(memory_space=pl.ANY),
+                          pl.BlockSpec(memory_space=pl.ANY)])
+        # flattened inputs: 0=tables, 1=ctx, 2=q, 3=k_pages, 4=v_pages,
+        # then [slopes], knew, vnew
+        io_aliases = {3: 1, 4: 2}
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(batch, n_hb),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, 1, rows, head_dim),
-                               lambda b, j, *_: (b, j, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((2, chunk_tokens, hb * head_dim), k_pages.dtype),
-            pltpu.VMEM((2, chunk_tokens, hb * head_dim), v_pages.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
-            pltpu.VMEM((rows, head_dim), jnp.float32),
-            pltpu.VMEM((rows, 128), jnp.float32),
-            pltpu.VMEM((rows, 128), jnp.float32),
-        ],
+        out_specs=out_specs if fused_write else out_specs[0],
+        scratch_shapes=scratch,
     )
-    out = pl.pallas_call(
+    result = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((batch, n_hb, rows, head_dim),
-                                       q.dtype),
+        out_shape=out_shape if fused_write else out_shape[0],
+        input_output_aliases=io_aliases,
         interpret=interpret,
     )(*inputs)
-    return out.reshape(batch, num_q_heads, head_dim)
+    if fused_write:
+        out, kp, vp = result
+        return out.reshape(batch, num_q_heads, head_dim), kp, vp
+    return result.reshape(batch, num_q_heads, head_dim)

@@ -75,6 +75,18 @@ async def run(args) -> dict:
             tpots.append((t1 - (first or t1)) / (n_out - 1))
         e2es.append(t1 - t0)
 
+    if args.warmup:
+        # Warm the compile caches with the same workload (this
+        # platform's remote compiles cost ~20 s per shape bucket; the
+        # reference's CUDA-graph capture is likewise excluded from its
+        # measurements by the first requests absorbing it).
+        warm = [asyncio.create_task(one(i))
+                for i in range(args.num_requests)]
+        await asyncio.gather(*warm)
+        ttfts.clear()
+        tpots.clear()
+        e2es.clear()
+
     tasks = []
     t_start = time.perf_counter()
     async for i in poisson_arrivals(args.num_requests, args.request_rate,
@@ -144,6 +156,9 @@ def main() -> None:
     parser.add_argument("--num-requests", type=int, default=128)
     parser.add_argument("--prompt-len", type=int, default=128)
     parser.add_argument("--output-len", type=int, default=64)
+    parser.add_argument("--warmup", type=int, default=1,
+                        help="run the workload once first to absorb "
+                             "shape-bucket compiles (0 to disable)")
     args = parser.parse_args()
     if args.model == "synthetic-7b":
         args.model = synthetic_7b_dir()

@@ -260,6 +260,101 @@ def test_pallas_decode_alibi():
                                atol=2e-3)
 
 
+@pytest.mark.parametrize("num_q_heads,num_kv_heads,pages_per_chunk", [
+    (8, 2, 4),         # multi-chunk
+    (8, 2, 8),         # single-chunk cross-cell pipeline
+    (32, 8, 8),        # GQA n_hb=1
+    (8, 8, 4),         # MHA-ish (n_hb=1, hb=8)
+])
+def test_pallas_decode_fused_write(num_q_heads, num_kv_heads,
+                                   pages_per_chunk):
+    """knew/vnew injection: the kernel must produce the same attention
+    output as write-then-attend AND leave the pages identically
+    updated."""
+    from aphrodite_tpu.ops.kv_cache import write_to_kv_cache
+    q, k_pages, v_pages, bt, ctx = make_problem(
+        num_q_heads=num_q_heads, num_kv_heads=num_kv_heads, dim=128,
+        page_size=8, pages_per_seq=8, pages=64, batch=4)
+    rng = np.random.default_rng(11)
+    B = q.shape[0]
+    d = 128
+    # The engine guarantees pages are globally sequence-exclusive; the
+    # fused write relies on it (make_problem only dedups WITHIN a row).
+    perm = rng.permutation(k_pages.shape[0])
+    for b in range(B):
+        n_pages = -(-int(ctx[b]) // 8)
+        bt[b, :n_pages] = perm[b * 8:b * 8 + n_pages]
+    # ctx includes the new token (write-then-attend convention); make
+    # one row a padded (ctx=0) lane.
+    ctx = ctx.copy()
+    ctx[1] = 0
+    knew = rng.normal(size=(B, num_kv_heads, d)).astype(np.float32)
+    vnew = rng.normal(size=(B, num_kv_heads, d)).astype(np.float32)
+    slots = np.full((B,), k_pages.shape[0] * 8, dtype=np.int32)
+    for b in range(B):
+        if ctx[b] > 0:
+            pos = ctx[b] - 1
+            slots[b] = bt[b][pos // 8] * 8 + pos % 8
+
+    ref_k, ref_v = write_to_kv_cache(
+        jnp.asarray(knew), jnp.asarray(vnew), jnp.asarray(k_pages),
+        jnp.asarray(v_pages), jnp.asarray(slots))
+    want = numpy_paged_attention(q, np.asarray(ref_k),
+                                 np.asarray(ref_v), bt,
+                                 np.maximum(ctx, 1), 0.1)
+    want[ctx == 0] = 0.0
+
+    out, got_k, got_v = paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(k_pages), jnp.asarray(v_pages),
+        jnp.asarray(bt), jnp.asarray(ctx), None,
+        jnp.asarray(knew), jnp.asarray(vnew), scale=0.1,
+        pages_per_chunk=pages_per_chunk, interpret=True)
+    got = np.asarray(out)
+    mask = ctx > 0
+    np.testing.assert_allclose(got[mask], want[mask], rtol=2e-3,
+                               atol=2e-3)
+    np.testing.assert_allclose(got[~mask], 0.0, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got_k), np.asarray(ref_k),
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got_v), np.asarray(ref_v),
+                               atol=1e-6)
+
+
+def test_pallas_decode_fused_write_int8():
+    """Fused write with int8 pages quantizes the injected token into
+    stored units."""
+    from aphrodite_tpu.ops.kv_cache import write_to_kv_cache
+    q, k_pages, v_pages, bt, ctx = make_problem(
+        num_q_heads=8, num_kv_heads=2, dim=128, page_size=8,
+        pages_per_seq=8, pages=32, batch=3)
+    S = 0.05
+    kp8 = np.clip(np.round(k_pages / S), -127, 127).astype(np.int8)
+    vp8 = np.clip(np.round(v_pages / S), -127, 127).astype(np.int8)
+    rng = np.random.default_rng(12)
+    B = q.shape[0]
+    knew = rng.normal(size=(B, 2, 128)).astype(np.float32)
+    vnew = rng.normal(size=(B, 2, 128)).astype(np.float32)
+    slots = np.zeros((B,), dtype=np.int32)
+    for b in range(B):
+        pos = ctx[b] - 1
+        slots[b] = bt[b][pos // 8] * 8 + pos % 8
+    ref_k, ref_v = write_to_kv_cache(
+        jnp.asarray(knew), jnp.asarray(vnew), jnp.asarray(kp8),
+        jnp.asarray(vp8), jnp.asarray(slots), kv_scale=S)
+    want = numpy_paged_attention(
+        q, np.asarray(ref_k, np.float32) * S,
+        np.asarray(ref_v, np.float32) * S, bt, ctx, 0.1)
+    out, got_k, got_v = paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(kp8), jnp.asarray(vp8),
+        jnp.asarray(bt), jnp.asarray(ctx), None,
+        jnp.asarray(knew), jnp.asarray(vnew), scale=0.1, kv_scale=S,
+        pages_per_chunk=4, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=2e-3,
+                               atol=2e-3)
+    np.testing.assert_array_equal(np.asarray(got_k), np.asarray(ref_k))
+    np.testing.assert_array_equal(np.asarray(got_v), np.asarray(ref_v))
+
+
 @pytest.mark.parametrize("d_true", [64, 80, 96])
 def test_pallas_decode_padded_head(d_true):
     """Head sizes below the 128-lane tile run with zero-padded pages
