@@ -190,15 +190,23 @@ class GrammarMatcher:
         # bounded by the INITIAL partial length. The bound must not
         # shrink with the remainder: a split into N single-char
         # terminals legitimately recurses N deep.
-        return self._can_end(parser_key, partial, len(partial) + 4)
+        return self._can_end(parser_key, partial, len(partial) + 4, {})
 
     def _can_end(self, parser_key: int, partial: str,
-                 depth_left: int) -> bool:
+                 depth_left: int, memo: dict) -> bool:
+        # Memo scoped to one can_end call: overlapping short terminals
+        # (A='a', AA='aa') reach the same (state, suffix) through
+        # exponentially many split orders; each is decided once.
+        key = (parser_key, partial)
+        hit = memo.get(key)
+        if hit is not None:
+            return hit
         accepts = self._accepts(parser_key)
         if partial == "":
             return END in accepts
         if depth_left <= 0:                    # defensive cycle bound
             return False
+        result = False
         for terminal in sorted(accepts):
             if terminal == END:
                 continue
@@ -206,9 +214,11 @@ class GrammarMatcher:
             if processed is None:
                 continue
             next_key = self._feed(parser_key, terminal, processed)
-            if self._can_end(next_key, remainder, depth_left - 1):
-                return True
-        return False
+            if self._can_end(next_key, remainder, depth_left - 1, memo):
+                result = True
+                break
+        memo[key] = result
+        return result
 
 
 class TokenTrie:

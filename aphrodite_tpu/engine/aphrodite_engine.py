@@ -38,6 +38,46 @@ from aphrodite_tpu.common.utils import Counter
 logger = init_logger(__name__)
 
 
+def _enable_compilation_cache() -> None:
+    """Point JAX's persistent compilation cache at a durable directory
+    so a server restart replays every (phase, bucket) executable from
+    disk instead of repaying ~20 s/bucket remote compiles — the
+    dominant term in cold-start TTFT (SERVING_r03: 63-70 s p50).
+    Opt out with APHRODITE_COMPILE_CACHE=0 or redirect with
+    APHRODITE_COMPILE_CACHE=<dir>."""
+    import os
+    loc = os.environ.get("APHRODITE_COMPILE_CACHE", "")
+    if loc == "0":
+        return
+    if not loc:
+        loc = os.path.join(
+            os.environ.get("XDG_CACHE_HOME",
+                           os.path.expanduser("~/.cache")),
+            "aphrodite_tpu", "jax_cache")
+    try:
+        import jax
+        if jax.default_backend() == "cpu" and "APHRODITE_COMPILE_CACHE" \
+                not in os.environ:
+            # CPU compiles are fast and local (tests/dev): persisting
+            # every tiny program would just grow the cache unboundedly.
+            return
+        # Per-backend subdirectory: entries AOT-compiled for the TPU
+        # tunnel must not be offered to CPU runs (feature-mismatch
+        # warnings / potential SIGILL) and vice versa.
+        loc = os.path.join(loc, jax.default_backend())
+        os.makedirs(loc, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", loc)
+        # Cache every compile (the default only caches >1 s compiles;
+        # on this platform even tiny programs pay the remote round
+        # trip, and the decode bucket lattice is many small programs).
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                          0)
+    except Exception as e:  # cache is an optimization, never fatal
+        logger.warning("compilation cache unavailable: %s", e)
+
+
 class AphroditeEngine:
     """Synchronous engine; AsyncAphrodite wraps it for serving."""
 
@@ -68,6 +108,8 @@ class AphroditeEngine:
         self.device_config = device_config
         self.lora_config = lora_config
         self.log_stats = log_stats
+
+        _enable_compilation_cache()
 
         if skip_tokenizer_init:
             self.tokenizer = None
@@ -231,7 +273,7 @@ class AphroditeEngine:
         if self.model_config.get_sliding_window() is not None:
             return 1
         remaining = []
-        hard_cap = max_steps
+        extra_cap = {}          # seq_id -> max USEFUL extra slots
         for md in seq_group_metadata_list:
             p = md.sampling_params
             if (len(md.seq_data) != 1 or p.use_beam_search
@@ -241,36 +283,33 @@ class AphroditeEngine:
                     or abs(p.frequency_penalty) >= 1e-5
                     or abs(p.repetition_penalty - 1.0) >= 1e-5):
                 return 1
-            data = next(iter(md.seq_data.values()))
-            if p.max_tokens is not None:
-                remaining.append(p.max_tokens - data.get_output_len())
-            else:
-                # Unbounded groups want the full burst; without this a
-                # co-batched short group's remaining would cap them via
-                # max(remaining).
-                remaining.append(max_steps)
-            # Positions/pages must exist for EVERY burst step of EVERY
-            # sequence (the device loop walks the block table), so the
-            # model-length bound is a hard per-seq cap even though
-            # max_tokens is not (see overshoot below).
-            hard_cap = min(hard_cap,
-                           self.scheduler_config.max_model_len -
-                           data.get_len())
-        want = max(1, min(max_steps, hard_cap,
+            seq_id = next(iter(md.seq_data))
+            data = md.seq_data[seq_id]
+            # Per-row useful steps: tokens remaining (unbounded groups
+            # want the full burst) clamped by model-len room. The burst
+            # may run PAST a row's cap — the device loop pins the row's
+            # position at its last reserved slot (ModelRunner._burst_step
+            # pos_cap) — so a nearly-finished row neither shortens the
+            # burst nor inflates the page reservation (advisor r3).
+            r = max_steps if p.max_tokens is None else \
+                p.max_tokens - data.get_output_len()
+            r = max(0, min(r, self.scheduler_config.max_model_len -
+                           data.get_len()))
+            remaining.append(r)
+            extra_cap[seq_id] = r
+        want = max(1, min(max_steps,
                           max(remaining) if remaining else max_steps))
         if want <= 1:
             return 1
         # Bucket to powers of two: each burst length is its own compiled
         # scan program, and compiles are expensive. Round UP when the
-        # overshoot is small (a finished group's extra tokens are
-        # dropped by _process_burst_outputs and its pages are reserved):
-        # e.g. 31 remaining runs one 32-burst instead of the
-        # 16+8+4+2+1 ladder of ever-worse per-step rates. Round DOWN
-        # when the waste would exceed the per-burst overhead (~2-3
-        # steps' worth of device time).
+        # overshoot is small (overshot rows' extra tokens are dropped by
+        # _process_burst_outputs): e.g. 31 remaining runs one 32-burst
+        # instead of the 16+8+4+2+1 ladder of ever-worse per-step
+        # rates. Round DOWN when the waste would exceed the per-burst
+        # overhead (~2-3 steps' worth of device time).
         up = 1 << (want - 1).bit_length()
-        if up - want <= max(2, up // 8) and up <= max_steps and \
-                up <= hard_cap:
+        if up - want <= max(2, up // 8) and up <= max_steps:
             want = up
         else:
             want = 1 << (want.bit_length() - 1)
@@ -278,7 +317,7 @@ class AphroditeEngine:
         # sequences' block tables and satisfy the next round's
         # reservation.
         granted = self.scheduler.reserve_decode_burst(
-            seq_group_metadata_list, want - 1)
+            seq_group_metadata_list, want - 1, extra_cap)
         return 1 << ((1 + granted).bit_length() - 1)
 
     def _process_burst_outputs(

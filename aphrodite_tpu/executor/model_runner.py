@@ -49,6 +49,11 @@ logger = init_logger(__name__)
 # time compiling than decoding); <=33% padding waste per step.
 _DECODE_BATCH_BUCKETS = [1, 2, 4, 8, 12, 16, 24, 32, 48, 64, 96, 128,
                          192, 256, 384, 512]
+
+# Enables the (host-side) sequence-exclusive-pages precondition check
+# for the pipelined decode KV writer.
+import os as _os
+_DEBUG_KV = bool(_os.environ.get("APHRODITE_DEBUG_KV"))
 _PREFILL_BATCH_BUCKETS = [1, 2, 4, 8, 16, 32]
 _PAGES_BUCKET = 8          # block-table width granularity (Pallas chunk)
 
@@ -136,11 +141,19 @@ class ModelRunner:
 
     def _burst_step(self, params, input_ids, positions, kv_caches,
                     metadata, tensors, bases, salt1, salt2, greedy_mask,
-                    step_salt, *, max_best_of: int, num_topk: int):
+                    pos_cap, step_salt, *, max_best_of: int,
+                    num_topk: int):
         """One multi-step-decode iteration, fully on device: model step,
         fused sampling, and next-step input computation (token feedback,
         advanced positions/slots from the block table) — so K iterations
-        chain with zero host syncs between them."""
+        chain with zero host syncs between them.
+
+        `pos_cap` [batch, 1] is each row's last reserved position
+        (current pos + min(tokens remaining, model-len room)): rows the
+        burst overshoots stop advancing and rewrite their own final
+        slot with (discarded) garbage instead of walking the block
+        table past their reservation — so the scheduler only reserves
+        pages a row can actually use (advisor r3)."""
         hidden, new_caches = self.model(params, input_ids, positions,
                                         kv_caches, metadata)
         flat = hidden.reshape(-1, hidden.shape[-1])
@@ -151,21 +164,25 @@ class ModelRunner:
             need_logprobs=False)
         next_tok = jnp.where(greedy_mask, packed[:, 0], packed[:, 1])
         next_ids = next_tok[:, None].astype(jnp.int32)
-        next_pos = positions + 1
+        next_pos = jnp.minimum(positions + 1, pos_cap)
         p = next_pos[:, 0]
         page = jnp.take_along_axis(metadata.block_tables,
                                    (p // self.page_size)[:, None],
                                    axis=1)[:, 0]
         next_slots = jnp.minimum(
             page * self.page_size + p % self.page_size, self.num_slots)
+        # ctx tracks pos+1 exactly (sliding window never bursts), so the
+        # clamp rides along: an overshot row's fused-kernel write pos
+        # (ctx-1) pins to its cap slot.
         next_meta = metadata.replace(
             slot_mapping=next_slots,
-            context_lens=metadata.context_lens + 1)
+            context_lens=p + 1)
         return packed, next_ids, next_pos, next_meta, new_caches
 
     def _burst_scan(self, params, input_ids, positions, kv_caches,
                     metadata, tensors, bases, salt1, salt2, greedy_mask,
-                    *, num_steps: int, max_best_of: int, num_topk: int):
+                    pos_cap, *, num_steps: int, max_best_of: int,
+                    num_topk: int):
         """The whole K-step decode burst as ONE compiled program
         (lax.scan over _burst_step). On this platform each dispatch
         costs milliseconds of host<->device round-trip, so K separate
@@ -176,7 +193,7 @@ class ModelRunner:
             ids, pos, meta, kv = carry
             packed, ids, pos, meta, kv = self._burst_step(
                 params, ids, pos, kv, meta, tensors, bases, salt1,
-                salt2, greedy_mask, t,
+                salt2, greedy_mask, pos_cap, t,
                 max_best_of=max_best_of, num_topk=num_topk)
             return (ids, pos, meta, kv), packed
 
@@ -432,6 +449,17 @@ class ModelRunner:
         for i, t in enumerate(tables_list):
             tables[i, :len(t)] = t
 
+        # The pipelined decode page-writer (kv_write.py distinct_pages)
+        # prefetches cell i+1's page before cell i's writeback lands, so
+        # two tokens on one page would silently lose a write. CoW in
+        # append_slot makes decode pages sequence-exclusive; this guards
+        # the precondition loudly when debugging (advisor r3).
+        if __debug__ and _DEBUG_KV:
+            written = [s // self.page_size for s in slot_list]
+            assert len(set(written)) == len(written), (
+                "decode slots share a page — sequence-exclusive-pages "
+                f"precondition violated: {sorted(written)}")
+
         metadata = InputMetadata(
             slot_mapping=jnp.asarray(slots),
             block_tables=jnp.asarray(tables),
@@ -546,12 +574,24 @@ class ModelRunner:
         plan = self.sampler.plan(sampling, pad_to=padded)
 
         greedy = np.zeros((padded,), dtype=bool)
+        # Per-row last reserved position: pos + min(tokens remaining,
+        # model-len room, num_steps). Overshot rows clamp here instead
+        # of walking the block table past their page reservation
+        # (advisor r3); pad rows pin at their pad slot.
+        pos_cap = np.zeros((padded, 1), dtype=np.int32)
+        max_len = self.scheduler_config.max_model_len
         row = 0
         for md in seq_group_metadata_list:
             n = len(md.seq_data)
             if md.sampling_params.sampling_type == SamplingType.GREEDY:
                 greedy[row:row + n] = True
-            row += n
+            mt = md.sampling_params.max_tokens
+            for data in md.seq_data.values():
+                r = num_steps if mt is None else \
+                    mt - data.get_output_len()
+                r = max(0, min(r, max_len - data.get_len(), num_steps))
+                pos_cap[row, 0] = data.get_len() - 1 + r
+                row += 1
         greedy_mask = jnp.asarray(greedy)
         tensors = plan.tensors
         bases = jnp.asarray(plan.bases)
@@ -566,8 +606,9 @@ class ModelRunner:
         t0 = _time.perf_counter() if timing else 0.0
         packed, kv_caches = self._burst_scan_fn(
             params, ids, pos, kv_caches, meta, tensors, bases, salt1,
-            salt2, greedy_mask, num_steps=num_steps,
-            max_best_of=plan.max_best_of, num_topk=plan.num_topk)
+            salt2, greedy_mask, jnp.asarray(pos_cap),
+            num_steps=num_steps, max_best_of=plan.max_best_of,
+            num_topk=plan.num_topk)
         t1 = _time.perf_counter() if timing else 0.0
 
         all_packed = np.asarray(packed)                    # ONE sync

@@ -343,7 +343,7 @@ class Scheduler:
         return seq_group_metadata_list, scheduler_outputs
 
     def reserve_decode_burst(self, seq_group_metadata_list,
-                             max_extra: int) -> int:
+                             max_extra: int, extra_cap=None) -> int:
         """Reserve KV pages so the next `1 + returned` decode steps can
         run device-side without host scheduling (multi-step decode).
 
@@ -352,6 +352,12 @@ class Scheduler:
         refreshes the metadata's block-table snapshots. Returns 0 (plain
         single-step decode) when a shared tail makes slot positions
         CoW-dependent.
+
+        `extra_cap` (seq_id -> int) bounds how many extra slots a
+        sequence can actually USE (tokens remaining / model-len room):
+        a nearly-finished row reserves only that many pages — the
+        device loop clamps its position there — instead of the full
+        burst length (advisor r3).
         """
         seqs = [
             seq for g in self.running
@@ -362,6 +368,12 @@ class Scheduler:
         for seq in seqs:
             if not self.block_manager.has_unshared_tail(seq):
                 return 0
+
+        def cap(seq, t: int) -> int:
+            if extra_cap is None:
+                return t
+            return min(t, extra_cap.get(seq.seq_id, t))
+
         # Leave the allocator watermark untouched so speculative burst
         # reservations never starve prompt admission (can_allocate) or
         # peer decode groups (can_append_slot); also keep waiting work
@@ -371,7 +383,7 @@ class Scheduler:
         granted = 0
         for t in range(1, max_extra + 1):
             needed = sum(
-                self.block_manager.burst_blocks_needed(seq, t)
+                self.block_manager.burst_blocks_needed(seq, cap(seq, t))
                 for seq in seqs)
             if needed > free:
                 break
@@ -380,14 +392,15 @@ class Scheduler:
         if granted < max_extra and os.environ.get(
                 "APHRODITE_BURST_TIMING"):
             need_full = sum(
-                self.block_manager.burst_blocks_needed(seq, max_extra)
+                self.block_manager.burst_blocks_needed(
+                    seq, cap(seq, max_extra))
                 for seq in seqs)
             print(f"[burst reserve] want {max_extra} granted {granted}: "
                   f"free {free} needed(full) {need_full} seqs "
                   f"{len(seqs)} len0 {seqs[0].get_len()}", flush=True)
         if granted:
             for seq in seqs:
-                self.block_manager.reserve_slots(seq, granted)
+                self.block_manager.reserve_slots(seq, cap(seq, granted))
             for md in seq_group_metadata_list:
                 for seq_id in md.block_tables:
                     md.block_tables[seq_id] = [

@@ -60,8 +60,19 @@ def device_bench(step, init, iters: int = 0, reps: int = 3,
         lambda c: jnp.ravel(jax.tree_util.tree_leaves(c)[0])[:1])
 
     def run(loop):
-        out = loop(init)
-        np.asarray(pull(out))                # compile
+        # The remote-compile tunnel occasionally drops a response body;
+        # retry the compile a few times before giving up.
+        for attempt in range(4):
+            try:
+                out = loop(init)
+                np.asarray(pull(out))        # compile
+                break
+            except Exception as e:
+                if attempt == 3:
+                    raise
+                print(f"[retry] compile attempt {attempt}: {e!r}",
+                      file=sys.stderr, flush=True)
+                time.sleep(5.0)
         times = []
         for _ in range(reps):
             t0 = time.perf_counter()
@@ -351,6 +362,121 @@ def main() -> None:
         rtts.append(rtt)
         row(f"FULL decoder layer (gptq) b={B}", s * 1e3, LAYERS, "")
 
+    # --- the REAL burst step, whole-program, with ablations ---
+    # Reproduces ModelRunner._burst_step exactly (32-layer model, logits
+    # on all rows, fused sample, metadata advance) inside the same
+    # fori_loop structure the engine's lax.scan burst compiles to, so
+    # the gap between SUM(components) and the engine's measured ms/step
+    # is decomposed: (a) model-only vs 32x single-layer = cross-layer
+    # glue + scan carry handling; (b) +logits+sample vs model-only =
+    # head overhead in situ; (c) full burst vs bench.py's wall
+    # ms/step = host-side remainder.
+    if want("burst"):
+        from types import SimpleNamespace as _NS
+        from aphrodite_tpu.modeling.models.llama import LlamaForCausalLM
+        from aphrodite_tpu.modeling.layers.quantization.gptq import (
+            GPTQConfig)
+        from aphrodite_tpu.modeling.hf_loader import (
+            initialize_dummy_params)
+        from aphrodite_tpu.modeling.input_metadata import InputMetadata
+        from aphrodite_tpu.modeling.layers.sampler import (
+            Sampler, fused_sample)
+        from aphrodite_tpu.modeling.sampling_metadata import (
+            SamplingMetadata)
+        from aphrodite_tpu.common.sampling_params import SamplingParams
+        from aphrodite_tpu.common.sequence import SequenceData
+
+        cfg = _NS(
+            architectures=["LlamaForCausalLM"], vocab_size=VOCAB,
+            hidden_size=HIDDEN, intermediate_size=INTER,
+            num_hidden_layers=LAYERS, num_attention_heads=HEADS,
+            num_key_value_heads=KV_HEADS, rms_norm_eps=1e-5,
+            rope_theta=10000.0, max_position_embeddings=4096,
+            tie_word_embeddings=False, hidden_act="silu")
+        model = LlamaForCausalLM(
+            cfg, dtype=jnp.bfloat16,
+            linear_method=GPTQConfig(4, GROUP).get_linear_method())
+        mparams = initialize_dummy_params(model, seed=0)
+        pages_per_seq_b = -(-max(8, -(-ctx // PAGE)) // 8) * 8
+        npg = B * pages_per_seq_b + 1
+        kv_caches = [
+            (jnp.zeros((npg, PAGE, KV_HEADS * HEAD_DIM), jnp.bfloat16),
+             jnp.zeros((npg, PAGE, KV_HEADS * HEAD_DIM), jnp.bfloat16))
+            for _ in range(LAYERS)
+        ]
+        tbl = jnp.asarray(
+            np.arange(B * pages_per_seq_b).reshape(B, pages_per_seq_b),
+            jnp.int32)
+        meta0 = InputMetadata(
+            slot_mapping=jnp.asarray(
+                np.arange(B) * pages_per_seq_b * PAGE + (ctx - 1),
+                jnp.int32),
+            block_tables=tbl,
+            context_lens=jnp.full((B,), ctx, jnp.int32),
+            is_prompt=False)
+        ids0 = jnp.ones((B, 1), jnp.int32)
+        pos0 = jnp.full((B, 1), ctx - 1, jnp.int32)
+
+        sp = SamplingParams(temperature=0.0, max_tokens=16,
+                            ignore_eos=True)
+        sampling = SamplingMetadata(
+            seq_groups=[([i], sp) for i in range(B)],
+            seq_data={i: SequenceData([1, 2, 3]) for i in range(B)},
+            prompt_lens=[],
+            selected_token_indices=jnp.arange(B, dtype=jnp.int32),
+            categorized_sample_indices={})
+        splr = Sampler(VOCAB)
+        plan = splr.plan(sampling, pad_to=B)
+        sbases = jnp.asarray(plan.bases)
+        ssalt1 = jnp.asarray(plan.salt1)
+        ssalt2 = jnp.asarray(plan.salt2)
+        gmask = jnp.ones((B,), bool)
+
+        def model_only(c, t):
+            ids, pos, meta, kv = c
+            hidden, kv = model(mparams, ids, pos, kv, meta)
+            # Feedback: next ids depend on hidden (keeps the loop live);
+            # metadata advances exactly as the real burst does.
+            ids = jnp.maximum(
+                ids, (hidden[:, :1, 0] * jnp.bfloat16(0)).astype(
+                    jnp.int32))
+            pos2 = pos + 1
+            p = pos2[:, 0]
+            page = jnp.take_along_axis(
+                meta.block_tables, (p // PAGE)[:, None], axis=1)[:, 0]
+            meta = meta.replace(
+                slot_mapping=page * PAGE + p % PAGE,
+                context_lens=meta.context_lens + 1)
+            return (ids, pos2, meta, kv)
+
+        def full_burst(c, t):
+            ids, pos, meta, kv = c
+            hidden, kv = model(mparams, ids, pos, kv, meta)
+            flat = hidden.reshape(-1, hidden.shape[-1])
+            logits = model.compute_logits(mparams, flat)
+            packed, _ = fused_sample(
+                logits, plan.tensors, sbases, ssalt1 + t, ssalt2,
+                max_best_of=plan.max_best_of, num_topk=plan.num_topk,
+                need_logprobs=False)
+            next_tok = jnp.where(gmask, packed[:, 0], packed[:, 1])
+            ids = next_tok[:, None].astype(jnp.int32)
+            pos2 = pos + 1
+            p = pos2[:, 0]
+            page = jnp.take_along_axis(
+                meta.block_tables, (p // PAGE)[:, None], axis=1)[:, 0]
+            meta = meta.replace(
+                slot_mapping=page * PAGE + p % PAGE,
+                context_lens=meta.context_lens + 1)
+            return (ids, pos2, meta, kv)
+
+        for nm, fn in (("model-only(32L)", model_only),
+                       ("FULL burst step", full_burst)):
+            init = (ids0, pos0, meta0, [
+                (k + 0, v + 0) for (k, v) in kv_caches])
+            s, rtt = device_bench(fn, init, slow=True)
+            rtts.append(rtt)
+            row(f"BURST {nm} b={B}", s * 1e3, 1, "")
+
     # --- elementwise glue: rmsnorm x2 + silu_and_mul per layer ---
     if want("glue"):
         from aphrodite_tpu.modeling.layers.layernorm import rms_norm
@@ -381,7 +507,7 @@ def main() -> None:
     # FULL-layer cross-check (which already contains the components)
     # are reference rows, not addends.
     excluded = ("bf16 dense", "kv_write prefill-window", "FULL decoder",
-                "PREFILL")
+                "PREFILL", "BURST")
     for name, ms_call, n, ms_step, note in rows:
         print(f"{name:54s} {ms_call * 1e3:9.1f} {n:4d} {ms_step:8.3f}  "
               f"{note}")

@@ -75,7 +75,7 @@ async def run(args) -> dict:
             tpots.append((t1 - (first or t1)) / (n_out - 1))
         e2es.append(t1 - t0)
 
-    if args.warmup:
+    if getattr(args, "warmup", 0):
         # Warm the compile caches with the same workload (this
         # platform's remote compiles cost ~20 s per shape bucket; the
         # reference's CUDA-graph capture is likewise excluded from its

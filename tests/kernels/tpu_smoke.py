@@ -103,6 +103,63 @@ def main() -> int:
             pages_per_chunk=2), np.float32)[..., :d_true]
         check(f"tokenmajor head{d_true} padded", refs, got)
 
+    # -- fused-write drain protocol: page CONTENTS after multi-batch
+    #    fused decode (compiled, non-interpret) must match a host-side
+    #    slot write bit-for-bit. The cell-(i-2) writeback drain
+    #    (paged_attention.py:185-201,307-339) is the subtle part: a
+    #    dropped or mis-slotted writeback corrupts a page silently.
+    for Hq2, Hkv2, tag in ((32, 8, "n_hb=1"), (16, 16, "n_hb=2")):
+        B2, d2, page2, pps2 = 24, 128, 16, 8
+        pages2 = B2 * pps2 + 1
+        q2 = jnp.asarray(rs.randn(B2, Hq2, d2) * 0.1, jnp.bfloat16)
+        kp2 = jnp.asarray(rs.randn(pages2, page2, Hkv2 * d2) * 0.1,
+                          jnp.bfloat16)
+        vp2 = jnp.asarray(rs.randn(pages2, page2, Hkv2 * d2) * 0.1,
+                          jnp.bfloat16)
+        # Sequence-exclusive pages (the engine decode contract), in a
+        # shuffled order so page ids don't correlate with batch index.
+        perm = rs.permutation(pages2 - 1)
+        bt2 = jnp.asarray(perm[:B2 * pps2].reshape(B2, pps2), jnp.int32)
+        ctx2_np = rs.randint(1, pps2 * page2, (B2,)).astype(np.int32)
+        ctx2_np[5] = 0                     # padded row: no write
+        ctx2_np[7] = 1                     # minimum context
+        ctx2_np[11] = pps2 * page2         # full table
+        ctx2 = jnp.asarray(ctx2_np)
+        kn2 = jnp.asarray(rs.randn(B2, Hkv2, d2) * 0.1, jnp.bfloat16)
+        vn2 = jnp.asarray(rs.randn(B2, Hkv2, d2) * 0.1, jnp.bfloat16)
+        for ppc2 in (2, pps2):             # chunked + single-chunk
+            outf, kpf, vpf = paged_decode_attention(
+                q2, kp2, vp2, bt2, ctx2, knew=kn2, vnew=vn2,
+                scale=scale, pages_per_chunk=ppc2)
+            ekp = np.asarray(kp2, np.float32).copy()
+            evp = np.asarray(vp2, np.float32).copy()
+            knf = np.asarray(kn2, np.float32).reshape(B2, Hkv2 * d2)
+            vnf = np.asarray(vn2, np.float32).reshape(B2, Hkv2 * d2)
+            for i in range(B2):
+                c = int(ctx2_np[i])
+                if c == 0:
+                    continue
+                pg = int(np.asarray(bt2)[i, (c - 1) // page2])
+                ekp[pg, (c - 1) % page2] = knf[i]
+                evp[pg, (c - 1) % page2] = vnf[i]
+            errk = np.abs(np.asarray(kpf, np.float32) - ekp).max()
+            errv = np.abs(np.asarray(vpf, np.float32) - evp).max()
+            name = f"fused-write contents {tag} ppc={ppc2}"
+            print(f"{name}: k err {errk:.2e} v err {errv:.2e}")
+            if not (errk == 0.0 and errv == 0.0):   # bit-for-bit
+                failures.append((name, max(errk, errv)))
+            # attention output must equal the reference computed over
+            # the POST-write pages (the injected token participates)
+            ref2 = np.asarray(paged_decode_attention_ref(
+                q2, jnp.asarray(ekp, jnp.bfloat16),
+                jnp.asarray(evp, jnp.bfloat16), bt2, ctx2, scale),
+                np.float32)
+            ref2[ctx2_np == 0] = 0.0
+            erro = np.abs(np.asarray(outf, np.float32) - ref2).max()
+            print(f"{name}: out err {erro:.2e}")
+            if not (erro < 3e-2):
+                failures.append((name + " out", erro))
+
     # -- prefill page writer (whole-page DMA, partial tail, OOB) --
     from aphrodite_tpu.ops.pallas.kv_write import (write_kv_pages,
                                                    write_kv_pages_prefill)

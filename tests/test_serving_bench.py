@@ -13,7 +13,7 @@ def test_serving_harness(tiny_model_dir):
         model=tiny_model_dir, load_format="dummy", dtype="float32",
         quantization=None, kv_cache_dtype="auto", max_num_seqs=4,
         max_model_len=256, multi_step=4, request_rate=float("inf"),
-        num_requests=6, prompt_len=12, output_len=5)
+        num_requests=6, prompt_len=12, output_len=5, warmup=0)
     result = asyncio.run(run(args))
     assert result["metric"] == "serving_p50_ttft_s"
     d = result["detail"]
