@@ -239,15 +239,15 @@ class AphroditeEngine:
         if scheduler_outputs.is_empty():
             return self._process_model_outputs([], scheduler_outputs)
 
-        burst = self._burst_steps(seq_group_metadata_list,
-                                  scheduler_outputs)
+        burst, extra_cap = self._burst_steps(seq_group_metadata_list,
+                                             scheduler_outputs)
         if burst > 1:
             outputs_list = self.executor.execute_decode_burst(
                 seq_group_metadata_list,
                 scheduler_outputs.blocks_to_swap_in,
                 scheduler_outputs.blocks_to_swap_out,
                 scheduler_outputs.blocks_to_copy,
-                num_steps=burst)
+                num_steps=burst, extra_cap=extra_cap)
             return self._process_burst_outputs(outputs_list,
                                                scheduler_outputs)
 
@@ -259,8 +259,10 @@ class AphroditeEngine:
         return self._process_model_outputs(output, scheduler_outputs)
 
     def _burst_steps(self, seq_group_metadata_list,
-                     scheduler_outputs) -> int:
-        """How many decode steps to run device-side this round.
+                     scheduler_outputs):
+        """(burst length, per-seq useful-step caps) for this round —
+        the caps map is the single source of truth shared by the page
+        reservation and the device position clamp.
 
         Eligible: decode round, no sliding window, and every group is a
         single-sequence greedy/random group without history-dependent
@@ -269,9 +271,9 @@ class AphroditeEngine:
         """
         max_steps = self.scheduler_config.multi_step
         if max_steps <= 1 or scheduler_outputs.prompt_run:
-            return 1
+            return 1, None
         if self.model_config.get_sliding_window() is not None:
-            return 1
+            return 1, None
         remaining = []
         extra_cap = {}          # seq_id -> max USEFUL extra slots
         for md in seq_group_metadata_list:
@@ -282,7 +284,7 @@ class AphroditeEngine:
                     or abs(p.presence_penalty) >= 1e-5
                     or abs(p.frequency_penalty) >= 1e-5
                     or abs(p.repetition_penalty - 1.0) >= 1e-5):
-                return 1
+                return 1, None
             seq_id = next(iter(md.seq_data))
             data = md.seq_data[seq_id]
             # Per-row useful steps: tokens remaining (unbounded groups
@@ -300,7 +302,7 @@ class AphroditeEngine:
         want = max(1, min(max_steps,
                           max(remaining) if remaining else max_steps))
         if want <= 1:
-            return 1
+            return 1, None
         # Bucket to powers of two: each burst length is its own compiled
         # scan program, and compiles are expensive. Round UP when the
         # overshoot is small (overshot rows' extra tokens are dropped by
@@ -318,7 +320,7 @@ class AphroditeEngine:
         # reservation.
         granted = self.scheduler.reserve_decode_burst(
             seq_group_metadata_list, want - 1, extra_cap)
-        return 1 << ((1 + granted).bit_length() - 1)
+        return 1 << ((1 + granted).bit_length() - 1), extra_cap
 
     def _process_burst_outputs(
             self, outputs_list: List[SamplerOutput],

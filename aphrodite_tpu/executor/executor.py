@@ -241,6 +241,7 @@ class TPUExecutor:
         blocks_to_swap_out: Dict[int, int],
         blocks_to_copy: Dict[int, List[int]],
         num_steps: int,
+        extra_cap=None,
     ) -> List[SamplerOutput]:
         """Multi-step decode: one scheduling round drives `num_steps`
         device iterations (see ModelRunner.execute_decode_burst)."""
@@ -248,6 +249,6 @@ class TPUExecutor:
                        blocks_to_swap_out)
         outputs, new_caches = self.model_runner.execute_decode_burst(
             seq_group_metadata_list, self.cache_engine.kv_caches,
-            num_steps, blocks_to_copy)
+            num_steps, blocks_to_copy, extra_cap)
         self.cache_engine.kv_caches = new_caches
         return outputs

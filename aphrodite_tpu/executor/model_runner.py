@@ -51,9 +51,9 @@ _DECODE_BATCH_BUCKETS = [1, 2, 4, 8, 12, 16, 24, 32, 48, 64, 96, 128,
                          192, 256, 384, 512]
 
 # Enables the (host-side) sequence-exclusive-pages precondition check
-# for the pipelined decode KV writer.
+# for the pipelined decode KV writer ("" or "0" = off).
 import os as _os
-_DEBUG_KV = bool(_os.environ.get("APHRODITE_DEBUG_KV"))
+_DEBUG_KV = _os.environ.get("APHRODITE_DEBUG_KV", "") not in ("", "0")
 _PREFILL_BATCH_BUCKETS = [1, 2, 4, 8, 16, 32]
 _PAGES_BUCKET = 8          # block-table width granularity (Pallas chunk)
 
@@ -504,6 +504,10 @@ class ModelRunner:
         kv_caches: List[Tuple[jax.Array, jax.Array]],
         blocks_to_copy: Optional[Dict[int, List[int]]] = None,
     ) -> Tuple[SamplerOutput, List[Tuple[jax.Array, jax.Array]]]:
+        import os as _os
+        import time as _time
+        timing = _os.environ.get("APHRODITE_BURST_TIMING")
+        t0 = _time.perf_counter() if timing else 0.0
         kv_caches = self._apply_block_copies(kv_caches, blocks_to_copy)
 
         if not seq_group_metadata_list:
@@ -522,11 +526,13 @@ class ModelRunner:
         params = self._params_with_lora(
             seq_group_metadata_list, inputs["input_ids"].shape[0],
             rows_per_group)
+        t1 = _time.perf_counter() if timing else 0.0
         logits, kv_caches = self._step_fn(
             params, inputs["input_ids"], inputs["positions"],
             kv_caches, inputs["metadata"], inputs["sel"],
             is_prompt=inputs["is_prompt"],
             use_prefix=inputs["use_prefix"])
+        t2 = _time.perf_counter() if timing else 0.0
 
         has_processors = any(
             p.logits_processors for _, p in sampling.seq_groups)
@@ -541,13 +547,24 @@ class ModelRunner:
         # the padded row bucket; the ONLY blocking transfer per step is
         # the packed result pull in the middle here.
         plan = self.sampler.plan(sampling, pad_to=logits.shape[0])
+        t3 = _time.perf_counter() if timing else 0.0
         packed, logprobs_dev = _fused_sample_jit(
             logits, plan.tensors, jnp.asarray(plan.bases),
             jnp.asarray(plan.salt1), jnp.asarray(plan.salt2),
             max_best_of=plan.max_best_of, num_topk=plan.num_topk,
             need_logprobs=plan.need_logprobs)
-        output = self.sampler.finalize(sampling, plan, np.asarray(packed),
+        packed_np = np.asarray(packed)                     # ONE sync
+        t4 = _time.perf_counter() if timing else 0.0
+        output = self.sampler.finalize(sampling, plan, packed_np,
                                        logprobs_dev)
+        if timing:
+            t5 = _time.perf_counter()
+            print(f"[step prompt={is_prompt} rows={logits.shape[0]}] "
+                  f"prep {(t1 - t0) * 1e3:.0f} ms, dispatch "
+                  f"{(t2 - t1) * 1e3:.0f} ms, plan "
+                  f"{(t3 - t2) * 1e3:.0f} ms, sample+sync "
+                  f"{(t4 - t3) * 1e3:.0f} ms, finalize "
+                  f"{(t5 - t4) * 1e3:.0f} ms", flush=True)
         return output, kv_caches
 
     def execute_decode_burst(
@@ -556,6 +573,7 @@ class ModelRunner:
         kv_caches: List[Tuple[jax.Array, jax.Array]],
         num_steps: int,
         blocks_to_copy: Optional[Dict[int, List[int]]] = None,
+        extra_cap: Optional[Dict[int, int]] = None,
     ) -> Tuple[List[SamplerOutput], List[Tuple[jax.Array, jax.Array]]]:
         """Run `num_steps` decode iterations with device-side token
         feedback as ONE compiled scan dispatch and ONE host sync (the
@@ -574,22 +592,22 @@ class ModelRunner:
         plan = self.sampler.plan(sampling, pad_to=padded)
 
         greedy = np.zeros((padded,), dtype=bool)
-        # Per-row last reserved position: pos + min(tokens remaining,
-        # model-len room, num_steps). Overshot rows clamp here instead
-        # of walking the block table past their page reservation
-        # (advisor r3); pad rows pin at their pad slot.
+        # Per-row last reserved position: pos + the engine's per-seq
+        # useful-step cap (tokens remaining / model-len room — ONE
+        # source of truth, computed in AphroditeEngine._burst_steps and
+        # used for the page reservation), clamped to the burst length.
+        # Overshot rows pin here instead of walking the block table
+        # past their reservation (advisor r3); pad rows pin at their
+        # pad slot.
         pos_cap = np.zeros((padded, 1), dtype=np.int32)
-        max_len = self.scheduler_config.max_model_len
+        cap_of = extra_cap or {}
         row = 0
         for md in seq_group_metadata_list:
             n = len(md.seq_data)
             if md.sampling_params.sampling_type == SamplingType.GREEDY:
                 greedy[row:row + n] = True
-            mt = md.sampling_params.max_tokens
-            for data in md.seq_data.values():
-                r = num_steps if mt is None else \
-                    mt - data.get_output_len()
-                r = max(0, min(r, max_len - data.get_len(), num_steps))
+            for seq_id, data in md.seq_data.items():
+                r = min(cap_of.get(seq_id, num_steps), num_steps)
                 pos_cap[row, 0] = data.get_len() - 1 + r
                 row += 1
         greedy_mask = jnp.asarray(greedy)

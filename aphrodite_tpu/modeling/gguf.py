@@ -397,27 +397,35 @@ def _reverse_hf_permute(w: np.ndarray, n_heads: int) -> np.ndarray:
 class RawGGUF:
     """A still-quantized tensor handed to GGUFLinearMethod: the packed
     ggml blocks plus enough metadata to repack for the at-rest Pallas
-    matmuls (layers/quantization/gguf.py)."""
+    matmuls (layers/quantization/gguf.py). `compat` marks members of
+    MIXED sibling groups: they convert to the shared grouped-int8 form
+    instead of their native packing."""
 
-    __slots__ = ("type_name", "blocks", "shape")
+    __slots__ = ("type_name", "blocks", "shape", "compat")
 
     def __init__(self, type_name: str, blocks: np.ndarray,
-                 shape: Tuple[int, int]) -> None:
+                 shape: Tuple[int, int], compat: bool = False) -> None:
         self.type_name = type_name
         self.blocks = blocks          # [n_blocks, bytes_per_block] u8
         self.shape = shape            # (out_features, in_features)
+        self.compat = compat
 
 
-# ggml formats the at-rest kernels handle; weight name fragments that
-# route through a LinearMethod (projection matmuls only — embeddings,
-# norms, lm_head always dequantize).
-_AT_REST_TYPES = ("Q4_K", "Q8_0")
+# ggml formats with a NATIVE at-rest packing of their own (Q6_K's
+# native form IS the shared grouped-int8, so it routes through 'i8g');
+# weight name fragments that route through a LinearMethod (projection
+# matmuls only — embeddings, norms, lm_head always dequantize).
+_NATIVE_PACKED = ("Q4_K", "Q8_0")
 _PROJ_FRAGMENTS = ("q_proj", "k_proj", "v_proj", "o_proj",
                    "gate_proj", "up_proj", "down_proj")
 # Shards merged into one matmul must agree on representation: a merged
 # layer can't be half packed, half dense (apply() dispatches on the
 # bucket's param names). llama.cpp mixes types inside qkv (attn_v is
-# often Q6_K in Q4_K_M files), so at-rest routing is per GROUP.
+# often Q6_K in Q4_K_M files), so at-rest routing is per GROUP: a
+# uniform group keeps its native packing; a mixed group whose members
+# are all block-quantized unifies on grouped int8 (exact for
+# Q6_K/Q8_0, a negligible requantization for the rest) — only groups
+# containing fp tensors fall back to dense.
 _STACKED_SIBLINGS = {
     "q_proj": ("q_proj", "k_proj", "v_proj"),
     "k_proj": ("q_proj", "k_proj", "v_proj"),
@@ -430,9 +438,9 @@ _STACKED_SIBLINGS = {
 def gguf_weights_iterator(path: str, at_rest: bool = False
                           ) -> Iterator[Tuple[str, np.ndarray]]:
     """Yield (hf_name, tensor) for every tensor in the file. Block
-    formats dequantize on the fly; with `at_rest`, Q4_K/Q8_0 projection
-    weights instead yield RawGGUF packed blocks for the quantized
-    execution path."""
+    formats dequantize on the fly; with `at_rest`, block-quantized
+    projection weights instead yield RawGGUF packed blocks for the
+    quantized execution path."""
     reader = GGUFReader(path)
     n_heads = int(reader.fields.get("llama.attention.head_count", 0))
     n_kv = int(reader.fields.get("llama.attention.head_count_kv",
@@ -445,12 +453,17 @@ def gguf_weights_iterator(path: str, at_rest: bool = False
         except ValueError:
             pass
 
-    def group_at_rest(name: str, frag: str) -> bool:
-        """Every sibling merged into the same matmul must be an
-        at-rest type AND the same type (one packed form per bucket)."""
+    def group_mode(name: str, frag: str):
+        """How this tensor's merged bucket executes: 'native' (uniform
+        at-rest type), 'i8g' (mixed-but-all-quantized -> shared
+        grouped-int8), or None (dense fallback)."""
         sibs = _STACKED_SIBLINGS.get(frag, (frag,))
         types = {type_of.get(name.replace(frag, s)) for s in sibs}
-        return len(types) == 1 and types <= set(_AT_REST_TYPES)
+        if len(types) == 1 and types <= set(_NATIVE_PACKED):
+            return "native"
+        if types <= set(_DEQUANT):     # incl. uniform Q6_K
+            return "i8g"
+        return None
 
     for info in reader.tensors:
         try:
@@ -461,11 +474,11 @@ def gguf_weights_iterator(path: str, at_rest: bool = False
             logger.debug("Skipping GGUF tensor %s", info.name)
             continue
         tname, block, bpb = GGML_TYPES[info.ggml_type]
-        frag = next((f for f in _PROJ_FRAGMENTS if f".{f}." in name),
-                    None)
-        if (at_rest and tname in _AT_REST_TYPES and
-                len(info.shape) == 2 and frag is not None and
-                group_at_rest(name, frag)):
+        mode = group_mode(name, frag) \
+            if (frag := next((f for f in _PROJ_FRAGMENTS
+                              if f".{f}." in name), None)) else None
+        if (at_rest and tname in _DEQUANT and
+                len(info.shape) == 2 and mode is not None):
             with open(reader.path, "rb") as f:
                 f.seek(reader.data_start + info.offset)
                 raw = np.frombuffer(f.read(info.n_bytes), np.uint8)
@@ -477,7 +490,8 @@ def gguf_weights_iterator(path: str, at_rest: bool = False
             elif name.endswith("self_attn.k_proj.weight") and n_kv:
                 blocks = _permute_raw_rows(blocks, out_f, in_f, block,
                                            n_kv)
-            yield name, RawGGUF(tname, blocks, (out_f, in_f))
+            yield name, RawGGUF(tname, blocks, (out_f, in_f),
+                                compat=(mode == "i8g"))
             continue
         arr = reader.load(info)
         if name.endswith("self_attn.q_proj.weight") and n_heads:

@@ -90,6 +90,58 @@ def q8_0_to_kernel(blocks: np.ndarray, out_features: int,
     return qs, d
 
 
+def q6k_to_kernel(blocks: np.ndarray, out_features: int,
+                  in_features: int, scale_dtype=np.float32
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+    """Raw Q6_K superblocks [n, 210] -> the grouped-int8 form
+    (qs [in, out] int8 = codes - 32, d16 [in/16, out] = d * subscale):
+    EXACT — Q6_K's value index // 16 is its scale index, so the 6-bit
+    codes land on the int8 grid with no requantization."""
+    from aphrodite_tpu.modeling.gguf import _f16
+    n = blocks.shape[0]
+    ql = blocks[:, :128]
+    qh = blocks[:, 128:192]
+    sc = blocks[:, 192:208].view(np.int8).astype(np.float32)  # [n, 16]
+    d = _f16(blocks[:, 208:210])[:, 0]                        # [n]
+    codes = np.empty((n, 256), dtype=np.int16)
+    for half in range(2):
+        qlh = ql[:, 64 * half:64 * (half + 1)]
+        qhh = qh[:, 32 * half:32 * (half + 1)]
+        quarters = (
+            (qlh[:, :32] & 0xF) | (((qhh >> 0) & 3) << 4),
+            (qlh[:, 32:] & 0xF) | (((qhh >> 2) & 3) << 4),
+            (qlh[:, :32] >> 4) | (((qhh >> 4) & 3) << 4),
+            (qlh[:, 32:] >> 4) | (((qhh >> 6) & 3) << 4),
+        )
+        for quarter, q in enumerate(quarters):
+            codes[:, 128 * half + 32 * quarter:
+                  128 * half + 32 * (quarter + 1)] = q.astype(np.int16)
+    qs = (codes - 32).astype(np.int8)
+    dl = d[:, None] * sc                                      # [n, 16]
+    qs = qs.reshape(out_features, in_features).T.copy()
+    d16 = dl.reshape(out_features, in_features // 16).T.astype(
+        scale_dtype)
+    return qs, d16
+
+
+def dense_to_i8g(w: np.ndarray, scale_dtype=np.float32
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """Requantize a dense [out, in] weight into the grouped-int8 form
+    (per-(16-input-row, column) symmetric scales). Used for members of
+    MIXED at-rest sibling groups whose native packing can't share a
+    bucket (e.g. the Q4_K half of a Q4_K_M qkv): ~0.4% max relative
+    error per group — far below the error of the source 4-bit format
+    itself."""
+    wt = np.asarray(w, dtype=np.float32).T                # [in, out]
+    in_f, out_f = wt.shape
+    g = wt.reshape(in_f // 16, 16, out_f)
+    amax = np.abs(g).max(axis=1)                          # [in/16, out]
+    s = np.where(amax > 0, amax / 127.0, 1.0)
+    qs = np.clip(np.round(g / s[:, None, :]), -127, 127)
+    return (qs.reshape(in_f, out_f).astype(np.int8),
+            s.astype(scale_dtype))
+
+
 class GGUFLinearMethod(LinearMethod):
     """Per-tensor format dispatch: Q4_K/Q8_0 packed params, everything
     else a dense `weight` (dequantized at load)."""
@@ -119,6 +171,7 @@ class GGUFLinearMethod(LinearMethod):
             "ml": P(in_axis, out_axis),
             "qs": P(in_axis, out_axis),
             "d": P(in_axis, out_axis),
+            "d16": P(in_axis, out_axis),
             "weight": P(in_axis, out_axis),
         }
         if bias:
@@ -141,6 +194,10 @@ class GGUFLinearMethod(LinearMethod):
             rep_m = jnp.repeat(params["ml"].astype(jnp.float32), 32,
                                axis=0)
             return (codes * rep - rep_m).astype(dtype)
+        if "qs" in params and "d16" in params:
+            rep = jnp.repeat(params["d16"].astype(jnp.float32), 16,
+                             axis=0)
+            return (params["qs"].astype(jnp.float32) * rep).astype(dtype)
         if "qs" in params:
             rep = jnp.repeat(params["d"].astype(jnp.float32), 32,
                              axis=0)
@@ -164,6 +221,18 @@ class GGUFLinearMethod(LinearMethod):
                     if "bias" in params:
                         y = y + params["bias"]
                     return y
+        elif "qs" in params and "d16" in params:
+            K, N = params["qs"].shape
+            if jax.default_backend() == "tpu":
+                from aphrodite_tpu.ops.pallas.quant_matmul import (
+                    gguf_i8g_matmul, gguf_i8g_supported)
+                if gguf_i8g_supported(K, N):
+                    y = gguf_i8g_matmul(x.reshape(-1, K), params["qs"],
+                                        params["d16"])
+                    y = y.reshape(*lead, N)
+                    if "bias" in params:
+                        y = y + params["bias"]
+                    return y
         elif "qs" in params:
             K, N = params["qs"].shape
             if jax.default_backend() == "tpu":
@@ -183,22 +252,44 @@ class GGUFLinearMethod(LinearMethod):
         return y
 
     def load_weight(self, params, name: str, hf_tensor) -> np.ndarray:
-        from aphrodite_tpu.modeling.gguf import RawGGUF
+        from aphrodite_tpu.modeling.gguf import _DEQUANT, RawGGUF
         if isinstance(hf_tensor, RawGGUF):
             out_f, in_f = hf_tensor.shape
-            if hf_tensor.type_name == "Q4_K":
+            tname = hf_tensor.type_name
+            if tname == "Q6_K":
+                # Native form IS grouped int8 (exact repack) — used
+                # both standalone and inside mixed groups.
+                qs, d16 = q6k_to_kernel(hf_tensor.blocks, out_f, in_f)
+                self.pending_rename = "qs"
+                self.pending_sidecar = {"d16": d16}
+                return qs
+            if hf_tensor.compat:
+                # Member of a mixed sibling group: unify on grouped
+                # int8 so the merged bucket has one representation.
+                if tname == "Q8_0":
+                    qs, d = q8_0_to_kernel(hf_tensor.blocks, out_f,
+                                           in_f)
+                    d16 = np.repeat(d, 2, axis=0)      # exact
+                else:
+                    dense = _DEQUANT[tname](hf_tensor.blocks).reshape(
+                        out_f, in_f)
+                    qs, d16 = dense_to_i8g(dense)
+                self.pending_rename = "qs"
+                self.pending_sidecar = {"d16": d16}
+                return qs
+            if tname == "Q4_K":
                 qweight, dl, ml = q4k_to_kernel(hf_tensor.blocks,
                                                 out_f, in_f)
                 self.pending_rename = "qweight"
                 self.pending_sidecar = {"dl": dl, "ml": ml}
                 return qweight
-            if hf_tensor.type_name == "Q8_0":
+            if tname == "Q8_0":
                 qs, d = q8_0_to_kernel(hf_tensor.blocks, out_f, in_f)
                 self.pending_rename = "qs"
                 self.pending_sidecar = {"d": d}
                 return qs
             raise ValueError(
-                f"RawGGUF type {hf_tensor.type_name} reached the "
+                f"RawGGUF type {tname} reached the "
                 "linear method; the iterator should dequantize it")
         # Dense (load-time-dequantized or fp) tensor: HF [out, in].
         if name == "weight":

@@ -82,11 +82,35 @@ class SqueezeLLMLinearMethod(LinearMethod):
 
     def apply(self, params: Dict[str, jax.Array],
               x: jax.Array) -> jax.Array:
-        w = self.dequantize(params, x.dtype)
-        y = x @ w
+        in_features = params["qweight"].shape[0] * \
+            self.config.pack_factor
+        out_features = params["lookup_table"].shape[0]
+        if self._use_pallas(in_features, out_features):
+            from aphrodite_tpu.ops.pallas.quant_matmul import (
+                squeezellm_matmul)
+            lead = x.shape[:-1]
+            y = squeezellm_matmul(
+                x.reshape(-1, in_features), params["qweight"],
+                params["lookup_table"])
+            y = y.reshape(*lead, out_features)
+        else:
+            w = self.dequantize(params, x.dtype)
+            y = x @ w
         if "bias" in params:
             y = y + params["bias"]
         return y
+
+    def _use_pallas(self, in_features: int, out_features: int) -> bool:
+        """Fused LUT kernel on TPU (codes stay packed in HBM); the XLA
+        gather fallback everywhere else re-materializes the dense
+        weight every step."""
+        import os
+        if os.environ.get("APHRODITE_DISABLE_PALLAS_QUANT"):
+            return False
+        from aphrodite_tpu.ops.pallas.quant_matmul import (
+            squeezellm_supported)
+        return (jax.default_backend() == "tpu" and
+                squeezellm_supported(in_features, out_features))
 
     def load_weight(self, params, name: str,
                     hf_tensor: np.ndarray) -> np.ndarray:

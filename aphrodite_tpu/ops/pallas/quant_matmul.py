@@ -498,6 +498,157 @@ def gguf_q8_matmul(x: jax.Array, qs: jax.Array, d: jax.Array, *,
     return out[:m] if padded_m != m else out
 
 
+def _gguf_i8g_kernel(x_ref, qs_ref, d_ref, o_ref, acc_ref, *,
+                     k_tiles: int):
+    """Grouped-int8 tile: int8 rows with a scale per 16-row group
+    (Q6_K's native granularity). 16-row sublane slices of int8 are
+    unaligned (the int8 tile is 32 rows), so each aligned 32-row slice
+    selects between its two scale rows with a row-iota mask."""
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    n32 = qs_ref.shape[0] // 32
+    chunks = []
+    for c in range(n32):
+        q = qs_ref[c * 32:(c + 1) * 32].astype(jnp.float32)
+        j = jax.lax.broadcasted_iota(jnp.int32, q.shape, 0)
+        dlo = d_ref[2 * c].astype(jnp.float32)        # [1, bn]
+        dhi = d_ref[2 * c + 1].astype(jnp.float32)
+        chunks.append(
+            (q * jnp.where(j < 16, dlo, dhi)).astype(x_ref.dtype))
+    w = chunks[0] if n32 == 1 else jax.lax.concatenate(chunks, 0)
+    acc_ref[...] += jnp.dot(x_ref[...], w,
+                            preferred_element_type=jnp.float32)
+
+    @pl.when(k == k_tiles - 1)
+    def _flush():
+        o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+
+def gguf_i8g_supported(in_features: int, out_features: int) -> bool:
+    return in_features % 256 == 0 and out_features % 128 == 0
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def gguf_i8g_matmul(x: jax.Array, qs: jax.Array, d16: jax.Array, *,
+                    interpret: bool = False) -> jax.Array:
+    """y[m, N] = x[m, K] @ (int8 qs[K, N] * d16[K//16, N]) — the
+    grouped-int8 at-rest form: Q6_K repacks into it exactly
+    (codes - 32, d*subscale rows), Q8_0 by repeating its per-32 scale
+    rows, and other ggml block types requantize into it at load so
+    MIXED sibling groups (llama.cpp Q4_K_M puts Q6_K in attn_v/ffn_down
+    next to Q4_K) still execute packed instead of falling back to a
+    dense bf16 copy. Reference: the per-type mat-vec dispatch in
+    `kernels/quantization/gguf/gguf_kernel.cu`."""
+    m, K = x.shape
+    N = qs.shape[1]
+    G = K // 16
+    block_k = 512 if K % 512 == 0 else 256
+    block_m, block_n, padded_m = _tile_mn(m, N, x.dtype)
+    if padded_m != m:
+        x = jnp.pad(x, ((0, padded_m - m), (0, 0)))
+    k_tiles = K // block_k
+    grid = (padded_m // block_m, N // block_n, k_tiles)
+    gpt = block_k // 16
+
+    out = pl.pallas_call(
+        functools.partial(_gguf_i8g_kernel, k_tiles=k_tiles),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_m, block_k), lambda i, n, k: (i, k)),
+            pl.BlockSpec((block_k, block_n), lambda i, n, k: (k, n)),
+            pl.BlockSpec((gpt, 1, block_n), lambda i, n, k: (k, 0, n)),
+        ],
+        out_specs=pl.BlockSpec((block_m, block_n),
+                               lambda i, n, k: (i, n)),
+        out_shape=jax.ShapeDtypeStruct((padded_m, N), x.dtype),
+        scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(x, qs, d16.reshape(G, 1, N))
+    return out[:m] if padded_m != m else out
+
+
+# ---------------------------------------------- SqueezeLLM 4-bit LUT --
+
+def _sqllm_kernel(x_ref, qw_ref, lut_ref, o_ref, acc_ref, *,
+                  k_tiles: int):
+    """Non-uniform 4-bit LUT tile: unpack codes plane-wise, materialize
+    the weight tile with a 16-way select against the per-column codebook
+    rows, accumulate on the MXU. This is the TPU-native form of the CUDA
+    shared-memory LUT gather
+    (`kernels/quantization/squeezellm/quant_cuda_kernel.cu`): TPUs have
+    no per-lane scatter/gather, but a 16-way masked select is pure VPU
+    work the codes stream through once per tile — the packed codes are
+    the only HBM traffic."""
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = _unpack_planes(qw_ref[...], 4)       # [block_k, bn] plane order
+    w = jnp.zeros(q.shape, jnp.float32)
+    for v in range(16):
+        w = jnp.where(q == v, lut_ref[v:v + 1, :].astype(jnp.float32),
+                      w)
+    acc_ref[...] += jnp.dot(x_ref[...], w.astype(x_ref.dtype),
+                            preferred_element_type=jnp.float32)
+
+    @pl.when(k == k_tiles - 1)
+    def _flush():
+        o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+
+def squeezellm_supported(in_features: int, out_features: int) -> bool:
+    return in_features % 256 == 0 and out_features % 128 == 0
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def squeezellm_matmul(x: jax.Array, qweight: jax.Array,
+                      lookup_table: jax.Array, *,
+                      interpret: bool = False) -> jax.Array:
+    """y[m, N] = x[m, K] @ w with w[i, j] = lookup_table[j, q[i, j]]:
+    qweight [K//8, N] int32 (8 nibbles along K, SqueezeLLM layout),
+    lookup_table [N, 16] per-output-channel codebook. Codes stay packed
+    in HBM; the dense weight matrix never materializes."""
+    m, K = x.shape
+    N = qweight.shape[1]
+    block_k = 512 if K % 512 == 0 else 256
+    block_m, block_n, padded_m = _tile_mn(m, N, x.dtype)
+    # Whole-block plane unpack -> x column permutation over each
+    # block_k span (same blockwise transpose trick as gptq_matmul).
+    r = block_k // 8
+    x = x.reshape(m, K // block_k, r, 8).swapaxes(2, 3).reshape(m, K)
+    if padded_m != m:
+        x = jnp.pad(x, ((0, padded_m - m), (0, 0)))
+    k_tiles = K // block_k
+    grid = (padded_m // block_m, N // block_n, k_tiles)
+
+    out = pl.pallas_call(
+        functools.partial(_sqllm_kernel, k_tiles=k_tiles),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_m, block_k), lambda i, n, k: (i, k)),
+            pl.BlockSpec((block_k // 8, block_n),
+                         lambda i, n, k: (k, n)),
+            pl.BlockSpec((16, block_n), lambda i, n, k: (0, n)),
+        ],
+        out_specs=pl.BlockSpec((block_m, block_n),
+                               lambda i, n, k: (i, n)),
+        out_shape=jax.ShapeDtypeStruct((padded_m, N), x.dtype),
+        scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(x, qweight, lookup_table.T)
+    return out[:m] if padded_m != m else out
+
+
 # -------------------------------------------------------- int8 dense --
 
 def _int8_kernel(x_ref, w_ref, s_ref, o_ref, acc_ref, *, k_tiles: int):

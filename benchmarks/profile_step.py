@@ -38,37 +38,45 @@ PAGE = 16
 
 
 def device_bench(step, init, iters: int = 0, reps: int = 3,
-                 slow: bool = False):
-    """step: (carry, i) -> carry, pure device. Returns (s/iter, rtt).
+                 slow: bool = False, donate: bool = False):
+    """step: (carry, i) -> carry, pure device. Returns (s/iter, rtt)
+    — and with donate=True, (s/iter, rtt, final_state).
 
     Dual-iteration-count measurement: the same loop is compiled at a
     small and a large trip count and per-iteration time is the slope
     (t_big - t_small) / (n_big - n_small) — the sync round-trip and any
     fixed dispatch overhead cancel exactly (on this platform the sync
     pull costs ~100 ms of tunnel RTT, far above small-kernel runtimes,
-    so subtracting a separately-measured RTT is too noisy)."""
+    so subtracting a separately-measured RTT is too noisy).
+
+    donate=True threads ONE state through every call with buffer
+    donation (in-place loops): required when the carry is bigger than
+    half of HBM (e.g. the full KV pool) — without it each call holds
+    input + output copies. The caller receives the final state to chain
+    further measurements on the same buffers (`init` is consumed)."""
     import jax
     import jax.numpy as jnp
 
     n1, n2 = (8, 40) if slow else (64, 576)
+    dn = (0,) if donate else ()
 
     def make_loop(n):
         return jax.jit(lambda c: jax.lax.fori_loop(
-            0, n, lambda i, cc: step(cc, i), c))
+            0, n, lambda i, cc: step(cc, i), c), donate_argnums=dn)
     loop1, loop2 = make_loop(n1), make_loop(n2)
     pull = jax.jit(
         lambda c: jnp.ravel(jax.tree_util.tree_leaves(c)[0])[:1])
 
-    def run(loop):
+    def run(loop, state):
         # The remote-compile tunnel occasionally drops a response body;
         # retry the compile a few times before giving up.
         for attempt in range(4):
             try:
-                out = loop(init)
-                np.asarray(pull(out))        # compile
+                state = loop(state)
+                np.asarray(pull(state))      # compile
                 break
             except Exception as e:
-                if attempt == 3:
+                if attempt == 3 or donate:   # donated input is consumed
                     raise
                 print(f"[retry] compile attempt {attempt}: {e!r}",
                       file=sys.stderr, flush=True)
@@ -76,12 +84,16 @@ def device_bench(step, init, iters: int = 0, reps: int = 3,
         times = []
         for _ in range(reps):
             t0 = time.perf_counter()
-            out = loop(init)
-            np.asarray(pull(out))
+            state = loop(state)
+            np.asarray(pull(state))
             times.append(time.perf_counter() - t0)
-        return min(times)
-    t1, t2 = run(loop1), run(loop2)
-    return max(1e-9, (t2 - t1) / (n2 - n1)), t1
+        return min(times), state
+    t1, state = run(loop1, init)
+    t2, state = run(loop2, state)
+    per_iter = max(1e-9, (t2 - t1) / (n2 - n1))
+    if donate:
+        return per_iter, t1, state
+    return per_iter, t1
 
 
 def main() -> None:
@@ -432,48 +444,52 @@ def main() -> None:
         ssalt2 = jnp.asarray(plan.salt2)
         gmask = jnp.ones((B,), bool)
 
+        def advance(meta, pos):
+            # Clamp exactly like ModelRunner._burst_step: positions pin
+            # at the last allocated slot so the walk stays in-table.
+            pos2 = jnp.minimum(pos + 1,
+                               pages_per_seq_b * PAGE - 1)
+            p = pos2[:, 0]
+            page = jnp.take_along_axis(
+                meta.block_tables, (p // PAGE)[:, None], axis=1)[:, 0]
+            return meta.replace(
+                slot_mapping=page * PAGE + p % PAGE,
+                context_lens=p + 1), pos2
+
         def model_only(c, t):
-            ids, pos, meta, kv = c
-            hidden, kv = model(mparams, ids, pos, kv, meta)
+            ids, pos, meta, kv, prm = c
+            hidden, kv = model(prm, ids, pos, kv, meta)
             # Feedback: next ids depend on hidden (keeps the loop live);
             # metadata advances exactly as the real burst does.
             ids = jnp.maximum(
                 ids, (hidden[:, :1, 0] * jnp.bfloat16(0)).astype(
                     jnp.int32))
-            pos2 = pos + 1
-            p = pos2[:, 0]
-            page = jnp.take_along_axis(
-                meta.block_tables, (p // PAGE)[:, None], axis=1)[:, 0]
-            meta = meta.replace(
-                slot_mapping=page * PAGE + p % PAGE,
-                context_lens=meta.context_lens + 1)
-            return (ids, pos2, meta, kv)
+            meta, pos2 = advance(meta, pos)
+            return (ids, pos2, meta, kv, prm)
 
         def full_burst(c, t):
-            ids, pos, meta, kv = c
-            hidden, kv = model(mparams, ids, pos, kv, meta)
+            ids, pos, meta, kv, prm = c
+            hidden, kv = model(prm, ids, pos, kv, meta)
             flat = hidden.reshape(-1, hidden.shape[-1])
-            logits = model.compute_logits(mparams, flat)
+            logits = model.compute_logits(prm, flat)
             packed, _ = fused_sample(
                 logits, plan.tensors, sbases, ssalt1 + t, ssalt2,
                 max_best_of=plan.max_best_of, num_topk=plan.num_topk,
                 need_logprobs=False)
             next_tok = jnp.where(gmask, packed[:, 0], packed[:, 1])
             ids = next_tok[:, None].astype(jnp.int32)
-            pos2 = pos + 1
-            p = pos2[:, 0]
-            page = jnp.take_along_axis(
-                meta.block_tables, (p // PAGE)[:, None], axis=1)[:, 0]
-            meta = meta.replace(
-                slot_mapping=page * PAGE + p % PAGE,
-                context_lens=meta.context_lens + 1)
-            return (ids, pos2, meta, kv)
+            meta, pos2 = advance(meta, pos)
+            return (ids, pos2, meta, kv, prm)
 
+        # ONE state threaded through both ablations with donation: the
+        # KV pool is over half of HBM, so un-donated loops OOM, and jit
+        # must not close over the params (they'd serialize into the
+        # remote-compile request).
+        state = (ids0, pos0, meta0, kv_caches, mparams)
         for nm, fn in (("model-only(32L)", model_only),
                        ("FULL burst step", full_burst)):
-            init = (ids0, pos0, meta0, [
-                (k + 0, v + 0) for (k, v) in kv_caches])
-            s, rtt = device_bench(fn, init, slow=True)
+            s, rtt, state = device_bench(fn, state, slow=True,
+                                         donate=True)
             rtts.append(rtt)
             row(f"BURST {nm} b={B}", s * 1e3, 1, "")
 

@@ -286,6 +286,40 @@ def main() -> int:
     if rel > 3e-2:
         failures.append(("gguf_q8", rel))
 
+    # -- SqueezeLLM fused LUT matmul --
+    from aphrodite_tpu.modeling.layers.quantization.squeezellm import (
+        SqueezeLLMConfig)
+    from aphrodite_tpu.ops.pallas.quant_matmul import squeezellm_matmul
+    Ks, Ns, ms = 4096, 4096, 256
+    luts = jnp.asarray(rs.randn(Ns, 16) * 0.01, jnp.float32)
+    qws = jnp.asarray(rs.randint(-2**31, 2**31, (Ks // 8, Ns),
+                                 dtype=np.int32))
+    xs = jnp.asarray(rs.randn(ms, Ks), jnp.bfloat16)
+    smethod = SqueezeLLMConfig().get_linear_method()
+    refs2 = np.asarray(xs @ smethod.dequantize(
+        {"qweight": qws, "lookup_table": luts}, jnp.bfloat16),
+        np.float32)
+    gots2 = np.asarray(squeezellm_matmul(xs, qws, luts), np.float32)
+    rel = np.abs(refs2 - gots2).max() / (np.abs(refs2).max() + 1e-9)
+    print(f"squeezellm_matmul: rel err {rel:.2e}")
+    if rel > 3e-2:
+        failures.append(("squeezellm", rel))
+
+    # -- GGUF grouped-int8 (Q6_K-at-rest form) matmul --
+    from aphrodite_tpu.ops.pallas.quant_matmul import gguf_i8g_matmul
+    qsg = jnp.asarray(rs.randint(-128, 128, (Ks, Ns), dtype=np.int8))
+    dg16 = jnp.asarray(rs.rand(Ks // 16, Ns) * 0.01 + 1e-3, jnp.float32)
+    xg2 = jnp.asarray(rs.randn(ms, Ks), jnp.bfloat16)
+    refg2 = np.asarray(
+        (xg2.astype(jnp.float32) @
+         (qsg.astype(jnp.float32) * jnp.repeat(dg16, 16, axis=0))),
+        np.float32)
+    gotg2 = np.asarray(gguf_i8g_matmul(xg2, qsg, dg16), np.float32)
+    rel = np.abs(refg2 - gotg2).max() / (np.abs(refg2).max() + 1e-9)
+    print(f"gguf_i8g_matmul: rel err {rel:.2e}")
+    if rel > 3e-2:
+        failures.append(("gguf_i8g", rel))
+
     # -- int8 dense matmul --
     w8 = jnp.asarray(rs.randint(-128, 128, (K, N), dtype=np.int8))
     s8 = jnp.asarray(rs.rand(N) * 0.01 + 1e-3, jnp.float32)

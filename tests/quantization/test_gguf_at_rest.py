@@ -92,6 +92,120 @@ def test_q8_pallas_matmul_matches_dense(K, N, m):
     assert rel < 2e-5, rel
 
 
+def random_q6k_blocks(out_f, in_f):
+    """Valid random Q6_K superblocks: small finite f16 d, random int8
+    sub-scales and 6-bit codes."""
+    n = out_f * in_f // 256
+    blocks = np.zeros((n, 210), np.uint8)
+    blocks[:, :192] = rs.randint(0, 256, (n, 192), dtype=np.uint8)
+    blocks[:, 192:208] = rs.randint(-16, 16, (n, 16),
+                                    dtype=np.int8).view(np.uint8)
+    d = (rs.rand(n).astype(np.float16) * 0.01 + 1e-3)
+    blocks[:, 208:210] = d.view(np.uint8).reshape(n, 2)
+    return blocks
+
+
+def test_q6k_repack_matches_dequant_oracle():
+    from aphrodite_tpu.modeling.gguf import _deq_q6_k
+    from aphrodite_tpu.modeling.layers.quantization.gguf import (
+        q6k_to_kernel)
+    out_f, in_f = 8, 512
+    blocks = random_q6k_blocks(out_f, in_f)
+    dense = _deq_q6_k(blocks).reshape(out_f, in_f)
+    qs, d16 = q6k_to_kernel(blocks, out_f, in_f)
+    method = GGUFLinearMethod(GGUFConfig())
+    w = np.asarray(method.dequantize(
+        {"qs": jnp.asarray(qs), "d16": jnp.asarray(d16)}))
+    np.testing.assert_allclose(w, dense.T, rtol=1e-5, atol=1e-5)
+
+
+def test_dense_to_i8g_roundtrip():
+    from aphrodite_tpu.modeling.layers.quantization.gguf import (
+        dense_to_i8g)
+    w = rs.randn(96, 256).astype(np.float32) * 0.05      # [out, in]
+    qs, d16 = dense_to_i8g(w)
+    w_hat = qs.astype(np.float32) * np.repeat(d16, 16, axis=0)
+    # Symmetric int8 per 16-row group: <=0.5/127 of the group max.
+    err = np.abs(w_hat - w.T)
+    bound = np.repeat(d16, 16, axis=0) * 0.51
+    assert (err <= bound).all()
+
+
+@pytest.mark.parametrize("K,N,m", [(512, 256, 5), (256, 384, 33)])
+def test_i8g_pallas_matmul_matches_dense(K, N, m):
+    from aphrodite_tpu.ops.pallas.quant_matmul import gguf_i8g_matmul
+    qs = rs.randint(-128, 128, (K, N), dtype=np.int8)
+    d16 = (rs.rand(K // 16, N).astype(np.float32) * 0.01 + 1e-3)
+    x = rs.randn(m, K).astype(np.float32)
+    ref = x @ (qs.astype(np.float32) * np.repeat(d16, 16, axis=0))
+    got = np.asarray(gguf_i8g_matmul(jnp.asarray(x), jnp.asarray(qs),
+                                     jnp.asarray(d16), interpret=True))
+    rel = np.abs(ref - got).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 2e-5, rel
+
+
+def test_mixed_quantized_group_routes_i8g(tmp_path):
+    """A sibling group mixing BLOCK-QUANTIZED types (the Q4_K_M
+    pattern: attn_v wider than attn_q/attn_k) must stay at rest on the
+    shared grouped-int8 form instead of falling back to dense — and
+    the loaded bucket must reproduce the dequantized weights."""
+    from aphrodite_tpu.modeling.gguf import (write_gguf,
+                                             gguf_weights_iterator)
+    meta = {
+        "general.architecture": "llama",
+        "llama.embedding_length": 256, "llama.block_count": 1,
+        "llama.feed_forward_length": 256,
+        "llama.attention.head_count": 4,
+        "llama.attention.head_count_kv": 2,
+        "llama.context_length": 128, "llama.vocab_size": 64,
+    }
+    t = {
+        "token_embd.weight": (rs.randn(64, 256).astype(np.float32),
+                              "F32"),
+        "output.weight": (rs.randn(64, 256).astype(np.float32), "F32"),
+        "output_norm.weight": (np.ones(256, np.float32), "F32"),
+        "blk.0.attn_norm.weight": (np.ones(256, np.float32), "F32"),
+        "blk.0.ffn_norm.weight": (np.ones(256, np.float32), "F32"),
+        # q/k at Q4_0, v at Q8_0 -> mixed but all quantized -> i8g
+        "blk.0.attn_q.weight": (
+            rs.randn(256, 256).astype(np.float32) * 0.05, "Q4_0"),
+        "blk.0.attn_k.weight": (
+            rs.randn(128, 256).astype(np.float32) * 0.05, "Q4_0"),
+        "blk.0.attn_v.weight": (
+            rs.randn(128, 256).astype(np.float32) * 0.05, "Q8_0"),
+        "blk.0.attn_output.weight": (
+            rs.randn(256, 256).astype(np.float32) * 0.05, "Q8_0"),
+        "blk.0.ffn_gate.weight": (
+            rs.randn(256, 256).astype(np.float32) * 0.05, "Q8_0"),
+        "blk.0.ffn_up.weight": (
+            rs.randn(256, 256).astype(np.float32) * 0.05, "Q8_0"),
+        "blk.0.ffn_down.weight": (
+            rs.randn(256, 256).astype(np.float32) * 0.05, "Q8_0"),
+    }
+    path = str(tmp_path / "mixed_quant.gguf")
+    write_gguf(path, meta, t)
+    raw = dict(gguf_weights_iterator(path, at_rest=True))
+    dense = dict(gguf_weights_iterator(path, at_rest=False))
+    qkv = ["model.layers.0.self_attn.q_proj.weight",
+           "model.layers.0.self_attn.k_proj.weight",
+           "model.layers.0.self_attn.v_proj.weight"]
+    for nm in qkv:
+        assert type(raw[nm]).__name__ == "RawGGUF", nm
+        assert raw[nm].compat, nm
+    method = GGUFLinearMethod(GGUFConfig())
+    for nm in qkv:
+        bucket = {}
+        qs = method.load_weight(bucket, "weight", raw[nm])
+        params = {method.pending_rename: jnp.asarray(qs)}
+        params.update({k: jnp.asarray(v) for k, v in
+                       method.pending_sidecar.items()})
+        method.pending_rename = method.pending_sidecar = None
+        w_hat = np.asarray(method.dequantize(params, jnp.float32))
+        ref = np.asarray(dense[nm], np.float32).T       # [in, out]
+        # Q8_0 members are exact; Q4_0 members requantize at <=0.4%.
+        assert np.abs(w_hat - ref).max() <= 0.01 * np.abs(ref).max()
+
+
 def test_gguf_registered():
     from aphrodite_tpu.modeling.layers.quantization import (
         get_quantization_config_cls)

@@ -128,6 +128,26 @@ def test_squeezellm_lut_dequant():
     np.testing.assert_allclose(w_hat, expected, rtol=1e-6)
 
 
+def test_squeezellm_fused_kernel_matches_dequant():
+    """The Pallas LUT kernel (interpret mode) must match the XLA
+    dequantize-then-dot path."""
+    from aphrodite_tpu.ops.pallas.quant_matmul import (
+        squeezellm_matmul, squeezellm_supported)
+    K, N, m = 256, 128, 24
+    assert squeezellm_supported(K, N)
+    lut = rng.randn(N, 16).astype(np.float32) * 0.1
+    q = rng.randint(0, 16, size=(K, N))
+    qweight = jnp.asarray(pack_rows(q))
+    params = {"qweight": qweight, "lookup_table": jnp.asarray(lut)}
+    method = SqueezeLLMConfig().get_linear_method()
+    w = np.asarray(method.dequantize(params, jnp.float32))
+    x = rng.randn(m, K).astype(np.float32)
+    ref = x @ w
+    got = np.asarray(squeezellm_matmul(
+        jnp.asarray(x), qweight, jnp.asarray(lut), interpret=True))
+    np.testing.assert_allclose(got, ref, rtol=2e-2, atol=2e-2)
+
+
 def test_int8_load_and_apply():
     method = Int8Config().get_linear_method()
     w_hf = rng.randn(OUT, IN).astype(np.float32)   # HF layout [out, in]
