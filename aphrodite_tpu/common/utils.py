@@ -124,3 +124,27 @@ def is_tpu() -> bool:
         return get_device_platform() == "tpu"
     except Exception:  # pragma: no cover - jax not importable
         return False
+
+
+def v5e8_memory_math(tp: int = 8, batch: int = 256, ctx: int = 2048):
+    """Projected per-chip HBM for the bf16 Mistral-7B v5e-8 north star
+    (BASELINE.md: fp16 7B >= 5k out-tok/s at bs=256). One source of
+    truth for bench.py's --tp report and __graft_entry__'s dryrun
+    assertion. Returns (weights_gib_total, kv_gib_per_chip,
+    act_gib, total_gib_per_chip)."""
+    hidden, inter, layers, vocab = 4096, 14336, 32, 32000
+    heads, kv_heads, hd = 32, 8, 128
+    per_layer = (hidden * (heads + 2 * kv_heads) * hd     # qkv
+                 + heads * hd * hidden                    # o
+                 + 2 * hidden * inter                     # gate_up
+                 + inter * hidden                         # down
+                 + 2 * hidden)                            # norms
+    n_params = 2 * vocab * hidden + layers * per_layer + hidden
+    weights_gib = n_params * 2 / 2**30
+    # KV heads shard tp-ways (8 heads -> 1/chip at tp=8); token-major
+    # pages pad head_dim to the 128-lane tile.
+    kv_tok_chip = 2 * (kv_heads // min(tp, kv_heads)) * hd * 2 * layers
+    kv_gib_chip = batch * ctx * kv_tok_chip / 2**30
+    act_gib = 0.75            # 8192-token prefill round, gate_up peak
+    total = weights_gib / tp + kv_gib_chip + act_gib
+    return weights_gib, kv_gib_chip, act_gib, total

@@ -68,14 +68,21 @@ class LoRALinearMethod(LinearMethod):
         idx = params[LORA_IDX]                    # [batch] int32, -1=none
         a = params[LORA_A]                        # [slots, in, r]
         b = params[LORA_B]                        # [slots, r, out]
-        # Dense combine over slots: exact, static shapes.
-        # x: [batch, seq, in]
-        xa = jnp.einsum("bsh,lhr->lbsr", x, a)
-        delta = jnp.einsum("lbsr,lro->lbso", xa, b)
-        slots = jnp.arange(a.shape[0], dtype=idx.dtype)
-        mask = (idx[None, :] == slots[:, None])   # [slots, batch]
-        masked = delta * mask[:, :, None, None].astype(delta.dtype)
-        return y + jnp.sum(masked, axis=0)
+        # Gathered combine (the bgmv formulation,
+        # `kernels/punica/bgmv_impl.cuh`): each row fetches ITS
+        # adapter's A/B and runs one batched small matmul — cost is
+        # independent of max_loras, unlike the previous dense sweep
+        # over every slot (which paid max_loras x the adapter FLOPs
+        # per token; advisor/verdict r3). The gather materializes
+        # [batch, in, r] — bandwidth-bound and tiny next to the base
+        # matmul at serving ranks. x: [batch, seq, in].
+        safe = jnp.maximum(idx, 0)
+        a_tok = jnp.take(a, safe, axis=0)         # [batch, in, r]
+        b_tok = jnp.take(b, safe, axis=0)         # [batch, r, out]
+        xa = jnp.einsum("bsh,bhr->bsr", x, a_tok)
+        delta = jnp.einsum("bsr,bro->bso", xa, b_tok)
+        active = (idx >= 0)[:, None, None].astype(delta.dtype)
+        return y + delta * active
 
     def load_weight(self, params, name, hf_tensor):
         converted = self.base.load_weight(params, name, hf_tensor)

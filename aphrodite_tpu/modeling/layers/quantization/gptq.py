@@ -129,9 +129,20 @@ class GPTQLinearMethod(LinearMethod):
         in_features = params["g_idx"].shape[0]
         out_features = params["scales"].shape[1]
         if self._use_pallas(in_features, out_features):
-            from aphrodite_tpu.ops.pallas.quant_matmul import gptq_matmul
+            import os
+            from aphrodite_tpu.ops.pallas.quant_matmul import (
+                gptq_matmul, gptq_matmul_a8)
             lead = x.shape[:-1]
-            y = gptq_matmul(
+            # APHRODITE_W4A8=1: int8 activations into the MXU's 2x-rate
+            # int8 mode (weights stay int4 at rest; activation rounding
+            # is the only approximation). Off by default — numerics are
+            # no longer bit-identical to the W4A16 path. 4-bit only:
+            # 8-bit codes minus their zero point span [-256, 254] and
+            # would wrap on the kernel's int8 cast.
+            mm = gptq_matmul_a8 if (
+                os.environ.get("APHRODITE_W4A8") == "1" and
+                cfg.weight_bits == 4) else gptq_matmul
+            y = mm(
                 x.reshape(-1, in_features), params["qweight"],
                 params["qzeros"], params["scales"],
                 bits=cfg.weight_bits, group_size=cfg.group_size)

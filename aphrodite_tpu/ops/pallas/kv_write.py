@@ -179,10 +179,9 @@ def _decode_write_kernel(
 def _prefill_write_kernel(
     # scalar prefetch
     page_ids_ref,   # [cells] int32; >= num_pages skips the cell
-    src_blocks_ref,  # [cells] int32 (consumed by the index map)
     valids_ref,     # [cells] int32 tokens covered (1..page_size)
     # inputs
-    kblk_ref,       # [page_size, H*d] VMEM (this cell's k rows)
+    kblk_ref,       # [C * page_size, H*d] VMEM (C cells' k rows)
     vblk_ref,
     k_in,           # [P, S, H*d] ANY/HBM (aliased)
     v_in,
@@ -190,78 +189,84 @@ def _prefill_write_kernel(
     k_out,
     v_out,
     # scratch
-    kbuf,           # [2, page_size, H*d] VMEM staging
+    kbuf,           # [2, page_size, H*d] VMEM tail staging
     vbuf,
     rsem,
-    wsem,
+    wsem,           # [C, 2]
     *,
     page_size: int,
     num_pages: int,
+    pages_per_cell: int,
 ):
-    """Prefill page writer: one grid cell per (sequence, page), writing
-    a WHOLE page from the prompt's contiguous token rows — no
-    read-modify-write for full pages, one 32 KB-class DMA per side,
-    writebacks double-buffered across cells (pages are distinct by
-    construction: each cell owns one (seq, page))."""
-    del k_in, v_in, src_blocks_ref
+    """Prefill page writer: each grid cell writes `pages_per_cell`
+    WHOLE pages with DMAs issued STRAIGHT from the (auto-pipelined)
+    input block to their HBM pages — no staging copy, and the per-cell
+    fixed cost (grid step + block handoff, ~10 us measured round 4)
+    amortizes over C pages instead of one. Partial tail pages
+    read-modify-write through a small staging buffer. All writebacks
+    are waited before the cell ends: the input buffer is recycled two
+    cells later by the pipeline, so in-flight reads from it must not
+    outlive the cell."""
+    del k_in, v_in
     i = pl.program_id(0)
-    n = pl.num_programs(0)
-    pg = page_ids_ref[i]
-    valid = valids_ref[i]
-    s = jax.lax.rem(i, 2)
+    C = pages_per_cell
 
-    def wb_copies(j, slot):
-        pj = page_ids_ref[j]
-        return (pltpu.make_async_copy(kbuf.at[slot], k_out.at[pj],
-                                      wsem.at[slot, 0]),
-                pltpu.make_async_copy(vbuf.at[slot], v_out.at[pj],
-                                      wsem.at[slot, 1]))
+    for c in range(C):                        # static unroll
+        cell = i * C + c
+        pg = page_ids_ref[cell]
+        valid = valids_ref[cell]
+        rows = pl.ds(c * page_size, page_size)
 
-    # Free this slot: cell i-2 wrote from it.
-    @pl.when((i >= 2) & (page_ids_ref[i - 2] < num_pages))
-    def _():
-        for c in wb_copies(i - 2, s):
-            c.wait()
-
-    @pl.when(pg < num_pages)
-    def _():
-        @pl.when(valid >= page_size)
+        @pl.when((pg < num_pages) & (valid >= page_size))
         def _full():
-            kbuf[s] = kblk_ref[...]
-            vbuf[s] = vblk_ref[...]
+            pltpu.make_async_copy(kblk_ref.at[rows, :], k_out.at[pg],
+                                  wsem.at[c, 0]).start()
+            pltpu.make_async_copy(vblk_ref.at[rows, :], v_out.at[pg],
+                                  wsem.at[c, 1]).start()
 
-        @pl.when(valid < page_size)
+        @pl.when((pg < num_pages) & (valid < page_size))
         def _partial():
-            # Tail page: merge the valid rows over the existing page.
+            # Tail page: merge valid rows over the existing page.
+            # Fully synchronous (tails are <=1 per sequence); the
+            # staging slot alternates so two tails in one cell never
+            # race.
+            s = c % 2
             ck = pltpu.make_async_copy(k_out.at[pg], kbuf.at[s],
-                                       rsem.at[0])
+                                       rsem.at[s, 0])
             cv = pltpu.make_async_copy(v_out.at[pg], vbuf.at[s],
-                                       rsem.at[1])
+                                       rsem.at[s, 1])
             ck.start()
             cv.start()
             ck.wait()
             cv.wait()
-            rows = jax.lax.broadcasted_iota(
+            riota = jax.lax.broadcasted_iota(
                 jnp.int32, (page_size, 1), 0)
-            kbuf[s] = jnp.where(rows < valid, kblk_ref[...], kbuf[s])
-            vbuf[s] = jnp.where(rows < valid, vblk_ref[...], vbuf[s])
+            kbuf[s] = jnp.where(riota < valid, kblk_ref[rows, :],
+                                kbuf[s])
+            vbuf[s] = jnp.where(riota < valid, vblk_ref[rows, :],
+                                vbuf[s])
+            wk = pltpu.make_async_copy(kbuf.at[s], k_out.at[pg],
+                                       wsem.at[c, 0])
+            wv = pltpu.make_async_copy(vbuf.at[s], v_out.at[pg],
+                                       wsem.at[c, 1])
+            wk.start()
+            wv.start()
+            wk.wait()
+            wv.wait()
 
-        for c in wb_copies(i, s):
-            c.start()
+    # Drain the full-page writebacks issued above (tail pages waited
+    # inline). Re-constructed copies wait the matching semaphores.
+    for c in range(C):
+        cell = i * C + c
+        pg = page_ids_ref[cell]
+        rows = pl.ds(c * page_size, page_size)
 
-    # Drain the last two cells' writebacks (n is static).
-    @pl.when(i == n - 1)
-    def _():
-        if n >= 2:
-            @pl.when(page_ids_ref[n - 2] < num_pages)
-            def _():
-                for c in wb_copies(n - 2, (n - 2) % 2):
-                    c.wait()
-
-        @pl.when(pg < num_pages)
+        @pl.when((pg < num_pages) & (valids_ref[cell] >= page_size))
         def _():
-            for c in wb_copies(i, s):
-                c.wait()
+            pltpu.make_async_copy(kblk_ref.at[rows, :], k_out.at[pg],
+                                  wsem.at[c, 0]).wait()
+            pltpu.make_async_copy(vblk_ref.at[rows, :], v_out.at[pg],
+                                  wsem.at[c, 1]).wait()
 
 
 def write_kv_pages_prefill(
@@ -270,25 +275,43 @@ def write_kv_pages_prefill(
     k_pages: jax.Array,   # [num_pages, page_size, H*d]
     v_pages: jax.Array,
     page_ids: jax.Array,  # [cells] int32; >= num_pages skips
-    src_blocks: jax.Array,  # [cells] int32 block index into knew rows
+    src_blocks: jax.Array,  # [cells] int32; MUST equal arange(cells)
     valids: jax.Array,    # [cells] int32 valid rows (1..page_size)
     *,
     interpret: bool = False,
 ):
-    """Whole-page prefill writer (see _prefill_write_kernel)."""
+    """Whole-page prefill writer (see _prefill_write_kernel).
+
+    Contract: cell c's source rows are knew[c*page_size:(c+1)*page_size]
+    — i.e. `src_blocks` is the identity. _prepare_prompt's page-aligned
+    cell layout guarantees this (cell i*ppp+p reads block i*ppp+p); the
+    parameter is retained so callers state the mapping explicitly and a
+    future non-identity layout fails loudly below."""
     tokens, hd = knew.shape
     num_pages, page_size, _ = k_pages.shape
     cells = page_ids.shape[0]
     dtype = k_pages.dtype
+    if not isinstance(src_blocks, jax.core.Tracer):
+        import numpy as _np
+        live = _np.asarray(page_ids) < num_pages
+        if not (_np.asarray(src_blocks)[live] ==
+                _np.arange(cells)[live]).all():
+            raise ValueError(
+                "write_kv_pages_prefill requires identity src_blocks "
+                "(cell c reads knew rows [c*page_size, (c+1)*page_size))")
+    # Pages per grid cell: the largest power of two <= 16 dividing the
+    # cell count (cells = padded_batch * pages_per_prompt, so real
+    # workloads have deep power-of-two factors).
+    C = max(c for c in (16, 8, 4, 2, 1) if cells % c == 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
-        grid=(cells,),
+        num_scalar_prefetch=2,
+        grid=(cells // C,),
         in_specs=[
-            pl.BlockSpec((page_size, hd),
-                         lambda i, pids, sblk, vld: (sblk[i], 0)),
-            pl.BlockSpec((page_size, hd),
-                         lambda i, pids, sblk, vld: (sblk[i], 0)),
+            pl.BlockSpec((C * page_size, hd),
+                         lambda i, pids, vld: (i, 0)),
+            pl.BlockSpec((C * page_size, hd),
+                         lambda i, pids, vld: (i, 0)),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
@@ -299,16 +322,15 @@ def write_kv_pages_prefill(
         scratch_shapes=[
             pltpu.VMEM((2, page_size, hd), dtype),
             pltpu.VMEM((2, page_size, hd), dtype),
-            pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.SemaphoreType.DMA((C, 2)),
         ],
     )
-    # The src_blocks index map addresses knew in page_size-row blocks;
-    # OOB-skipped cells still need a legal block index (0).
     kernel = functools.partial(
         _prefill_write_kernel,
         page_size=page_size,
         num_pages=num_pages,
+        pages_per_cell=C,
     )
     return pl.pallas_call(
         kernel,
@@ -317,11 +339,11 @@ def write_kv_pages_prefill(
             jax.ShapeDtypeStruct(k_pages.shape, dtype),
             jax.ShapeDtypeStruct(v_pages.shape, dtype),
         ],
-        # inputs: 0=page_ids, 1=src_blocks(unused in body), 2=valids,
-        # 3=knew, 4=vnew, 5=k_pages, 6=v_pages
-        input_output_aliases={5: 0, 6: 1},
+        # inputs: 0=page_ids, 1=valids, 2=knew, 3=vnew,
+        # 4=k_pages, 5=v_pages
+        input_output_aliases={4: 0, 5: 1},
         interpret=interpret,
-    )(page_ids, src_blocks, valids, knew.astype(dtype),
+    )(page_ids, valids, knew.astype(dtype),
       vnew.astype(dtype), k_pages, v_pages)
 
 

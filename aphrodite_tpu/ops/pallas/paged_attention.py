@@ -61,6 +61,10 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -2.0**30  # large-but-finite: avoids inf-inf NaNs in corrections
 
+# Fused-write writeback ring depth: cell i reuses slot i % _WB_SLOTS and
+# waits cell i-_WB_SLOTS's DMA, so deeper rings hide more write latency.
+_WB_SLOTS = 8
+
 
 def head_block(num_kv_heads: int) -> int:
     """Largest divisor of H that is <= 8: the per-grid-cell head count.
@@ -84,7 +88,8 @@ def _decode_kernel_tm(
     block_tables_ref,   # [batch, pages_per_seq] int32 (SMEM)
     context_lens_ref,   # [batch] int32 (SMEM)
     # inputs (slopes_ref [n_hb, rows, 128] only with has_alibi;
-    # knew_ref/vnew_ref [1, 1, hb*d] only with fused_write)
+    # knew_ref/vnew_ref [1, 1, 1, hb*d] only with fused_write —
+    # knew_ref[0, 0] is the (1, hb*d) row)
     *refs,
     hb: int,
     group: int,
@@ -178,17 +183,20 @@ def _decode_kernel_tm(
         p_star = r_star // page_size
         g_star = block_tables_ref[b, pos_new // page_size]
 
-        # Free this cell's writeback buffer slot: cell i-2 used it.
+        # Free this cell's writeback buffer slot: cell i-_WB_SLOTS used
+        # it (a deeper ring than double-buffering — with 2 slots every
+        # cell stalled on a DMA issued only one cell earlier, ~200 us
+        # per layer at batch 512, PROFILE r04).
         cell = b * n_hb + j
-        s_wb = jax.lax.rem(cell, 2)
+        s_wb = jax.lax.rem(cell, _WB_SLOTS)
 
-        @pl.when(cell >= 2)
+        @pl.when(cell >= _WB_SLOTS)
         def _():
-            pb = (cell - 2) // n_hb
+            pb = (cell - _WB_SLOTS) // n_hb
 
             @pl.when(context_lens_ref[pb] > 0)
             def _():
-                pj = jax.lax.rem(cell - 2, n_hb)
+                pj = jax.lax.rem(cell - _WB_SLOTS, n_hb)
                 pgs = block_tables_ref[
                     pb, jnp.maximum(context_lens_ref[pb] - 1, 0)
                     // page_size]
@@ -305,38 +313,33 @@ def _decode_kernel_tm(
         jax.lax.fori_loop(0, num_chunks, body, None)
 
     if fused_write:
-        # Drain: the LAST two cells' writebacks have no successor to
-        # wait them.
+        # Drain: the LAST _WB_SLOTS cells' writebacks have no successor
+        # to wait them — the final cell waits each still-in-flight slot.
         cell = b * n_hb + j
         total = pl.num_programs(0) * n_hb
 
-        @pl.when((cell == total - 1) & (ctx > 0))
+        @pl.when(cell == total - 1)
         def _():
-            s_wb2 = jax.lax.rem(cell, 2)
-            pltpu.make_async_copy(
-                kwb.at[s_wb2], k_hbm.at[g_star, :, lanes_of(j)],
-                wbsem.at[s_wb2, 0]).wait()
-            pltpu.make_async_copy(
-                vwb.at[s_wb2], v_hbm.at[g_star, :, lanes_of(j)],
-                wbsem.at[s_wb2, 1]).wait()
+            for back in range(min(_WB_SLOTS, total)):
+                prev = total - 1 - back            # static
+                pb = prev // n_hb
+                pj = prev % n_hb
+                s_prev = prev % _WB_SLOTS
 
-        @pl.when((cell == total - 1) & (total >= 2))
-        def _():
-            pb = (cell - 1) // n_hb
-
-            @pl.when(context_lens_ref[pb] > 0)
-            def _():
-                pj = jax.lax.rem(cell - 1, n_hb)
-                s_prev = jax.lax.rem(cell - 1, 2)
-                pgs = block_tables_ref[
-                    pb, jnp.maximum(context_lens_ref[pb] - 1, 0)
-                    // page_size]
-                pltpu.make_async_copy(
-                    kwb.at[s_prev], k_hbm.at[pgs, :, lanes_of(pj)],
-                    wbsem.at[s_prev, 0]).wait()
-                pltpu.make_async_copy(
-                    vwb.at[s_prev], v_hbm.at[pgs, :, lanes_of(pj)],
-                    wbsem.at[s_prev, 1]).wait()
+                @pl.when(context_lens_ref[pb] > 0)
+                def _(pb=pb, pj=pj, s_prev=s_prev):
+                    pgs = block_tables_ref[
+                        pb,
+                        jnp.maximum(context_lens_ref[pb] - 1, 0)
+                        // page_size]
+                    pltpu.make_async_copy(
+                        kwb.at[s_prev],
+                        k_hbm.at[pgs, :, lanes_of(pj)],
+                        wbsem.at[s_prev, 0]).wait()
+                    pltpu.make_async_copy(
+                        vwb.at[s_prev],
+                        v_hbm.at[pgs, :, lanes_of(pj)],
+                        wbsem.at[s_prev, 1]).wait()
 
     l_final = l_scr[:, :1]
     l_safe = jnp.where(l_final == 0.0, 1.0, l_final)
@@ -419,10 +422,13 @@ def paged_decode_attention(
             alibi_slopes.astype(jnp.float32).reshape(n_hb, rows, 1),
             (n_hb, rows, 128)))
     if fused_write:
-        kn = knew.reshape(batch, n_hb, hb * head_dim)
-        vn = vnew.reshape(batch, n_hb, hb * head_dim)
-        spec_new = pl.BlockSpec((1, 1, hb * head_dim),
-                                lambda b, j, *_: (b, j, 0))
+        # The singleton axis keeps the block's last two dims equal to
+        # the array's ((1, hb*d)) — a (1, 1, hb*d) block over
+        # [batch, n_hb>1, hb*d] is not a legal Mosaic tiling.
+        kn = knew.reshape(batch, n_hb, 1, hb * head_dim)
+        vn = vnew.reshape(batch, n_hb, 1, hb * head_dim)
+        spec_new = pl.BlockSpec((1, 1, 1, hb * head_dim),
+                                lambda b, j, *_: (b, j, 0, 0))
         in_specs.extend([spec_new, spec_new])
         inputs.extend([kn, vn])
 
@@ -441,9 +447,11 @@ def paged_decode_attention(
     io_aliases = {}
     if fused_write:
         scratch.extend([
-            pltpu.VMEM((2, page_size, hb * head_dim), k_pages.dtype),
-            pltpu.VMEM((2, page_size, hb * head_dim), v_pages.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.VMEM((_WB_SLOTS, page_size, hb * head_dim),
+                       k_pages.dtype),
+            pltpu.VMEM((_WB_SLOTS, page_size, hb * head_dim),
+                       v_pages.dtype),
+            pltpu.SemaphoreType.DMA((_WB_SLOTS, 2)),
         ])
         out_shape.extend([
             jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),

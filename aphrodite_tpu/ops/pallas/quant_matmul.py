@@ -79,14 +79,19 @@ def _tile_mn(m: int, N: int, dtype, min_bn: int = 128):
     """Shared M/N tile sizing for the dequant-matmul kernels:
     (block_m, block_n, padded_m), honoring the APHRODITE_QMM_BLOCK_M/N
     env knobs (A/B-tuned in round 2). min_bn is the kernel's smallest
-    legal lane tile (AWQ's plane unpack needs 1024)."""
+    legal lane tile (AWQ's plane unpack needs 1024).
+
+    Tiny m (decode at low batch) is grid-overhead bound — the kernel
+    dequantizes the whole weight tile per grid cell regardless of m,
+    and the ~5 us/cell fixed cost dominates (LATENCY_r03's 12.7 tok/s
+    at bs=1 was mostly this) — so small m takes the WIDEST lane tiles."""
     import os
     sublane = 16 if dtype == jnp.bfloat16 else 8
     bm_cap = int(os.environ.get("APHRODITE_QMM_BLOCK_M", "512"))
     bm_cap = max(sublane, bm_cap // sublane * sublane)
     block_m = min(bm_cap, -(-m // sublane) * sublane)
     bn_cap = int(os.environ.get("APHRODITE_QMM_BLOCK_N", "0")) or (
-        1024 if block_m >= 512 else 4096)
+        1024 if block_m >= 512 else 2048)
     block_n = max((bn for bn in (2048, 1024, 512, 256, 128)
                    if N % bn == 0), default=0)
     if block_n < min_bn:
@@ -95,6 +100,16 @@ def _tile_mn(m: int, N: int, dtype, min_bn: int = 128):
         block_n //= 2           # keep N % block_n == 0 under any cap
     padded_m = -(-m // block_m) * block_m
     return block_m, block_n, padded_m
+
+
+def _tile_k(m: int, K: int, gs: int) -> int:
+    """K tile: block_k spans several quant groups; small m takes deeper
+    tiles (fewer grid cells — see _tile_mn) up to VMEM comfort."""
+    cap = 512 if m > 64 else 1024
+    block_k = gs
+    while block_k < cap and K % (block_k * 2) == 0:
+        block_k *= 2
+    return block_k
 
 def _kernel(x_ref, qw_ref, z_ref, s_ref, o_ref, acc_ref, *,
             bits: int, k_tiles: int, group_size: int):
@@ -161,9 +176,7 @@ def gptq_matmul(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
     # small, so spend VMEM on big tiles — block_k spans several quant
     # groups (the kernel dequants each group chunk separately) and
     # block_n goes up to 2048 lanes.
-    block_k = gs
-    while block_k < 512 and K % (block_k * 2) == 0:
-        block_k *= 2
+    block_k = _tile_k(m, K, gs)
     block_m, block_n, padded_m = _tile_mn(m, N, x.dtype)
     # Plane-order unpack (see _unpack_planes): permute x's columns to
     # match — per GROUP, since the kernel unpacks each group chunk
@@ -277,9 +290,7 @@ def awq_matmul(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
     gs = group_size
     G = K // gs
 
-    block_k = gs
-    while block_k < 512 and K % (block_k * 2) == 0:
-        block_k *= 2
+    block_k = _tile_k(m, K, gs)
     # NOTE: pre-refactor AWQ defaulted block_n to 2048 at every m; the
     # shared sizing caps it at 1024 for block_m >= 512. The 0.93x
     # vs-baseline bench row (BENCH notes) was measured WITH the shared
@@ -495,6 +506,109 @@ def gguf_q8_matmul(x: jax.Array, qs: jax.Array, d: jax.Array, *,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(x, qs, d.reshape(G, 1, N))
+    return out[:m] if padded_m != m else out
+
+
+def _gptq_a8_kernel(x_ref, xs_ref, qw_ref, z_ref, s_ref, o_ref,
+                    acc_ref, *, bits: int, k_tiles: int,
+                    group_size: int):
+    """W4A8 tile: int8 activations into the MXU's int8 mode. Per
+    quantization group: unpack the int4 codes plane-wise, subtract the
+    zero point IN INTEGERS (codes land exactly on the int8 grid — no
+    requantization), one int8 x int8 -> int32 dot per group, then scale
+    the int32 partials by the group's fp scale row into the f32
+    accumulator. The MXU's int8 mode has 2x the bf16 throughput, which
+    is the lever the W4A16 kernel can't reach (its matmuls already run
+    within ~6% of the bf16 dense roofline)."""
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    pack = 32 // bits
+    gs = group_size
+    rows_per_group = gs // pack
+    n_groups = z_ref.shape[0]
+    for g in range(n_groups):
+        q = _unpack_planes(
+            qw_ref[g * rows_per_group:(g + 1) * rows_per_group], bits)
+        w8 = (q - z_ref[g]).astype(jnp.int8)          # exact: |w|<=2^bits
+        x8 = x_ref[:, g * gs:(g + 1) * gs]
+        d = jax.lax.dot_general(x8, w8, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.int32)
+        acc_ref[...] += d.astype(jnp.float32) * \
+            s_ref[g].astype(jnp.float32)
+
+    @pl.when(k == k_tiles - 1)
+    def _flush():
+        o_ref[...] = (acc_ref[...] *
+                      xs_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("bits", "group_size", "interpret"))
+def gptq_matmul_a8(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
+                   scales: jax.Array, *, bits: int, group_size: int,
+                   interpret: bool = False) -> jax.Array:
+    """W4A8 variant of gptq_matmul: activations quantize to int8 with a
+    per-row scale (absmax) in the XLA prologue, weights stay int4 at
+    rest, and the kernel runs integer dots per quantization group. The
+    only approximation vs the W4A16 kernel is the activation rounding
+    (~0.4% per element, averaging out over the K contraction) —
+    opt-in via APHRODITE_W4A8 (see GPTQLinearMethod.apply)."""
+    m, K = x.shape
+    N = qweight.shape[1]
+    gs = group_size if group_size != -1 else K
+    pack = 32 // bits
+
+    # Per-row symmetric int8 activation quantization.
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=1,
+                     keepdims=True)
+    xs = jnp.maximum(absmax, 1e-8) / 127.0            # [m, 1]
+    x8 = jnp.clip(jnp.round(x.astype(jnp.float32) / xs), -127,
+                  127).astype(jnp.int8)
+
+    block_k = _tile_k(m, K, gs)
+    block_m, block_n, padded_m = _tile_mn(m, N, jnp.bfloat16)
+    R = gs // pack
+    x8 = x8.reshape(m, K // gs, R, pack).swapaxes(2, 3).reshape(m, K)
+    if padded_m != m:
+        x8 = jnp.pad(x8, ((0, padded_m - m), (0, 0)))
+        xs = jnp.pad(xs, ((0, padded_m - m), (0, 0)))
+
+    k_tiles = K // block_k
+    groups_per_tile = block_k // gs
+    grid = (padded_m // block_m, N // block_n, k_tiles)
+
+    shifts = (jnp.arange(pack, dtype=jnp.int32) * bits)[None, None, :]
+    z_all = jax.lax.bitwise_and(
+        jax.lax.shift_right_logical(qzeros[:, :, None], shifts),
+        (1 << bits) - 1).reshape(qzeros.shape[0], 1, N) + 1
+    scales3 = scales[:, None, :]
+
+    out = pl.pallas_call(
+        functools.partial(_gptq_a8_kernel, bits=bits, k_tiles=k_tiles,
+                          group_size=gs),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_m, block_k), lambda i, n, k: (i, k)),
+            pl.BlockSpec((block_m, 1), lambda i, n, k: (i, 0)),
+            pl.BlockSpec((block_k // pack, block_n),
+                         lambda i, n, k: (k, n)),
+            pl.BlockSpec((groups_per_tile, 1, block_n),
+                         lambda i, n, k: (k, 0, n)),
+            pl.BlockSpec((groups_per_tile, 1, block_n),
+                         lambda i, n, k: (k, 0, n)),
+        ],
+        out_specs=pl.BlockSpec((block_m, block_n),
+                               lambda i, n, k: (i, n)),
+        out_shape=jax.ShapeDtypeStruct((padded_m, N), x.dtype),
+        scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(x8, xs, qweight, z_all, scales3)
     return out[:m] if padded_m != m else out
 
 

@@ -47,7 +47,34 @@ def _log(msg: str) -> None:
 def main() -> None:
     import jax
     on_accel = jax.default_backend() not in ("cpu",)
+    tp = int(os.environ.get("BENCH_TP", "1"))
+    if tp > len(jax.devices()):
+        # The v5e-8 mode on a single-chip/laptop host: re-exec on a
+        # virtual tp-device CPU mesh (same trick as dryrun_multichip).
+        import subprocess
+        env = dict(os.environ)
+        for var in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE",
+                    "AXON_LOOPBACK_RELAY", "AXON_POOL_SVC_OVERRIDE"):
+            env.pop(var, None)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = " ".join(
+            f for f in env.get("XLA_FLAGS", "").split()
+            if not f.startswith("--xla_force_host_platform_device_count"))
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={tp}"
+        ).strip()
+        # Functional validation shapes: the full 7B geometry on a CPU
+        # mesh measures sharding correctness, not speed.
+        env.setdefault("BENCH_BATCH", "8")
+        env.setdefault("BENCH_STEPS", "4")
+        env.setdefault("BENCH_PROMPT", "16")
+        env.setdefault("BENCH_MULTI_STEP", "4")
+        raise SystemExit(subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env).returncode)
     size = os.environ.get("BENCH_MODEL", "7b" if on_accel else "tiny")
+    if tp > 1:
+        size = os.environ.get("BENCH_MODEL", "7b")
 
     if size == "7b":
         # Mistral-7B geometry (reference baseline row). Default quant is
@@ -59,7 +86,19 @@ def main() -> None:
         hidden, layers, heads, kv_heads, inter = 4096, 32, 32, 8, 14336
         vocab = 32000
         if "BENCH_QUANT" not in os.environ:
-            os.environ["BENCH_QUANT"] = "gptq"
+            # tp=8 is the bf16 north-star config (weights shard
+            # 8-ways, so no quantization needed to fit KV).
+            os.environ["BENCH_QUANT"] = "" if tp > 1 else "gptq"
+        if os.environ.get("BENCH_QUANT") == "gptq" and \
+                "APHRODITE_W4A8" not in os.environ:
+            # The GPTQ bench row runs the int8-activation MXU path
+            # (weights stay int4 at rest; activations round to int8
+            # per row — the reference's exllama kernel likewise
+            # accumulates at reduced precision). BENCH_W4A16=1 /
+            # APHRODITE_W4A8=0 selects the bit-exact bf16-activation
+            # path (~4.2k vs ~5.5k out-tok/s, round 4).
+            if os.environ.get("BENCH_W4A16") != "1":
+                os.environ["APHRODITE_W4A8"] = "1"
         default_batch = "512" if os.environ["BENCH_QUANT"] else "112"
         batch = int(os.environ.get("BENCH_BATCH", default_batch))
         steps = int(os.environ.get("BENCH_STEPS", "96"))
@@ -105,12 +144,21 @@ def main() -> None:
     # (8-bit sublane tile); bf16 keeps the default 16.
     block_size = int(os.environ.get(
         "BENCH_BLOCK", "32" if kv_dtype in ("int8", "fp8") else "16"))
+    if tp > 1 and size == "7b":
+        # Projected per-chip HBM at the v5e-8 serving point (the same
+        # math dryrun_multichip asserts — one helper, one truth).
+        from aphrodite_tpu.common.utils import v5e8_memory_math
+        w_gib, kv_chip, act, total = v5e8_memory_math(tp)
+        _log(f"tp={tp} projected HBM/chip: weights {w_gib / tp:.2f} + "
+             f"KV(bs=256, ctx=2048) {kv_chip:.2f} + act ~{act:.2f} = "
+             f"{total:.2f} GiB of 16")
+
     engine = AphroditeEngine.from_engine_args(EngineArgs(
         model=tmp, tokenizer=tmp, load_format="dummy", dtype="bfloat16",
         max_model_len=2048, max_num_seqs=batch, disable_log_stats=True,
         skip_tokenizer_init=True, multi_step=multi_step,
         quantization=quant, kv_cache_dtype=kv_dtype,
-        block_size=block_size,
+        block_size=block_size, tensor_parallel_size=tp,
         # Big prefill rounds: each scheduling round pays a fixed
         # dispatch+sync cost (~130 ms tunnel RTT) plus host batch
         # building, so batch as many prompt tokens as possible per round
@@ -159,9 +207,16 @@ def main() -> None:
     _run(engine, sp, rng_tokens, steps)
     _log(f"warmup done in {time.perf_counter() - t0:.1f}s")
 
+    # Python GC pauses showed up as ~0.5 s hiccups inside timed runs
+    # (millions of small host objects from output processing); collect
+    # up front and pause collection for the measurement.
+    import gc
+    gc.collect()
+    gc.disable()
     t0 = time.perf_counter()
     total_out = _run(engine, sp, rng_tokens, steps)
     dt = time.perf_counter() - t0
+    gc.enable()
     _log(f"timed run: {total_out} tokens in {dt:.1f}s")
 
     toks = total_out / dt
@@ -169,6 +224,8 @@ def main() -> None:
     tag = f"_{quant}" if quant else ""
     if mode != "burst":
         tag += f"_{mode}"
+    if tp > 1:
+        tag += f"_tp{tp}"
     # quant/batch/kv ride in the JSON so round-over-round comparisons
     # can't conflate differently-configured runs (round-2 advisor).
     print(json.dumps({
@@ -177,7 +234,7 @@ def main() -> None:
         "unit": "out_tok/s",
         "vs_baseline": round(toks / baseline, 4),
         "quant": quant, "batch": batch, "steps": steps,
-        "kv_dtype": kv_dtype, "baseline": baseline,
+        "kv_dtype": kv_dtype, "baseline": baseline, "tp": tp,
     }))
 
 

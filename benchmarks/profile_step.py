@@ -161,6 +161,29 @@ def main() -> None:
         row(f"gptq_matmul {name} [{B},{K}]x[{K},{N}]", s * 1e3, LAYERS,
             f"{flops / s / 1e12:.1f} TF/s")
 
+    # --- W4A8 quantized matmuls (int8 MXU path), same shapes ---
+    if want("a8"):
+        from aphrodite_tpu.ops.pallas.quant_matmul import gptq_matmul_a8
+    for name, K, N in (shapes if want("a8") else []):
+        x = jax.random.normal(key, (B, K), dtype=jnp.bfloat16)
+        qw = jax.random.randint(key, (K // 8, N), 0, 2**31 - 1,
+                                dtype=jnp.int32)
+        qz = jax.random.randint(key, (K // GROUP, N // 8), 0, 2**31 - 1,
+                                dtype=jnp.int32)
+        sc = jnp.ones((K // GROUP, N), dtype=jnp.bfloat16) * 0.01
+
+        def a8step(c, i, qw=qw, qz=qz, sc=sc):
+            xx, _ = c
+            o = gptq_matmul_a8(xx, qw, qz, sc, bits=4,
+                               group_size=GROUP)
+            return (xx + o[:, :1] * jnp.bfloat16(1e-30), o[0, 0])
+
+        s, rtt = device_bench(a8step, (x, jnp.bfloat16(0.0)))
+        rtts.append(rtt)
+        flops = 2 * B * K * N
+        row(f"W4A8 gptq_matmul {name} [{B},{K}]x[{K},{N}]", s * 1e3,
+            LAYERS, f"{flops / s / 1e12:.1f} TF/s")
+
     # --- bf16 dense matmuls, same shapes (MXU roofline comparison) ---
     for name, K, N in (shapes if want("dense") else []):
         x = jax.random.normal(key, (B, K), dtype=jnp.bfloat16)

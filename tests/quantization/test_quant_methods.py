@@ -128,6 +128,44 @@ def test_squeezellm_lut_dequant():
     np.testing.assert_allclose(w_hat, expected, rtol=1e-6)
 
 
+def test_gptq_w4a8_kernel_close_to_w4a16():
+    """The int8-activation kernel (interpret mode) must match the fp
+    dequant path within activation-rounding error."""
+    from aphrodite_tpu.ops.pallas.quant_matmul import gptq_matmul_a8
+    K, N, G, m = 256, 128, 2, 24
+    gs = K // G
+    w = rng.randn(K, N).astype(np.float32) * 0.05
+    q = np.zeros((K, N), np.int32)
+    scales = np.zeros((G, N), np.float32)
+    zeros = np.zeros((G, N), np.int32)
+    qmax = 15
+    for g in range(G):
+        blk = w[g * gs:(g + 1) * gs]
+        wmin, wmax = blk.min(0), blk.max(0)
+        s = np.maximum((wmax - wmin) / qmax, 1e-8)
+        z = np.clip(np.round(-wmin / s), 0, qmax).astype(np.int32)
+        q[g * gs:(g + 1) * gs] = np.clip(
+            np.round(blk / s) + z, 0, qmax)
+        scales[g], zeros[g] = s, z
+    qweight = pack_rows(q)
+    qz = np.zeros((G, N // 8), np.int32)
+    for i in range(8):
+        qz |= ((zeros[:, i::8] - 1) & 0xF) << (4 * i)
+    params = {"qweight": jnp.asarray(qweight),
+              "qzeros": jnp.asarray(qz),
+              "scales": jnp.asarray(scales),
+              "g_idx": jnp.asarray(np.arange(K) // gs, np.int32)}
+    method = GPTQConfig(4, gs).get_linear_method()
+    w_hat = np.asarray(method.dequantize(params, jnp.float32))
+    x = rng.randn(m, K).astype(np.float32)
+    ref = x @ w_hat
+    got = np.asarray(gptq_matmul_a8(
+        jnp.asarray(x), jnp.asarray(qweight), jnp.asarray(qz),
+        jnp.asarray(scales), bits=4, group_size=gs, interpret=True))
+    rel = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 2e-2, rel
+
+
 def test_squeezellm_fused_kernel_matches_dequant():
     """The Pallas LUT kernel (interpret mode) must match the XLA
     dequantize-then-dot path."""
