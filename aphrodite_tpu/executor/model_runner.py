@@ -120,6 +120,17 @@ class ModelRunner:
             static_argnames=("is_prompt", "use_prefix"),
             donate_argnums=(3,),      # kv_caches
         )
+        # Single-dispatch step+sample: one device program and ONE host
+        # sync per scheduling round (each dispatch costs a tunnel round
+        # trip here; the two-program split cost low-rate serving an
+        # extra ~0.1 s per prefill round). Routes needing raw logits
+        # (host logits processors, logprobs) use _step_fn instead.
+        self._step_sample_fn = jax.jit(
+            self._step_sample,
+            static_argnames=("is_prompt", "use_prefix", "max_best_of",
+                             "num_topk"),
+            donate_argnums=(3,),      # kv_caches
+        )
         self._burst_scan_fn = jax.jit(
             self._burst_scan,
             static_argnames=("max_best_of", "num_topk", "num_steps"),
@@ -138,6 +149,25 @@ class ModelRunner:
         rows = jnp.take(flat, sel_indices, axis=0)
         logits = self.model.compute_logits(params, rows)
         return logits, new_caches
+
+    def _step_sample(self, params, input_ids, positions, kv_caches,
+                     metadata, sel_indices, tensors, bases, salt1,
+                     salt2, *, is_prompt: bool, use_prefix: bool,
+                     max_best_of: int, num_topk: int):
+        """_step with the fused sampler in the same program (fast path:
+        no host logits processors, no logprob requests)."""
+        meta = metadata.replace(is_prompt=is_prompt,
+                                use_prefix=use_prefix)
+        hidden, new_caches = self.model(params, input_ids, positions,
+                                        kv_caches, meta)
+        flat = hidden.reshape(-1, hidden.shape[-1])
+        rows = jnp.take(flat, sel_indices, axis=0)
+        logits = self.model.compute_logits(params, rows)
+        packed, _ = fused_sample(
+            logits, tensors, bases, salt1, salt2,
+            max_best_of=max_best_of, num_topk=num_topk,
+            need_logprobs=False)
+        return packed, new_caches
 
     def _burst_step(self, params, input_ids, positions, kv_caches,
                     metadata, tensors, bases, salt1, salt2, greedy_mask,
@@ -359,6 +389,12 @@ class ModelRunner:
                     cell = i * ppp + p
                     pid[cell] = table[ctx_pages + p]
                     sblk[cell] = (i * padded_len) // ps + p
+                    # The Pallas prefill writer fetches its source rows
+                    # by CELL INDEX (identity contract — its in-kernel
+                    # block map cannot consult sblk); this layout is
+                    # identity by construction, and the assert keeps a
+                    # future re-layout from silently writing wrong KV.
+                    assert sblk[cell] == cell, (sblk[cell], cell)
                     vld[cell] = min(n - p * ps, ps)
             prefill_cells = (jnp.asarray(pid), jnp.asarray(sblk),
                              jnp.asarray(vld))
@@ -527,43 +563,62 @@ class ModelRunner:
             seq_group_metadata_list, inputs["input_ids"].shape[0],
             rows_per_group)
         t1 = _time.perf_counter() if timing else 0.0
-        logits, kv_caches = self._step_fn(
-            params, inputs["input_ids"], inputs["positions"],
-            kv_caches, inputs["metadata"], inputs["sel"],
-            is_prompt=inputs["is_prompt"],
-            use_prefix=inputs["use_prefix"])
-        t2 = _time.perf_counter() if timing else 0.0
 
         has_processors = any(
             p.logits_processors for _, p in sampling.seq_groups)
-        if has_processors:
-            # Host logits-processor path: needs the logits on the host
-            # mid-pipeline; pays extra syncs but only when a request
-            # installs custom processors.
-            output = self.sampler(logits[:inputs["num_rows"]], sampling)
+        plan = None if has_processors else \
+            self.sampler.plan(sampling, pad_to=inputs["sel"].shape[0])
+
+        # The fused program's sampler statics stay PINNED at the
+        # serving default (best_of=1, no top-k logprobs): a varying
+        # best_of/logprobs request must not recompile the whole model
+        # program — those route through the split path, where only the
+        # small sampler program recompiles.
+        if has_processors or plan.need_logprobs or \
+                plan.max_best_of != 1 or plan.num_topk != 0:
+            # Raw-logits routes: host logits processors need the
+            # logits mid-pipeline; logprob requests need the full
+            # log-softmax rows. Two device programs.
+            logits, kv_caches = self._step_fn(
+                params, inputs["input_ids"], inputs["positions"],
+                kv_caches, inputs["metadata"], inputs["sel"],
+                is_prompt=inputs["is_prompt"],
+                use_prefix=inputs["use_prefix"])
+            if has_processors:
+                output = self.sampler(logits[:inputs["num_rows"]],
+                                      sampling)
+                return output, kv_caches
+            packed, logprobs_dev = _fused_sample_jit(
+                logits, plan.tensors, jnp.asarray(plan.bases),
+                jnp.asarray(plan.salt1), jnp.asarray(plan.salt2),
+                max_best_of=plan.max_best_of, num_topk=plan.num_topk,
+                need_logprobs=plan.need_logprobs)
+            output = self.sampler.finalize(sampling, plan,
+                                           np.asarray(packed),
+                                           logprobs_dev)
             return output, kv_caches
 
-        # Fast path: sampling runs as a second async device program over
-        # the padded row bucket; the ONLY blocking transfer per step is
-        # the packed result pull in the middle here.
-        plan = self.sampler.plan(sampling, pad_to=logits.shape[0])
-        t3 = _time.perf_counter() if timing else 0.0
-        packed, logprobs_dev = _fused_sample_jit(
-            logits, plan.tensors, jnp.asarray(plan.bases),
+        # Fast path: model + fused sampler as ONE device program; the
+        # only blocking transfer per round is the packed result pull.
+        packed, kv_caches = self._step_sample_fn(
+            params, inputs["input_ids"], inputs["positions"],
+            kv_caches, inputs["metadata"], inputs["sel"],
+            plan.tensors, jnp.asarray(plan.bases),
             jnp.asarray(plan.salt1), jnp.asarray(plan.salt2),
-            max_best_of=plan.max_best_of, num_topk=plan.num_topk,
-            need_logprobs=plan.need_logprobs)
+            is_prompt=inputs["is_prompt"],
+            use_prefix=inputs["use_prefix"],
+            max_best_of=plan.max_best_of, num_topk=plan.num_topk)
+        t2 = _time.perf_counter() if timing else 0.0
         packed_np = np.asarray(packed)                     # ONE sync
         t4 = _time.perf_counter() if timing else 0.0
-        output = self.sampler.finalize(sampling, plan, packed_np,
-                                       logprobs_dev)
+        output = self.sampler.finalize(sampling, plan, packed_np, None)
         if timing:
             t5 = _time.perf_counter()
-            print(f"[step prompt={is_prompt} rows={logits.shape[0]}] "
+            print(f"[step prompt={is_prompt} "
+                  f"rows={inputs['sel'].shape[0]}] "
                   f"prep {(t1 - t0) * 1e3:.0f} ms, dispatch "
-                  f"{(t2 - t1) * 1e3:.0f} ms, plan "
-                  f"{(t3 - t2) * 1e3:.0f} ms, sample+sync "
-                  f"{(t4 - t3) * 1e3:.0f} ms, finalize "
+                  f"{(t2 - t1) * 1e3:.0f} ms, sync "
+                  f"{(t4 - t2) * 1e3:.0f} ms, finalize "
                   f"{(t5 - t4) * 1e3:.0f} ms", flush=True)
         return output, kv_caches
 

@@ -81,7 +81,10 @@ class LoRALinearMethod(LinearMethod):
         b_tok = jnp.take(b, safe, axis=0)         # [batch, r, out]
         xa = jnp.einsum("bsh,bhr->bsr", x, a_tok)
         delta = jnp.einsum("bsr,bro->bso", xa, b_tok)
-        active = (idx >= 0)[:, None, None].astype(delta.dtype)
+        # Out-of-range idx gets NO adapter (the old dense mask matched
+        # no slot; the clamped gather would silently apply the last).
+        active = ((idx >= 0) & (idx < a.shape[0]))[:, None, None] \
+            .astype(delta.dtype)
         return y + delta * active
 
     def load_weight(self, params, name, hf_tensor):

@@ -284,9 +284,10 @@ def write_kv_pages_prefill(
 
     Contract: cell c's source rows are knew[c*page_size:(c+1)*page_size]
     — i.e. `src_blocks` is the identity. _prepare_prompt's page-aligned
-    cell layout guarantees this (cell i*ppp+p reads block i*ppp+p); the
-    parameter is retained so callers state the mapping explicitly and a
-    future non-identity layout fails loudly below."""
+    cell layout guarantees this (cell i*ppp+p reads block i*ppp+p, with
+    a host-side assert there); the check below only fires for EAGER
+    callers (tests) — under jit the args are tracers and the caller's
+    assert is the real guard."""
     tokens, hd = knew.shape
     num_pages, page_size, _ = k_pages.shape
     cells = page_ids.shape[0]

@@ -84,7 +84,9 @@ def _tile_mn(m: int, N: int, dtype, min_bn: int = 128):
     Tiny m (decode at low batch) is grid-overhead bound — the kernel
     dequantizes the whole weight tile per grid cell regardless of m,
     and the ~5 us/cell fixed cost dominates (LATENCY_r03's 12.7 tok/s
-    at bs=1 was mostly this) — so small m takes the WIDEST lane tiles."""
+    at bs=1 was mostly this); the small-m remedy is DEEPER k tiles
+    (_tile_k doubles block_k to 1024 at m <= 64 — matmuls 77 -> 12
+    ms/step at m=16, round 4) while block_n stays capped at 2048."""
     import os
     sublane = 16 if dtype == jnp.bfloat16 else 8
     bm_cap = int(os.environ.get("APHRODITE_QMM_BLOCK_M", "512"))
@@ -157,6 +159,47 @@ def gptq_supported(in_features: int, out_features: int, bits: int,
             gs <= 1024 and out_features % 128 == 0)
 
 
+def _gptq_prologue(x, qzeros, scales, N: int, bits: int, gs: int,
+                   tile_dtype):
+    """Shared GPTQ wrapper prologue (one copy of the layout logic for
+    the W4A16 and W4A8 kernels): plane-permute and pad x, unpack the
+    zero points (+1, AutoGPTQ convention), lift scales to the [G, 1, N]
+    block shape, and size the tiles. Returns
+    (x, z_all, scales3, tiles) with tiles = (block_m, block_n, block_k,
+    padded_m, grid, groups_per_tile, k_tiles)."""
+    m, K = x.shape
+    pack = 32 // bits
+    # Tile sizes: per-grid-step overhead (~5us) dominates when tiles
+    # are small, so spend VMEM on big tiles — block_k spans several
+    # quant groups (the kernels dequant each group chunk separately).
+    block_k = _tile_k(m, K, gs)
+    block_m, block_n, padded_m = _tile_mn(m, N, tile_dtype)
+    # Plane-order unpack (see _unpack_planes): permute x's columns to
+    # match — per GROUP, since the kernels unpack each group chunk
+    # separately. The permutation is exactly a blockwise [R, pack]
+    # transpose, which XLA lowers natively (an explicit index gather
+    # is ~100x slower here).
+    R = gs // pack
+    x = x.reshape(m, K // gs, R, pack).swapaxes(2, 3).reshape(m, K)
+    if padded_m != m:
+        x = jnp.pad(x, ((0, padded_m - m), (0, 0)))
+    k_tiles = K // block_k
+    groups_per_tile = block_k // gs
+    grid = (padded_m // block_m, N // block_n, k_tiles)
+    # Zeros are unpacked once in the XLA prologue ([G, N] is
+    # ~weights/gs — trivial traffic) so the kernel's z block is a plain
+    # lane slice; the [G, 1, N] shape keeps the per-group row block
+    # legal (a block dim of 1 must equal the array dim).
+    shifts = (jnp.arange(pack, dtype=jnp.int32) * bits)[None, None, :]
+    z_all = jax.lax.bitwise_and(
+        jax.lax.shift_right_logical(qzeros[:, :, None], shifts),
+        (1 << bits) - 1).reshape(qzeros.shape[0], 1, N) + 1
+    scales3 = scales[:, None, :]
+    tiles = (block_m, block_n, block_k, padded_m, grid,
+             groups_per_tile, k_tiles)
+    return x, z_all, scales3, tiles
+
+
 @functools.partial(jax.jit,
                    static_argnames=("bits", "group_size", "interpret"))
 def gptq_matmul(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
@@ -171,36 +214,10 @@ def gptq_matmul(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
     N = qweight.shape[1]
     gs = group_size if group_size != -1 else K
     pack = 32 // bits
-
-    # Tile sizes: per-grid-step overhead (~5us) dominates when tiles are
-    # small, so spend VMEM on big tiles — block_k spans several quant
-    # groups (the kernel dequants each group chunk separately) and
-    # block_n goes up to 2048 lanes.
-    block_k = _tile_k(m, K, gs)
-    block_m, block_n, padded_m = _tile_mn(m, N, x.dtype)
-    # Plane-order unpack (see _unpack_planes): permute x's columns to
-    # match — per GROUP, since the kernel unpacks each group chunk
-    # separately. The permutation is exactly a blockwise [R, pack]
-    # transpose, which XLA lowers natively (an explicit index gather is
-    # ~100x slower here).
-    R = gs // pack
-    x = x.reshape(m, K // gs, R, pack).swapaxes(2, 3).reshape(m, K)
-    if padded_m != m:
-        x = jnp.pad(x, ((0, padded_m - m), (0, 0)))
-
-    k_tiles = K // block_k
-    groups_per_tile = block_k // gs
-    grid = (padded_m // block_m, N // block_n, k_tiles)
-
-    # Zeros are unpacked once in the XLA prologue ([G, N] is ~weights/gs
-    # — trivial traffic) so the kernel's z block is a plain lane slice;
-    # the [G, 1, N] shape keeps the per-group row block legal (a block
-    # dim of 1 must equal the array dim).
-    shifts = (jnp.arange(pack, dtype=jnp.int32) * bits)[None, None, :]
-    z_all = jax.lax.bitwise_and(
-        jax.lax.shift_right_logical(qzeros[:, :, None], shifts),
-        (1 << bits) - 1).reshape(qzeros.shape[0], 1, N) + 1
-    scales3 = scales[:, None, :]
+    x, z_all, scales3, tiles = _gptq_prologue(
+        x, qzeros, scales, N, bits, gs, x.dtype)
+    (block_m, block_n, block_k, padded_m, grid,
+     groups_per_tile, k_tiles) = tiles
 
     out = pl.pallas_call(
         functools.partial(_kernel, bits=bits, k_tiles=k_tiles,
@@ -562,30 +579,21 @@ def gptq_matmul_a8(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
     gs = group_size if group_size != -1 else K
     pack = 32 // bits
 
-    # Per-row symmetric int8 activation quantization.
+    # Per-row symmetric int8 activation quantization (row scales are
+    # permutation-invariant, so quantize before the shared prologue's
+    # column permute).
     absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=1,
                      keepdims=True)
     xs = jnp.maximum(absmax, 1e-8) / 127.0            # [m, 1]
     x8 = jnp.clip(jnp.round(x.astype(jnp.float32) / xs), -127,
                   127).astype(jnp.int8)
 
-    block_k = _tile_k(m, K, gs)
-    block_m, block_n, padded_m = _tile_mn(m, N, jnp.bfloat16)
-    R = gs // pack
-    x8 = x8.reshape(m, K // gs, R, pack).swapaxes(2, 3).reshape(m, K)
+    x8, z_all, scales3, tiles = _gptq_prologue(
+        x8, qzeros, scales, N, bits, gs, jnp.bfloat16)
+    (block_m, block_n, block_k, padded_m, grid,
+     groups_per_tile, k_tiles) = tiles
     if padded_m != m:
-        x8 = jnp.pad(x8, ((0, padded_m - m), (0, 0)))
         xs = jnp.pad(xs, ((0, padded_m - m), (0, 0)))
-
-    k_tiles = K // block_k
-    groups_per_tile = block_k // gs
-    grid = (padded_m // block_m, N // block_n, k_tiles)
-
-    shifts = (jnp.arange(pack, dtype=jnp.int32) * bits)[None, None, :]
-    z_all = jax.lax.bitwise_and(
-        jax.lax.shift_right_logical(qzeros[:, :, None], shifts),
-        (1 << bits) - 1).reshape(qzeros.shape[0], 1, N) + 1
-    scales3 = scales[:, None, :]
 
     out = pl.pallas_call(
         functools.partial(_gptq_a8_kernel, bits=bits, k_tiles=k_tiles,

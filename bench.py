@@ -213,10 +213,12 @@ def main() -> None:
     import gc
     gc.collect()
     gc.disable()
-    t0 = time.perf_counter()
-    total_out = _run(engine, sp, rng_tokens, steps)
-    dt = time.perf_counter() - t0
-    gc.enable()
+    try:
+        t0 = time.perf_counter()
+        total_out = _run(engine, sp, rng_tokens, steps)
+        dt = time.perf_counter() - t0
+    finally:
+        gc.enable()
     _log(f"timed run: {total_out} tokens in {dt:.1f}s")
 
     toks = total_out / dt
@@ -226,6 +228,10 @@ def main() -> None:
         tag += f"_{mode}"
     if tp > 1:
         tag += f"_tp{tp}"
+    # Activation mode rides in the JSON so W4A8 and W4A16 runs can't
+    # be conflated round-over-round.
+    act_mode = "w4a8" if os.environ.get("APHRODITE_W4A8") == "1" \
+        else "w4a16"
     # quant/batch/kv ride in the JSON so round-over-round comparisons
     # can't conflate differently-configured runs (round-2 advisor).
     print(json.dumps({
@@ -235,6 +241,7 @@ def main() -> None:
         "vs_baseline": round(toks / baseline, 4),
         "quant": quant, "batch": batch, "steps": steps,
         "kv_dtype": kv_dtype, "baseline": baseline, "tp": tp,
+        "activations": act_mode if quant == "gptq" else None,
     }))
 
 

@@ -77,7 +77,12 @@ def main() -> None:
         return time.perf_counter() - t0, ttft, n
 
     for _ in range(args.warmup):
-        run(min(64, args.output_len))
+        # Warmup must cover the FULL decode range: every
+        # (pages-bucket, burst-length) pair the timed run walks is its
+        # own compiled program, and a 64-token warmup left the later
+        # buckets compiling inside the measurement (round-4: 14 tok/s
+        # reported where steady state was 55+).
+        run(args.output_len)
     wall, ttft, n = run(args.output_len)
     decode_tps = (n - 1) / (wall - ttft) if n > 1 else 0.0
     print(json.dumps({

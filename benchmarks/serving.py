@@ -75,25 +75,31 @@ async def run(args) -> dict:
             tpots.append((t1 - (first or t1)) / (n_out - 1))
         e2es.append(t1 - t0)
 
-    if getattr(args, "warmup", 0):
-        # Warm the compile caches with the same workload (this
-        # platform's remote compiles cost ~20 s per shape bucket; the
-        # reference's CUDA-graph capture is likewise excluded from its
-        # measurements by the first requests absorbing it).
-        warm = [asyncio.create_task(one(i))
-                for i in range(args.num_requests)]
-        await asyncio.gather(*warm)
+    async def drive() -> float:
+        # Fresh rng per pass: warmup replays the SAME Poisson arrival
+        # schedule as the measured pass, so the batch-size bucket walk
+        # a slow arrival rate creates is compiled before measurement
+        # (an all-at-once warmup only covers the big-batch buckets —
+        # round 4's rate-2.0 runs showed 87 s compile-dominated TTFTs
+        # behind a "warmed" flag). ~20 s per shape bucket on this
+        # platform; the reference's CUDA-graph capture is likewise
+        # excluded from its measurements.
+        arrival_rng = np.random.RandomState(1234)
+        tasks = []
+        t0 = time.perf_counter()
+        async for i in poisson_arrivals(args.num_requests,
+                                        args.request_rate, arrival_rng):
+            tasks.append(asyncio.create_task(one(i)))
+        await asyncio.gather(*tasks)
+        return time.perf_counter() - t0
+
+    for _ in range(int(getattr(args, "warmup", 0) or 0)):
+        await drive()
         ttfts.clear()
         tpots.clear()
         e2es.clear()
 
-    tasks = []
-    t_start = time.perf_counter()
-    async for i in poisson_arrivals(args.num_requests, args.request_rate,
-                                    rng):
-        tasks.append(asyncio.create_task(one(i)))
-    await asyncio.gather(*tasks)
-    wall = time.perf_counter() - t_start
+    wall = await drive()
 
     def pct(xs, p):
         # 0.0 (not None) for empty series: round() downstream.
