@@ -392,9 +392,13 @@ class ModelRunner:
                     # The Pallas prefill writer fetches its source rows
                     # by CELL INDEX (identity contract — its in-kernel
                     # block map cannot consult sblk); this layout is
-                    # identity by construction, and the assert keeps a
+                    # identity by construction, and the check keeps a
                     # future re-layout from silently writing wrong KV.
-                    assert sblk[cell] == cell, (sblk[cell], cell)
+                    # A real raise, not an assert: it must survive -O.
+                    if sblk[cell] != cell:
+                        raise AssertionError(
+                            f"prefill cell layout not identity: "
+                            f"{sblk[cell]} != {cell}")
                     vld[cell] = min(n - p * ps, ps)
             prefill_cells = (jnp.asarray(pid), jnp.asarray(sblk),
                              jnp.asarray(vld))
@@ -593,9 +597,17 @@ class ModelRunner:
                 jnp.asarray(plan.salt1), jnp.asarray(plan.salt2),
                 max_best_of=plan.max_best_of, num_topk=plan.num_topk,
                 need_logprobs=plan.need_logprobs)
-            output = self.sampler.finalize(sampling, plan,
-                                           np.asarray(packed),
+            packed_np = np.asarray(packed)
+            t4 = _time.perf_counter() if timing else 0.0
+            output = self.sampler.finalize(sampling, plan, packed_np,
                                            logprobs_dev)
+            if timing:
+                print(f"[step split prompt={is_prompt} "
+                      f"rows={inputs['sel'].shape[0]}] prep "
+                      f"{(t1 - t0) * 1e3:.0f} ms, dispatch+sync "
+                      f"{(t4 - t1) * 1e3:.0f} ms, finalize "
+                      f"{(_time.perf_counter() - t4) * 1e3:.0f} ms",
+                      flush=True)
             return output, kv_caches
 
         # Fast path: model + fused sampler as ONE device program; the

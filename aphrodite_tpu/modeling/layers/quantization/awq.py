@@ -115,12 +115,18 @@ class AWQLinearMethod(LinearMethod):
         in_features, n_packed = qw.shape
         lead = x.shape[:-1]
         if jax.default_backend() == "tpu":
+            import os
             from aphrodite_tpu.ops.pallas.quant_matmul import (
-                awq_matmul, awq_supported)
+                awq_matmul, awq_matmul_a8, awq_supported)
             if awq_supported(in_features, n_packed * 8, cfg.group_size):
-                y = awq_matmul(x.reshape(-1, in_features), qw,
-                               params["qzeros"], params["scales"],
-                               group_size=cfg.group_size)
+                # APHRODITE_W4A8: int8 activations into the MXU int8
+                # mode — same opt-in/accuracy story as the GPTQ path
+                # (AWQ is always 4-bit, so no bits gate needed).
+                mm = awq_matmul_a8 if os.environ.get(
+                    "APHRODITE_W4A8") == "1" else awq_matmul
+                y = mm(x.reshape(-1, in_features), qw,
+                       params["qzeros"], params["scales"],
+                       group_size=cfg.group_size)
                 y = y.reshape(*lead, n_packed * 8)
                 if "bias" in params:
                     y = y + params["bias"]

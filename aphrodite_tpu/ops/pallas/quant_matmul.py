@@ -291,6 +291,45 @@ def awq_supported(in_features: int, out_features: int,
                                          # plane width lane-aligned
 
 
+def _quantize_activations_int8(x):
+    """Per-row symmetric int8 activation quantization (shared by the
+    W4A8 kernels). Returns (x8 [m, K] int8, xs [m, 1] f32)."""
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=1,
+                     keepdims=True)
+    xs = jnp.maximum(absmax, 1e-8) / 127.0
+    x8 = jnp.clip(jnp.round(x.astype(jnp.float32) / xs), -127,
+                  127).astype(jnp.int8)
+    return x8, xs
+
+
+def _awq_zs_plane_major(qzeros, scales, N, n_tiles, block_n, G):
+    """Arrange z and s into the kernels' tile-local plane-major column
+    order (ONE copy of the permutation convention for the W4A16 and
+    W4A8 AWQ wrappers): natural column c = t*bn + 8j + e sits at
+    t*bn + AWQ_ORDER[e]*(bn/8) + j, built with reshape/transpose
+    (XLA-native). Returns (z_pm [G,1,N], s_pm [G,1,N], order)."""
+    from aphrodite_tpu.modeling.layers.quantization.awq import (
+        AWQ_ORDER, _unpack_awq)
+    inv = np.argsort(np.asarray(AWQ_ORDER))
+
+    def to_plane_major(a):
+        t = a.reshape(*a.shape[:-1], n_tiles, block_n // 8, 8)
+        t = jnp.moveaxis(t[..., inv], -1, -2)     # [.., 8, bn/8]
+        return t.reshape(*a.shape[:-1], N)
+
+    z_nat = _unpack_awq(qzeros)                   # [G, N] natural
+    z_pm = to_plane_major(z_nat).reshape(G, 1, N)
+    s_pm = to_plane_major(scales).reshape(G, 1, N)
+    return z_pm, s_pm, np.asarray(AWQ_ORDER)
+
+
+def _awq_unpermute(y, padded_m, N, n_tiles, block_n, order):
+    """Inverse of the kernels' plane-major output column order."""
+    y = y.reshape(padded_m, n_tiles, 8, block_n // 8)
+    y = jnp.moveaxis(y, -2, -1)[..., order]       # [m, t, bn/8, 8]
+    return y.reshape(padded_m, N)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("group_size", "interpret"))
 def awq_matmul(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
@@ -300,8 +339,6 @@ def awq_matmul(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
     int4 layout (qweight [K, N/8] int32, 8 interleaved nibbles along N;
     qzeros [G, N/8] same packing; scales [G, N]; w = (q - z) * s).
     """
-    from aphrodite_tpu.modeling.layers.quantization.awq import (
-        AWQ_ORDER, _unpack_awq)
     m, K = x.shape
     N = qweight.shape[1] * 8
     gs = group_size
@@ -321,22 +358,8 @@ def awq_matmul(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
     groups_per_tile = block_k // gs
     n_tiles = N // block_n
     grid = (padded_m // block_m, n_tiles, k_tiles)
-
-    # Tile-local plane-major arrangement for z and s: natural column
-    # c = t*bn + 8j + e sits at t*bn + AWQ_ORDER[e]*(bn/8) + j. Build it
-    # with reshape/transpose (XLA-native): [.., bn/8, 8] -> index the
-    # nibble-order axis -> [.., 8, bn/8].
-    inv = np.argsort(np.asarray(AWQ_ORDER))    # plane p -> element e
-
-    def to_plane_major(a):                     # [..., N] natural
-        t = a.reshape(*a.shape[:-1], n_tiles, block_n // 8, 8)
-        t = jnp.moveaxis(t[..., inv], -1, -2)  # [.., 8, bn/8]
-        return t.reshape(*a.shape[:-1], N)
-
-    order = np.asarray(AWQ_ORDER)
-    z_nat = _unpack_awq(qzeros)                # [G, N] natural order
-    z_pm = to_plane_major(z_nat).reshape(G, 1, N)
-    s_pm = to_plane_major(scales).reshape(G, 1, N)
+    z_pm, s_pm, order = _awq_zs_plane_major(qzeros, scales, N,
+                                            n_tiles, block_n, G)
 
     out_pm = pl.pallas_call(
         functools.partial(_awq_kernel, k_tiles=k_tiles, group_size=gs),
@@ -359,11 +382,97 @@ def awq_matmul(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
         interpret=interpret,
     )(x, qweight, z_pm, s_pm)
 
-    # Un-permute output columns: plane-major -> natural (inverse of
-    # to_plane_major).
-    y = out_pm.reshape(padded_m, n_tiles, 8, block_n // 8)
-    y = jnp.moveaxis(y, -2, -1)[..., order]    # [m, t, bn/8, 8]
-    y = y.reshape(padded_m, N)
+    y = _awq_unpermute(out_pm, padded_m, N, n_tiles, block_n, order)
+    return y[:m] if padded_m != m else y
+
+
+def _awq_a8_kernel(x_ref, xs_ref, qw_ref, z_ref, s_ref, o_ref,
+                   acc_ref, *, k_tiles: int, group_size: int):
+    """W4A8 variant of _awq_kernel: int8 activations into the MXU int8
+    mode; the zero-point subtraction stays in integers (exact), the
+    int32 group partials rescale into the f32 accumulator (same scheme
+    as _gptq_a8_kernel)."""
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    gs = group_size
+    n_groups = z_ref.shape[0]
+    qw = qw_ref[...]                                  # [bk, bn/8] int32
+    planes = [
+        jax.lax.bitwise_and(jax.lax.shift_right_logical(qw, 4 * p), 0xF)
+        for p in range(8)
+    ]
+    w_pm = jax.lax.concatenate(planes, 1)             # [bk, bn] int32
+    for g in range(n_groups):
+        w8 = (w_pm[g * gs:(g + 1) * gs] - z_ref[g]).astype(jnp.int8)
+        x8 = x_ref[:, g * gs:(g + 1) * gs]
+        d = jax.lax.dot_general(x8, w8, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.int32)
+        acc_ref[...] += d.astype(jnp.float32) * \
+            s_ref[g].astype(jnp.float32)
+
+    @pl.when(k == k_tiles - 1)
+    def _flush():
+        o_ref[...] = (acc_ref[...] *
+                      xs_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("group_size", "interpret"))
+def awq_matmul_a8(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
+                  scales: jax.Array, *, group_size: int,
+                  interpret: bool = False) -> jax.Array:
+    """W4A8 AWQ: per-row int8 activation quantization feeding integer
+    dots (see awq_matmul for the layout story; only the dequant->dot
+    arithmetic differs)."""
+    m, K = x.shape
+    N = qweight.shape[1] * 8
+    gs = group_size
+    G = K // gs
+
+    x8, xs = _quantize_activations_int8(x)
+
+    block_k = _tile_k(m, K, gs)
+    block_m, block_n, padded_m = _tile_mn(m, N, jnp.bfloat16,
+                                          min_bn=1024)
+    if padded_m != m:
+        x8 = jnp.pad(x8, ((0, padded_m - m), (0, 0)))
+        xs = jnp.pad(xs, ((0, padded_m - m), (0, 0)))
+
+    k_tiles = K // block_k
+    groups_per_tile = block_k // gs
+    n_tiles = N // block_n
+    grid = (padded_m // block_m, n_tiles, k_tiles)
+    z_pm, s_pm, order = _awq_zs_plane_major(qzeros, scales, N,
+                                            n_tiles, block_n, G)
+
+    out_pm = pl.pallas_call(
+        functools.partial(_awq_a8_kernel, k_tiles=k_tiles,
+                          group_size=gs),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_m, block_k), lambda i, n, k: (i, k)),
+            pl.BlockSpec((block_m, 1), lambda i, n, k: (i, 0)),
+            pl.BlockSpec((block_k, block_n // 8),
+                         lambda i, n, k: (k, n)),
+            pl.BlockSpec((groups_per_tile, 1, block_n),
+                         lambda i, n, k: (k, 0, n)),
+            pl.BlockSpec((groups_per_tile, 1, block_n),
+                         lambda i, n, k: (k, 0, n)),
+        ],
+        out_specs=pl.BlockSpec((block_m, block_n),
+                               lambda i, n, k: (i, n)),
+        out_shape=jax.ShapeDtypeStruct((padded_m, N), x.dtype),
+        scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(x8, xs, qweight, z_pm, s_pm)
+
+    y = _awq_unpermute(out_pm, padded_m, N, n_tiles, block_n, order)
     return y[:m] if padded_m != m else y
 
 
@@ -579,14 +688,9 @@ def gptq_matmul_a8(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
     gs = group_size if group_size != -1 else K
     pack = 32 // bits
 
-    # Per-row symmetric int8 activation quantization (row scales are
-    # permutation-invariant, so quantize before the shared prologue's
-    # column permute).
-    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=1,
-                     keepdims=True)
-    xs = jnp.maximum(absmax, 1e-8) / 127.0            # [m, 1]
-    x8 = jnp.clip(jnp.round(x.astype(jnp.float32) / xs), -127,
-                  127).astype(jnp.int8)
+    # Row scales are permutation-invariant, so quantize before the
+    # shared prologue's column permute.
+    x8, xs = _quantize_activations_int8(x)
 
     x8, z_all, scales3, tiles = _gptq_prologue(
         x8, qzeros, scales, N, bits, gs, jnp.bfloat16)

@@ -89,14 +89,14 @@ def main() -> None:
             # tp=8 is the bf16 north-star config (weights shard
             # 8-ways, so no quantization needed to fit KV).
             os.environ["BENCH_QUANT"] = "" if tp > 1 else "gptq"
-        if os.environ.get("BENCH_QUANT") == "gptq" and \
+        if os.environ.get("BENCH_QUANT") in ("gptq", "awq") and \
                 "APHRODITE_W4A8" not in os.environ:
-            # The GPTQ bench row runs the int8-activation MXU path
+            # The GPTQ/AWQ bench rows run the int8-activation MXU path
             # (weights stay int4 at rest; activations round to int8
             # per row — the reference's exllama kernel likewise
             # accumulates at reduced precision). BENCH_W4A16=1 /
             # APHRODITE_W4A8=0 selects the bit-exact bf16-activation
-            # path (~4.2k vs ~5.5k out-tok/s, round 4).
+            # path (~4.2k vs ~6.2k out-tok/s GPTQ, round 4).
             if os.environ.get("BENCH_W4A16") != "1":
                 os.environ["APHRODITE_W4A8"] = "1"
         default_batch = "512" if os.environ["BENCH_QUANT"] else "112"
@@ -232,6 +232,7 @@ def main() -> None:
     # be conflated round-over-round.
     act_mode = "w4a8" if os.environ.get("APHRODITE_W4A8") == "1" \
         else "w4a16"
+    act_applies = quant in ("gptq", "awq")
     # quant/batch/kv ride in the JSON so round-over-round comparisons
     # can't conflate differently-configured runs (round-2 advisor).
     print(json.dumps({
@@ -241,7 +242,7 @@ def main() -> None:
         "vs_baseline": round(toks / baseline, 4),
         "quant": quant, "batch": batch, "steps": steps,
         "kv_dtype": kv_dtype, "baseline": baseline, "tp": tp,
-        "activations": act_mode if quant == "gptq" else None,
+        "activations": act_mode if act_applies else None,
     }))
 
 

@@ -515,6 +515,71 @@ def main() -> None:
                                          donate=True)
             rtts.append(rtt)
             row(f"BURST {nm} b={B}", s * 1e3, 1, "")
+        del state
+
+    # --- the REAL whole prompt step (one scheduling round) ---
+    if want("pstep"):
+        from types import SimpleNamespace as _NS2
+        from aphrodite_tpu.modeling.models.llama import LlamaForCausalLM
+        from aphrodite_tpu.modeling.layers.quantization.gptq import (
+            GPTQConfig)
+        from aphrodite_tpu.modeling.hf_loader import (
+            initialize_dummy_params)
+        from aphrodite_tpu.modeling.input_metadata import InputMetadata
+
+        cfg2 = _NS2(
+            architectures=["LlamaForCausalLM"], vocab_size=VOCAB,
+            hidden_size=HIDDEN, intermediate_size=INTER,
+            num_hidden_layers=LAYERS, num_attention_heads=HEADS,
+            num_key_value_heads=KV_HEADS, rms_norm_eps=1e-5,
+            rope_theta=10000.0, max_position_embeddings=4096,
+            tie_word_embeddings=False, hidden_act="silu")
+        pmodel = LlamaForCausalLM(
+            cfg2, dtype=jnp.bfloat16,
+            linear_method=GPTQConfig(4, GROUP).get_linear_method())
+        pparams = initialize_dummy_params(pmodel, seed=0)
+        # Bench prefill geometry: 256 seqs x 32 tokens (8192 tokens, 2
+        # pages/seq), page-aligned -> the whole-page writer engages.
+        PB, PS = 256, 32
+        ppp = PS // PAGE
+        npg2 = PB * ppp + 1
+        kv2 = [
+            (jnp.zeros((npg2, PAGE, KV_HEADS * HEAD_DIM), jnp.bfloat16),
+             jnp.zeros((npg2, PAGE, KV_HEADS * HEAD_DIM), jnp.bfloat16))
+            for _ in range(LAYERS)
+        ]
+        tbl2 = jnp.asarray(
+            np.arange(PB * ppp).reshape(PB, ppp), jnp.int32)
+        cells = PB * ppp
+        pmeta = InputMetadata(
+            slot_mapping=jnp.asarray(np.arange(PB * PS), jnp.int32),
+            block_tables=tbl2,
+            context_lens=jnp.zeros((PB,), jnp.int32),
+            prompt_lens=jnp.full((PB,), PS, jnp.int32),
+            prefill_cells=(
+                jnp.asarray(np.arange(cells), jnp.int32),
+                jnp.asarray(np.arange(cells), jnp.int32),
+                jnp.full((cells,), PAGE, jnp.int32)),
+            is_prompt=True)
+        pids = jnp.ones((PB, PS), jnp.int32)
+        ppos = jnp.tile(jnp.arange(PS, dtype=jnp.int32)[None], (PB, 1))
+
+        def prompt_step(c, t):
+            ids, pos, meta, kv, prm = c
+            hidden, kv = pmodel(prm, ids, pos, kv, meta)
+            flat = hidden.reshape(-1, hidden.shape[-1])
+            sel = jnp.arange(PB, dtype=jnp.int32) * PS + (PS - 1)
+            logits = pmodel.compute_logits(
+                prm, jnp.take(flat, sel, axis=0))
+            ids = jnp.maximum(
+                ids, (logits[:, :1] * 0).astype(jnp.int32))
+            return (ids, pos, meta, kv, prm)
+
+        s, rtt, _ = device_bench(
+            prompt_step, (pids, ppos, pmeta, kv2, pparams), slow=True,
+            donate=True)
+        rtts.append(rtt)
+        row(f"PROMPT step {PB}x{PS} (8k tok, 32L)", s * 1e3, 1, "")
 
     # --- elementwise glue: rmsnorm x2 + silu_and_mul per layer ---
     if want("glue"):
@@ -546,7 +611,7 @@ def main() -> None:
     # FULL-layer cross-check (which already contains the components)
     # are reference rows, not addends.
     excluded = ("bf16 dense", "kv_write prefill-window", "FULL decoder",
-                "PREFILL", "BURST")
+                "PREFILL", "BURST", "PROMPT", "W4A8")
     for name, ms_call, n, ms_step, note in rows:
         print(f"{name:54s} {ms_call * 1e3:9.1f} {n:4d} {ms_step:8.3f}  "
               f"{note}")

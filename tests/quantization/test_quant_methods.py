@@ -166,6 +166,28 @@ def test_gptq_w4a8_kernel_close_to_w4a16():
     assert rel < 2e-2, rel
 
 
+def test_awq_w4a8_kernel_close_to_dequant():
+    """The AWQ int8-activation kernel (interpret mode) must match the
+    fp dequant path within activation-rounding error."""
+    from aphrodite_tpu.ops.pallas.quant_matmul import awq_matmul_a8
+    K, N, m = 256, 1024, 24
+    G = K // 128
+    qwa = rng.randint(-2**31, 2**31, (K, N // 8)).astype(np.int32)
+    qza = rng.randint(-2**31, 2**31, (G, N // 8)).astype(np.int32)
+    sca = (rng.rand(G, N) * 0.01).astype(np.float32)
+    x = rng.randn(m, K).astype(np.float32)
+    method = AWQConfig(4, 128).get_linear_method()
+    w = np.asarray(method.dequantize(
+        {"qweight": jnp.asarray(qwa), "qzeros": jnp.asarray(qza),
+         "scales": jnp.asarray(sca)}, jnp.float32))
+    ref = x @ w
+    got = np.asarray(awq_matmul_a8(
+        jnp.asarray(x), jnp.asarray(qwa), jnp.asarray(qza),
+        jnp.asarray(sca), group_size=128, interpret=True))
+    rel = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 2e-2, rel
+
+
 def test_squeezellm_fused_kernel_matches_dequant():
     """The Pallas LUT kernel (interpret mode) must match the XLA
     dequantize-then-dot path."""
