@@ -63,12 +63,18 @@ def main() -> None:
         env["XLA_FLAGS"] = (
             flags + f" --xla_force_host_platform_device_count={tp}"
         ).strip()
-        # Functional validation shapes: the full 7B geometry on a CPU
-        # mesh measures sharding correctness, not speed.
+        # Functional validation shapes: per-LAYER geometry identical to
+        # the 7B config (every sharded matmul/attention/KV program is
+        # the same), but fewer layers and small rounds — XLA's CPU
+        # collectives abort the process if any virtual device spends
+        # >40 s in one all-reduce rendezvous, which a full 7B 8k-token
+        # forward does. Sharding correctness, not speed.
         env.setdefault("BENCH_BATCH", "8")
         env.setdefault("BENCH_STEPS", "4")
         env.setdefault("BENCH_PROMPT", "16")
         env.setdefault("BENCH_MULTI_STEP", "4")
+        env.setdefault("BENCH_LAYERS", "4")
+        env.setdefault("BENCH_PREFILL_TOKENS", "2048")
         raise SystemExit(subprocess.run(
             [sys.executable, os.path.abspath(__file__)],
             env=env).returncode)
@@ -85,6 +91,12 @@ def main() -> None:
         # selects the bf16 run against the fp16 row.
         hidden, layers, heads, kv_heads, inter = 4096, 32, 32, 8, 14336
         vocab = 32000
+        # Layer-count override ONLY for the virtual-mesh tp mode (the
+        # per-layer sharded programs are what that validation covers);
+        # a stale BENCH_LAYERS must not silently shrink a real
+        # single-chip measurement.
+        if tp > 1:
+            layers = int(os.environ.get("BENCH_LAYERS", str(layers)))
         if "BENCH_QUANT" not in os.environ:
             # tp=8 is the bf16 north-star config (weights shard
             # 8-ways, so no quantization needed to fit KV).
@@ -243,6 +255,7 @@ def main() -> None:
         "quant": quant, "batch": batch, "steps": steps,
         "kv_dtype": kv_dtype, "baseline": baseline, "tp": tp,
         "activations": act_mode if act_applies else None,
+        "layers": layers,
     }))
 
 

@@ -81,8 +81,17 @@ def main() -> None:
         print(f"max_loras={slots:3d}: {per * 1e6:9.1f} us/call",
               flush=True)
 
-    base = results[0][1]
     worst = max(p for _, p in results)
+    base = results[0][1]
+    if base <= 2e-6:
+        # The slope method bottomed out (tunneled-TPU RTT noise
+        # swamps the combine): the growth ratio is meaningless, so say
+        # so instead of declaring flatness — rerun on an attached
+        # device (or CPU) for the real curve.
+        print(f"base measurement <= {base * 1e6:.1f} us/call: below "
+              "this platform's slope resolution — growth ratio "
+              "unmeasurable here; the CPU run resolves the curve")
+        return
     print(f"growth {worst / base:.2f}x across "
           f"{results[0][0]}->{results[-1][0]} slots "
           f"({'FLAT' if worst / base < 1.5 else 'NOT FLAT'})")
