@@ -65,6 +65,12 @@ _NEG_INF = -2.0**30  # large-but-finite: avoids inf-inf NaNs in corrections
 # waits cell i-_WB_SLOTS's DMA, so deeper rings hide more write latency.
 _WB_SLOTS = 8
 
+# Single-chunk cross-cell read pipeline: cell i starts cell
+# i+_PF_DEPTH's chunk loads; the chunk buffer ring must be deeper than
+# the prefetch distance so a landing load never aliases a live slot.
+_PF_DEPTH = 3
+_CHUNK_SLOTS = 4
+
 
 def head_block(num_kv_heads: int) -> int:
     """Largest divisor of H that is <= 8: the per-grid-cell head count.
@@ -209,22 +215,31 @@ def _decode_kernel_tm(
 
     if single_chunk:
         # Every sequence fits one chunk: pipeline ACROSS grid cells —
-        # cell i starts cell i+1's loads before waiting on its own, so
-        # page-DMA latency overlaps the previous cell's compute.
-        # Scratch/semaphores persist across cells, alternating slots by
-        # cell-index parity.
+        # cell i starts cell i+_PF_DEPTH's loads before waiting on its
+        # own, so page-DMA latency overlaps several cells' compute
+        # (depth 1 left attention at ~450-600 GB/s of the ~820 floor;
+        # the buffer ring has _PF_DEPTH+1 slots so an in-flight load
+        # never lands in a slot still being read). Scratch/semaphores
+        # persist across cells, slots by cell index mod ring size.
         cell = b * n_hb + j
+        total_cells = pl.num_programs(0) * n_hb
 
         @pl.when(cell == 0)
         def _():
-            start_chunk(0, 0, cell_b=0, cell_j=0)
+            # Cells 1.._PF_DEPTH have no predecessor _PF_DEPTH back;
+            # cell 0 seeds their loads (static unroll; NOT `d` — that
+            # name is the kernel-wide head_dim alias).
+            for seed_cell in range(min(_PF_DEPTH + 1, total_cells)):
+                start_chunk(0, seed_cell % _CHUNK_SLOTS,
+                            cell_b=seed_cell // n_hb,
+                            cell_j=seed_cell % n_hb)
 
-        @pl.when(cell + 1 < pl.num_programs(0) * n_hb)
+        @pl.when((cell >= 1) & (cell + _PF_DEPTH < total_cells))
         def _():
-            nb = jnp.where(j + 1 < n_hb, b, b + 1)
-            nj = jnp.where(j + 1 < n_hb, j + 1, 0)
-            start_chunk(0, jax.lax.rem(cell + 1, 2), cell_b=nb,
-                        cell_j=nj)
+            nc = cell + _PF_DEPTH
+            start_chunk(0, jax.lax.rem(nc, _CHUNK_SLOTS),
+                        cell_b=nc // n_hb,
+                        cell_j=jax.lax.rem(nc, n_hb))
     else:
         @pl.when(num_chunks > 0)
         def _():
@@ -232,7 +247,7 @@ def _decode_kernel_tm(
 
     def body(c, _):
         if single_chunk:
-            slot = jax.lax.rem(b * n_hb + j, 2)
+            slot = jax.lax.rem(b * n_hb + j, _CHUNK_SLOTS)
         else:
             slot = jax.lax.rem(c, 2)
 
@@ -432,10 +447,16 @@ def paged_decode_attention(
         in_specs.extend([spec_new, spec_new])
         inputs.extend([kn, vn])
 
+    # The multi-chunk path double-buffers (rem(c, 2)); only the
+    # single-chunk cross-cell pipeline uses the deeper prefetch ring —
+    # don't spend its VMEM otherwise.
+    n_slots = _CHUNK_SLOTS if pages_per_seq == pages_per_chunk else 2
     scratch = [
-        pltpu.VMEM((2, chunk_tokens, hb * head_dim), k_pages.dtype),
-        pltpu.VMEM((2, chunk_tokens, hb * head_dim), v_pages.dtype),
-        pltpu.SemaphoreType.DMA((2, 2)),
+        pltpu.VMEM((n_slots, chunk_tokens, hb * head_dim),
+                   k_pages.dtype),
+        pltpu.VMEM((n_slots, chunk_tokens, hb * head_dim),
+                   v_pages.dtype),
+        pltpu.SemaphoreType.DMA((n_slots, 2)),
         pltpu.VMEM((rows, head_dim), jnp.float32),
         pltpu.VMEM((rows, 128), jnp.float32),
         pltpu.VMEM((rows, 128), jnp.float32),

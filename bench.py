@@ -75,6 +75,7 @@ def main() -> None:
         env.setdefault("BENCH_MULTI_STEP", "4")
         env.setdefault("BENCH_LAYERS", "4")
         env.setdefault("BENCH_PREFILL_TOKENS", "2048")
+        env["BENCH_VIRTUAL"] = "1"
         raise SystemExit(subprocess.run(
             [sys.executable, os.path.abspath(__file__)],
             env=env).returncode)
@@ -91,11 +92,12 @@ def main() -> None:
         # selects the bf16 run against the fp16 row.
         hidden, layers, heads, kv_heads, inter = 4096, 32, 32, 8, 14336
         vocab = 32000
-        # Layer-count override ONLY for the virtual-mesh tp mode (the
-        # per-layer sharded programs are what that validation covers);
-        # a stale BENCH_LAYERS must not silently shrink a real
-        # single-chip measurement.
-        if tp > 1:
+        # Layer-count override ONLY for the virtual-mesh validation
+        # mode (the per-layer sharded programs are what it covers); a
+        # stale BENCH_LAYERS must not silently shrink a REAL
+        # measurement, single-chip or multi-chip — so the gate is the
+        # explicit marker the re-exec parent sets, not tp.
+        if os.environ.get("BENCH_VIRTUAL") == "1":
             layers = int(os.environ.get("BENCH_LAYERS", str(layers)))
         if "BENCH_QUANT" not in os.environ:
             # tp=8 is the bf16 north-star config (weights shard
