@@ -581,6 +581,44 @@ def main() -> None:
         rtts.append(rtt)
         row(f"PROMPT step {PB}x{PS} (8k tok, 32L)", s * 1e3, 1, "")
 
+        # Cache-less ablation: same prompt step with kv_caches=None
+        # (no page writes at all) — the delta is the whole-page
+        # writer's true in-model cost.
+        def prompt_step_nokv(c, t):
+            ids, pos, meta, prm = c
+            hidden, _ = pmodel(prm, ids, pos, None, meta)
+            flat = hidden.reshape(-1, hidden.shape[-1])
+            sel = jnp.arange(PB, dtype=jnp.int32) * PS + (PS - 1)
+            logits = pmodel.compute_logits(
+                prm, jnp.take(flat, sel, axis=0))
+            ids = jnp.maximum(
+                ids, (logits[:, :1] * 0).astype(jnp.int32))
+            return (ids, pos, meta, prm)
+
+        # Fresh small inputs: the first measurement DONATED (consumed)
+        # its carry; params survive only because the nokv carry drops
+        # kv2 — rebuild the rest. (pparams was consumed too: rebuild.)
+        pparams2 = initialize_dummy_params(pmodel, seed=0)
+        pmeta2 = pmeta  # pytree of small arrays; rebuild leaves
+        pmeta2 = InputMetadata(
+            slot_mapping=jnp.asarray(np.arange(PB * PS), jnp.int32),
+            block_tables=jnp.asarray(
+                np.arange(PB * ppp).reshape(PB, ppp), jnp.int32),
+            context_lens=jnp.zeros((PB,), jnp.int32),
+            prompt_lens=jnp.full((PB,), PS, jnp.int32),
+            prefill_cells=(
+                jnp.asarray(np.arange(cells), jnp.int32),
+                jnp.asarray(np.arange(cells), jnp.int32),
+                jnp.full((cells,), PAGE, jnp.int32)),
+            is_prompt=True)
+        s, rtt, _ = device_bench(
+            prompt_step_nokv,
+            (jnp.ones((PB, PS), jnp.int32),
+             jnp.tile(jnp.arange(PS, dtype=jnp.int32)[None], (PB, 1)),
+             pmeta2, pparams2), slow=True, donate=True)
+        rtts.append(rtt)
+        row(f"PROMPT step {PB}x{PS} NO-KV-write", s * 1e3, 1, "")
+
     # --- elementwise glue: rmsnorm x2 + silu_and_mul per layer ---
     if want("glue"):
         from aphrodite_tpu.modeling.layers.layernorm import rms_norm
