@@ -92,8 +92,10 @@ def _tile_mn(m: int, N: int, dtype, min_bn: int = 128):
     bm_cap = int(os.environ.get("APHRODITE_QMM_BLOCK_M", "512"))
     bm_cap = max(sublane, bm_cap // sublane * sublane)
     block_m = min(bm_cap, -(-m // sublane) * sublane)
-    bn_cap = int(os.environ.get("APHRODITE_QMM_BLOCK_N", "0")) or (
-        1024 if block_m >= 512 else 2048)
+    # Full-width lane tiles at every m: the round-2 A/B that capped
+    # large-batch tiles at 1024 predates the W4A8 kernels (int8 tiles
+    # take half the VMEM); re-measured round 4 at 2048 = +2% bench.
+    bn_cap = int(os.environ.get("APHRODITE_QMM_BLOCK_N", "0")) or 2048
     block_n = max((bn for bn in (2048, 1024, 512, 256, 128)
                    if N % bn == 0), default=0)
     if block_n < min_bn:
