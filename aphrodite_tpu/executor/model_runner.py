@@ -106,6 +106,13 @@ class ModelRunner:
             kv_cache_dtype in (jnp.bfloat16, jnp.float32) and
             page_size % 8 == 0)
         self.sampler = Sampler(model_config.get_vocab_size())
+        # Block-table width granularity: 8 pages at the default page 16
+        # (the Pallas chunk unit), half that for 32-token pages so a
+        # short context isn't rounded up to 2x its KV (decode attention
+        # is DMA-COUNT bound — bigger pages halve the per-cell DMA
+        # count only if the table width doesn't pad back up).
+        self.pages_bucket = _PAGES_BUCKET if page_size <= 16 else \
+            max(2, _PAGES_BUCKET // 2)
 
         # LoRA: bucket keys carrying slot-stacked adapter tensors, and a
         # slot resolver installed by the executor's WorkerLoRAManager.
@@ -325,11 +332,12 @@ class ModelRunner:
         plens = np.zeros((padded_batch,), dtype=np.int32)
         # Bucket the table width to the longest scheduled table (always
         # — long prompts exceed one bucket regardless of prefix use).
+        pb = self.pages_bucket
         max_pages = max(
-            _PAGES_BUCKET,
+            pb,
             -(-max((len(next(iter(md.block_tables.values()), []))
                     for md in seq_group_metadata_list),
-                   default=1) // _PAGES_BUCKET) * _PAGES_BUCKET)
+                   default=1) // pb) * pb)
         num_pages_oob = self.num_slots // self.page_size
         tables = np.full((padded_batch, max_pages), num_pages_oob,
                          dtype=np.int32)
@@ -472,7 +480,8 @@ class ModelRunner:
         batch = len(tokens)
         padded_batch = _bucket(batch, _DECODE_BATCH_BUCKETS)
         max_pages = max(len(t) for t in tables_list)
-        max_pages = -(-max_pages // _PAGES_BUCKET) * _PAGES_BUCKET
+        max_pages = -(-max_pages // self.pages_bucket) * \
+            self.pages_bucket
 
         ids = np.zeros((padded_batch, 1), dtype=np.int32)
         pos_arr = np.zeros((padded_batch, 1), dtype=np.int32)

@@ -255,7 +255,10 @@ class PagedAttention:
             pps = tables.shape[1]
             page_size = k_pages.shape[1]
             batch = q3.shape[0]
-            ppc = 8
+            # Largest divisor of the table width <= 8 (narrow tables —
+            # e.g. 4 pages at page 32 — must not collapse to 1-page
+            # chunks).
+            ppc = next(d for d in (8, 4, 2, 1) if pps % d == 0)
             # Bigger chunks only for SMALL batches: the table width is
             # the batch MAX, so in a mixed large batch one long sequence
             # would inflate every short sequence's chunk (masked DMA +
@@ -265,8 +268,6 @@ class PagedAttention:
                 while ppc * 2 <= 32 and pps % (ppc * 2) == 0 and \
                         ppc * page_size < 512:
                     ppc *= 2
-            if pps % ppc != 0:
-                ppc = 1
             result = paged_decode_attention(
                 q3, k_pages, v_pages, tables,
                 metadata.context_lens, slopes, knew, vnew,

@@ -157,10 +157,10 @@ def main() -> None:
     multi_step = int(os.environ.get("BENCH_MULTI_STEP", "64"))
     quant = os.environ.get("BENCH_QUANT") or None
     kv_dtype = os.environ.get("BENCH_KV_DTYPE", "auto")
-    # 8-bit KV pages need >=32-token pages for the Pallas decode kernel
-    # (8-bit sublane tile); bf16 keeps the default 16.
-    block_size = int(os.environ.get(
-        "BENCH_BLOCK", "32" if kv_dtype in ("int8", "fp8") else "16"))
+    # 32-token pages halve decode attention's per-cell DMA count (the
+    # kernel is DMA-count bound at short contexts: +2.5% bench, round
+    # 4) and are required for 8-bit KV anyway (8-bit sublane tile).
+    block_size = int(os.environ.get("BENCH_BLOCK", "32"))
     if tp > 1 and size == "7b":
         # Projected per-chip HBM at the v5e-8 serving point (the same
         # math dryrun_multichip asserts — one helper, one truth).
