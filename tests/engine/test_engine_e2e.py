@@ -115,6 +115,24 @@ def test_detokenized_text_nonempty(tiny_llm):
     assert len(out[0].outputs[0].text) > 0
 
 
+def test_block_size_32_matches_16(tiny_model_dir):
+    """32-token pages (the round-4 bench default — decode attention is
+    DMA-count bound) must produce the same greedy tokens as 16-token
+    pages: page size is a layout choice, never a semantic one. Also
+    exercises the narrower block-table bucket that page 32 selects."""
+    from aphrodite_tpu.endpoints.llm import LLM
+    sp = SamplingParams(temperature=0.0, max_tokens=24, ignore_eos=True)
+    prompt = " ".join(["paged attention works"] * 9)
+    outs = {}
+    for bs in (16, 32):
+        llm = LLM(model=tiny_model_dir, load_format="dummy",
+                  dtype="float32", block_size=bs, max_model_len=256,
+                  max_num_seqs=4, multi_step=8, swap_space=0.01)
+        outs[bs] = list(
+            llm.generate([prompt], sp)[0].outputs[0].token_ids)
+    assert outs[16] == outs[32], outs
+
+
 def test_fp8_kv_cache(tiny_model_dir):
     """fp8-e5m2 KV cache halves KV bytes; greedy output should stay
     close to full-precision (same argmax on a short run here)."""
