@@ -545,7 +545,7 @@ def gguf_q4k_matmul(x: jax.Array, qweight: jax.Array, dl: jax.Array,
     m, K = x.shape
     N = qweight.shape[1]
     G = K // 32
-    block_k = 512 if K % 512 == 0 else 256 if K % 256 == 0 else 128
+    block_k = _tile_k(m, K, 128, cap=512) if K % 128 == 0 else K
     block_m, block_n, padded_m = _tile_mn(m, N, x.dtype)
     # Plane-order unpack per 128-row span -> same x column permutation
     # as GPTQ at group_size 128.
@@ -615,7 +615,7 @@ def gguf_q8_matmul(x: jax.Array, qs: jax.Array, d: jax.Array, *,
     m, K = x.shape
     N = qs.shape[1]
     G = K // 32
-    block_k = 512 if K % 512 == 0 else 256
+    block_k = _tile_k(m, K, 256, cap=512) if K % 256 == 0 else K
     block_m, block_n, padded_m = _tile_mn(m, N, x.dtype)
     if padded_m != m:
         x = jnp.pad(x, ((0, padded_m - m), (0, 0)))
@@ -779,7 +779,7 @@ def gguf_i8g_matmul(x: jax.Array, qs: jax.Array, d16: jax.Array, *,
     m, K = x.shape
     N = qs.shape[1]
     G = K // 16
-    block_k = 512 if K % 512 == 0 else 256
+    block_k = _tile_k(m, K, 256, cap=512) if K % 256 == 0 else K
     block_m, block_n, padded_m = _tile_mn(m, N, x.dtype)
     if padded_m != m:
         x = jnp.pad(x, ((0, padded_m - m), (0, 0)))
@@ -851,7 +851,7 @@ def squeezellm_matmul(x: jax.Array, qweight: jax.Array,
     in HBM; the dense weight matrix never materializes."""
     m, K = x.shape
     N = qweight.shape[1]
-    block_k = 512 if K % 512 == 0 else 256
+    block_k = _tile_k(m, K, 256, cap=512) if K % 256 == 0 else K
     block_m, block_n, padded_m = _tile_mn(m, N, x.dtype)
     # Whole-block plane unpack -> x column permutation over each
     # block_k span (same blockwise transpose trick as gptq_matmul).
