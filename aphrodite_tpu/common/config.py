@@ -253,12 +253,11 @@ class ParallelConfig:
     """Mesh-axis sizes for the SPMD step function.
 
     Replaces the reference's Ray/NCCL world description
-    (`common/config.py:359-405`): tp/pp/dp are named axes of one
+    (`common/config.py:359-405`): tp/sp/dp are named axes of one
     `jax.sharding.Mesh`; collectives ride ICI within a slice and DCN across
-    slices (XLA picks based on mesh topology). Unlike the reference, PP is a
-    planned first-class axis (the reference raises NotImplementedError,
-    `config.py:392-394`); it is validated here and implemented via staged
-    meshes in parallel/.
+    slices (XLA picks based on mesh topology). Pipeline parallelism is NOT
+    implemented — like the reference (`config.py:392-394`) pp>1 raises
+    below, rather than silently building a mesh axis no PartitionSpec uses.
     """
 
     def __init__(
@@ -298,6 +297,12 @@ class ParallelConfig:
         ):
             if value < 1:
                 raise ValueError(f"{name} must be >= 1, got {value}.")
+        if self.pipeline_parallel_size > 1:
+            raise NotImplementedError(
+                "Pipeline parallelism is not supported yet: no PartitionSpec "
+                "uses the pp mesh axis, so pp>1 would allocate chips that do "
+                "no work. Shard with tensor_parallel_size and/or "
+                "sequence_parallel_size instead.")
 
 
 class SchedulerConfig:

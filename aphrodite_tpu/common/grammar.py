@@ -196,29 +196,48 @@ class GrammarMatcher:
                  depth_left: int, memo: dict) -> bool:
         # Memo scoped to one can_end call: overlapping short terminals
         # (A='a', AA='aa') reach the same (state, suffix) through
-        # exponentially many split orders; each is decided once.
+        # exponentially many split orders; each is decided once. A
+        # memoized value must be depth-independent, so a False that was
+        # (transitively) produced by the depth cutoff is NOT cached —
+        # _can_end_uncached reports the taint. In practice the cutoff
+        # can never fire: every complete match consumes >= 1 char, so
+        # partial shrinks at least as fast as depth_left and the
+        # partial == "" base case wins the race (can_end starts depth
+        # at len(partial) + 4); the taint tracking keeps the memo
+        # correct even if that invariant ever changes.
+        return self._can_end_memo(parser_key, partial, depth_left, memo)[0]
+
+    def _can_end_memo(self, parser_key: int, partial: str,
+                      depth_left: int, memo: dict):
+        """Returns (result, hit_depth_cutoff); caches untainted results."""
         key = (parser_key, partial)
         hit = memo.get(key)
         if hit is not None:
-            return hit
+            return hit, False
         accepts = self._accepts(parser_key)
         if partial == "":
-            return END in accepts
-        if depth_left <= 0:                    # defensive cycle bound
-            return False
-        result = False
-        for terminal in sorted(accepts):
-            if terminal == END:
-                continue
-            processed, remainder, _ = self._validators[terminal](partial)
-            if processed is None:
-                continue
-            next_key = self._feed(parser_key, terminal, processed)
-            if self._can_end(next_key, remainder, depth_left - 1, memo):
-                result = True
-                break
-        memo[key] = result
-        return result
+            result, cut = END in accepts, False
+        elif depth_left <= 0:                  # defensive cycle bound
+            result, cut = False, True
+        else:
+            result, cut = False, False
+            for terminal in sorted(accepts):
+                if terminal == END:
+                    continue
+                processed, remainder, _ = \
+                    self._validators[terminal](partial)
+                if processed is None:
+                    continue
+                next_key = self._feed(parser_key, terminal, processed)
+                sub, sub_cut = self._can_end_memo(
+                    next_key, remainder, depth_left - 1, memo)
+                cut = cut or sub_cut
+                if sub:
+                    result, cut = True, False
+                    break
+        if result or not cut:
+            memo[key] = result
+        return result, cut
 
 
 class TokenTrie:

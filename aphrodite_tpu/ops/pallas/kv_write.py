@@ -227,9 +227,10 @@ def _prefill_write_kernel(
         @pl.when((pg < num_pages) & (valid < page_size))
         def _partial():
             # Tail page: merge valid rows over the existing page.
-            # Fully synchronous (tails are <=1 per sequence); the
-            # staging slot alternates so two tails in one cell never
-            # race.
+            # Safe because each tail RMW is fully synchronous (start +
+            # wait before the next statement) — a cell may hold many
+            # tails (pages_per_cell up to 16), but at most one is ever
+            # in flight; the alternating slot is incidental.
             s = c % 2
             ck = pltpu.make_async_copy(k_out.at[pg], kbuf.at[s],
                                        rsem.at[s, 0])
@@ -292,11 +293,16 @@ def write_kv_pages_prefill(
     num_pages, page_size, _ = k_pages.shape
     cells = page_ids.shape[0]
     dtype = k_pages.dtype
-    if not isinstance(src_blocks, jax.core.Tracer):
-        import numpy as _np
-        live = _np.asarray(page_ids) < num_pages
-        if not (_np.asarray(src_blocks)[live] ==
-                _np.arange(cells)[live]).all():
+    import numpy as _np
+    try:                      # tracers (jit callers) raise here and skip
+        src_np = _np.asarray(src_blocks)
+        ids_np = _np.asarray(page_ids)
+    except (jax.errors.TracerArrayConversionError,
+            jax.errors.ConcretizationTypeError):
+        src_np = None
+    if src_np is not None:
+        live = ids_np < num_pages
+        if not (src_np[live] == _np.arange(cells)[live]).all():
             raise ValueError(
                 "write_kv_pages_prefill requires identity src_blocks "
                 "(cell c reads knew rows [c*page_size, (c+1)*page_size))")

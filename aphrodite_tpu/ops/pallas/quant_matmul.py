@@ -85,10 +85,9 @@ def _tile_mn(m: int, N: int, dtype, min_bn: int = 128):
     Tiny m (decode at low batch) is grid-overhead bound — the kernel
     dequantizes the whole weight tile per grid cell regardless of m,
     and the ~5 us/cell fixed cost dominates (LATENCY_r03's 12.7 tok/s
-    at bs=1 was mostly this); the small-m remedy is DEEPER k tiles
-    (_tile_k doubles block_k to 1024 at m <= 64 — matmuls 77 -> 12
-    ms/step at m=16, round 4) while block_n stays capped at 2048."""
-    import os
+    at bs=1 was mostly this); the remedy is DEEPER k tiles (_tile_k
+    caps block_k at 1024 for every m — matmuls 77 -> 12 ms/step at
+    m=16, round 4) while block_n stays capped at 2048."""
     sublane = 16 if dtype == jnp.bfloat16 else 8
     bm_cap = int(os.environ.get("APHRODITE_QMM_BLOCK_M", "512"))
     bm_cap = max(sublane, bm_cap // sublane * sublane)
@@ -107,9 +106,11 @@ def _tile_mn(m: int, N: int, dtype, min_bn: int = 128):
     return block_m, block_n, padded_m
 
 
-def _tile_k(m: int, K: int, gs: int, cap: int = 0) -> int:
-    """K tile: block_k spans several quant groups; small m takes deeper
-    tiles (fewer grid cells — see _tile_mn) up to VMEM comfort."""
+def _tile_k(K: int, gs: int, cap: int = 0) -> int:
+    """K tile: block_k spans several quant groups, capped at 1024 (512
+    for the affine/LUT kernels) at EVERY m — the round-4 A/B showed the
+    deep tile wins at batch 512 too, not just small m (commit f34a566),
+    so there is no m-dependent branch here."""
     if not cap:
         # 1024 at every m (round-4 A/B: +2% bench over 512 at batch
         # 512 — fewer grid cells beats the extra VMEM).
@@ -179,7 +180,7 @@ def _gptq_prologue(x, qzeros, scales, N: int, bits: int, gs: int,
     # Tile sizes: per-grid-step overhead (~5us) dominates when tiles
     # are small, so spend VMEM on big tiles — block_k spans several
     # quant groups (the kernels dequant each group chunk separately).
-    block_k = _tile_k(m, K, gs)
+    block_k = _tile_k(K, gs)
     block_m, block_n, padded_m = _tile_mn(m, N, tile_dtype)
     # Plane-order unpack (see _unpack_planes): permute x's columns to
     # match — per GROUP, since the kernels unpack each group chunk
@@ -351,7 +352,7 @@ def awq_matmul(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
     gs = group_size
     G = K // gs
 
-    block_k = _tile_k(m, K, gs)
+    block_k = _tile_k(K, gs)
     # NOTE: pre-refactor AWQ defaulted block_n to 2048 at every m; the
     # shared sizing caps it at 1024 for block_m >= 512. The 0.93x
     # vs-baseline bench row (BENCH notes) was measured WITH the shared
@@ -442,7 +443,7 @@ def awq_matmul_a8(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
 
     x8, xs = _quantize_activations_int8(x)
 
-    block_k = _tile_k(m, K, gs)
+    block_k = _tile_k(K, gs)
     block_m, block_n, padded_m = _tile_mn(m, N, jnp.bfloat16,
                                           min_bn=1024)
     if padded_m != m:
@@ -545,7 +546,7 @@ def gguf_q4k_matmul(x: jax.Array, qweight: jax.Array, dl: jax.Array,
     m, K = x.shape
     N = qweight.shape[1]
     G = K // 32
-    block_k = _tile_k(m, K, 128, cap=512) if K % 128 == 0 else K
+    block_k = _tile_k(K, 128, cap=512) if K % 128 == 0 else K
     block_m, block_n, padded_m = _tile_mn(m, N, x.dtype)
     # Plane-order unpack per 128-row span -> same x column permutation
     # as GPTQ at group_size 128.
@@ -615,7 +616,7 @@ def gguf_q8_matmul(x: jax.Array, qs: jax.Array, d: jax.Array, *,
     m, K = x.shape
     N = qs.shape[1]
     G = K // 32
-    block_k = _tile_k(m, K, 256, cap=512) if K % 256 == 0 else K
+    block_k = _tile_k(K, 256, cap=512) if K % 256 == 0 else K
     block_m, block_n, padded_m = _tile_mn(m, N, x.dtype)
     if padded_m != m:
         x = jnp.pad(x, ((0, padded_m - m), (0, 0)))
@@ -779,7 +780,7 @@ def gguf_i8g_matmul(x: jax.Array, qs: jax.Array, d16: jax.Array, *,
     m, K = x.shape
     N = qs.shape[1]
     G = K // 16
-    block_k = _tile_k(m, K, 256, cap=512) if K % 256 == 0 else K
+    block_k = _tile_k(K, 256, cap=512) if K % 256 == 0 else K
     block_m, block_n, padded_m = _tile_mn(m, N, x.dtype)
     if padded_m != m:
         x = jnp.pad(x, ((0, padded_m - m), (0, 0)))
@@ -851,7 +852,7 @@ def squeezellm_matmul(x: jax.Array, qweight: jax.Array,
     in HBM; the dense weight matrix never materializes."""
     m, K = x.shape
     N = qweight.shape[1]
-    block_k = _tile_k(m, K, 256, cap=512) if K % 256 == 0 else K
+    block_k = _tile_k(K, 256, cap=512) if K % 256 == 0 else K
     block_m, block_n, padded_m = _tile_mn(m, N, x.dtype)
     # Whole-block plane unpack -> x column permutation over each
     # block_k span (same blockwise transpose trick as gptq_matmul).
