@@ -199,7 +199,7 @@ class GrammarMatcher:
         # exponentially many split orders; each is decided once. A
         # memoized value must be depth-independent, so a False that was
         # (transitively) produced by the depth cutoff is NOT cached —
-        # _can_end_uncached reports the taint. In practice the cutoff
+        # _can_end_memo reports the taint. In practice the cutoff
         # can never fire: every complete match consumes >= 1 char, so
         # partial shrinks at least as fast as depth_left and the
         # partial == "" base case wins the race (can_end starts depth

@@ -68,8 +68,12 @@ _WB_SLOTS = 8
 # Single-chunk cross-cell read pipeline: cell i starts cell
 # i+_PF_DEPTH's chunk loads; the chunk buffer ring must be deeper than
 # the prefetch distance so a landing load never aliases a live slot.
-_PF_DEPTH = 3
-_CHUNK_SLOTS = 4
+import os as _os
+_PF_DEPTH = int(_os.environ.get("APHRODITE_ATTN_PF", "6"))
+if _PF_DEPTH < 1:
+    raise ValueError(
+        f"APHRODITE_ATTN_PF must be >= 1, got {_PF_DEPTH}")
+_CHUNK_SLOTS = _PF_DEPTH + 2
 
 
 def head_block(num_kv_heads: int) -> int:
@@ -177,7 +181,14 @@ def _decode_kernel_tm(
         jnp.int32, (rows, hb * d), 1) // d
     row_head = jax.lax.broadcasted_iota(
         jnp.int32, (rows, hb * d), 0) // group
-    q_packed = jnp.where(lane_head == row_head, q_rep, 0.0)
+    # bf16 operand for the MXU score dot: f32 matmuls run the MXU in
+    # multi-pass mode at ~1/6 the bf16 rate, and at this kernel's tiny
+    # per-cell FLOP count the f32 dots were the binding per-cell cost
+    # (399 -> ~330 us/layer measured when both dots take bf16 inputs).
+    # Accumulation stays f32 (preferred_element_type below); only the
+    # operands round to bf16 — standard flash-attention practice.
+    q_packed = jnp.where(lane_head == row_head, q_rep,
+                         0.0).astype(jnp.bfloat16)
 
     # Fused write bookkeeping: the current token sits at position
     # ctx-1, inside chunk c_star at in-chunk row r_star, page slot
@@ -218,8 +229,9 @@ def _decode_kernel_tm(
         # cell i starts cell i+_PF_DEPTH's loads before waiting on its
         # own, so page-DMA latency overlaps several cells' compute
         # (depth 1 left attention at ~450-600 GB/s of the ~820 floor;
-        # the buffer ring has _PF_DEPTH+1 slots so an in-flight load
-        # never lands in a slot still being read). Scratch/semaphores
+        # depth 6 measures ~690; the buffer ring has _PF_DEPTH+2 slots
+        # so an in-flight load never lands in a slot still being
+        # read). Scratch/semaphores
         # persist across cells, slots by cell index mod ring size.
         cell = b * n_hb + j
         total_cells = pl.num_programs(0) * n_hb
@@ -263,19 +275,25 @@ def _decode_kernel_tm(
             # write its page back (this cell's head-lane slice only).
             @pl.when((ctx > 0) & (c == c_star))
             def _():
-                rows_i = jax.lax.broadcasted_iota(
-                    jnp.int32, k_buf.shape[1:], 0)
+                # Inject only into the page being written back (the
+                # token lives there by construction) — a [page_size,
+                # hb*d] where instead of a whole-chunk pass.
+                pg = pl.ds(p_star * page_size, page_size)
+                rows_p = jax.lax.broadcasted_iota(
+                    jnp.int32, (page_size, k_buf.shape[2]), 0)
+                r_in_page = jax.lax.rem(r_star, page_size)
                 kq = _quantize_row(knew_ref[0, 0], k_buf.dtype,
                                    kv_scale)
                 vq = _quantize_row(vnew_ref[0, 0], v_buf.dtype,
                                    kv_scale)
-                k_buf[slot] = jnp.where(rows_i == r_star, kq,
-                                        k_buf[slot])
-                v_buf[slot] = jnp.where(rows_i == r_star, vq,
-                                        v_buf[slot])
-                pg = pl.ds(p_star * page_size, page_size)
-                kwb[s_wb] = k_buf[slot, pg, :]
-                vwb[s_wb] = v_buf[slot, pg, :]
+                kpage = jnp.where(rows_p == r_in_page, kq,
+                                  k_buf[slot, pg, :])
+                vpage = jnp.where(rows_p == r_in_page, vq,
+                                  v_buf[slot, pg, :])
+                k_buf[slot, pg, :] = kpage
+                v_buf[slot, pg, :] = vpage
+                kwb[s_wb] = kpage
+                vwb[s_wb] = vpage
                 pltpu.make_async_copy(
                     kwb.at[s_wb], k_hbm.at[g_star, :, lanes_of(j)],
                     wbsem.at[s_wb, 0]).start()
@@ -283,7 +301,9 @@ def _decode_kernel_tm(
                     vwb.at[s_wb], v_hbm.at[g_star, :, lanes_of(j)],
                     wbsem.at[s_wb, 1]).start()
 
-        k = k_buf[slot].astype(jnp.float32)          # [chunk, hb*d]
+        k = k_buf[slot]                              # [chunk, hb*d]
+        if k.dtype != jnp.bfloat16:                  # int8/fp8 KV dequant
+            k = k.astype(jnp.bfloat16)
         s = jax.lax.dot_general(
             q_packed, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)      # [rows, chunk]
@@ -304,9 +324,11 @@ def _decode_kernel_tm(
         l_prev = l_scr[:, :1]
         l_new = l_prev * corr + jnp.sum(p_exp, axis=1, keepdims=True)
 
-        v = v_buf[slot].astype(jnp.float32)          # [chunk, hb*d]
+        v = v_buf[slot]                              # [chunk, hb*d]
+        if v.dtype != jnp.bfloat16:                  # int8/fp8 KV dequant
+            v = v.astype(jnp.bfloat16)
         pv = jax.lax.dot_general(
-            p_exp, v, (((1,), (0,)), ((), ())),
+            p_exp.astype(jnp.bfloat16), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)      # [rows, hb*d]
         # Extract each row's own head block: hb static lane slices,
         # masked adds (no in-register reshape).

@@ -1,0 +1,82 @@
+"""A/B harness for the decode-attention kernel at the bench geometry.
+
+Measures paged_decode_attention (with and without fused KV write) at the
+exact shapes bench.py drives: batch 512, ctx 128, page 32, 4 pages/seq,
+Mistral-7B heads. Usage:
+    python benchmarks/attn_ab.py [--batch 512] [--ctx 128] [--page 32]
+Variant knobs are env vars read by ops/pallas/paged_attention.py so the
+same binary A/Bs kernel changes without code edits.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from benchmarks.profile_step import device_bench  # noqa: E402
+
+HEADS, KV_HEADS, HEAD_DIM = 32, 8, 128
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=512)
+    ap.add_argument("--ctx", type=int, default=128)
+    ap.add_argument("--page", type=int, default=32)
+    ap.add_argument("--fused", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from aphrodite_tpu.ops.pallas.paged_attention import (
+        paged_decode_attention)
+
+    B, ctx, PAGE = args.batch, args.ctx, args.page
+    pages_per_seq = -(-ctx // PAGE)
+    ppc = next(d for d in (8, 4, 2, 1) if pages_per_seq % d == 0)
+    num_pages = B * pages_per_seq + 1
+    key = jax.random.PRNGKey(0)
+    kp = jax.random.normal(
+        key, (num_pages, PAGE, KV_HEADS * HEAD_DIM), dtype=jnp.bfloat16)
+    vp = jax.random.normal(
+        key, (num_pages, PAGE, KV_HEADS * HEAD_DIM), dtype=jnp.bfloat16)
+    # Sequence-exclusive pages (the engine's decode contract).
+    perm = np.random.permutation(num_pages - 1) + 1
+    tables = jnp.asarray(
+        perm[:B * pages_per_seq].reshape(B, pages_per_seq), jnp.int32)
+    ctx_lens = jnp.full((B,), ctx, dtype=jnp.int32)
+    q3 = jax.random.normal(key, (B, HEADS, HEAD_DIM), dtype=jnp.bfloat16)
+    kv_bytes = 2 * B * KV_HEADS * pages_per_seq * PAGE * HEAD_DIM * 2
+
+    if args.fused:
+        kn = jax.random.normal(key, (B, KV_HEADS, HEAD_DIM),
+                               dtype=jnp.bfloat16)
+
+        def astep(c, i):
+            qq, kpp, vpp = c
+            o, kpp, vpp = paged_decode_attention(
+                qq, kpp, vpp, tables, ctx_lens, None, kn, kn,
+                scale=0.0884, pages_per_chunk=ppc)
+            return (qq + o * jnp.bfloat16(1e-30), kpp, vpp)
+        s, rtt, _ = device_bench(astep, (q3, kp, vp), donate=True)
+    else:
+        def astep(c, i):
+            qq = c
+            o = paged_decode_attention(
+                qq, kp, vp, tables, ctx_lens, None, scale=0.0884,
+                pages_per_chunk=ppc)
+            return qq + o * jnp.bfloat16(1e-30)
+        s, rtt = device_bench(astep, q3)
+    tag = "fused" if args.fused else "read-only"
+    print(f"decode_attn[{tag}] b={B} ctx={ctx} page={PAGE} ppc={ppc}: "
+          f"{s * 1e6:.1f} us/call = {s * 32 * 1e3:.2f} ms/step(32L)  "
+          f"{kv_bytes / s / 1e9:.0f} GB/s KV", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -93,7 +93,13 @@ def test_paged_decode_ref_alibi():
 def test_pallas_decode_matches_oracle(num_q_heads, num_kv_heads,
                                       pages_per_chunk):
     """The token-major kernel across GQA/MHA/head-block shapes
-    (hb = 8 for H=8/32, hb = 6 for H=12, hb = H for small H)."""
+    (hb = 8 for H=8/32, hb = 6 for H=12, hb = H for small H).
+
+    Tolerance 1e-2 across this file's pallas-vs-f32-oracle checks: the
+    kernel's dot operands are bf16 (f32 accumulation) — the same
+    numeric class as the reference CUDA kernel's half operands
+    (`kernels/attention/attention_kernels.cu`), bounded by one bf16
+    rounding (2^-8) per operand against the f32 numpy oracle."""
     q, k_pages, v_pages, bt, ctx = make_problem(num_q_heads=num_q_heads,
                                                 num_kv_heads=num_kv_heads,
                                                 dim=128, page_size=8,
@@ -106,7 +112,7 @@ def test_pallas_decode_matches_oracle(num_q_heads, num_kv_heads,
                                  scale=scale,
                                  pages_per_chunk=pages_per_chunk,
                                  interpret=True)
-    np.testing.assert_allclose(np.array(got), expected, rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.array(got), expected, rtol=1e-2, atol=1e-2)
 
 
 def test_pallas_decode_short_context():
@@ -119,7 +125,7 @@ def test_pallas_decode_short_context():
                                  jnp.array(v_pages), jnp.array(bt),
                                  jnp.array(ctx), scale=0.1,
                                  pages_per_chunk=2, interpret=True)
-    np.testing.assert_allclose(np.array(got), expected, rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.array(got), expected, rtol=1e-2, atol=1e-2)
 
 
 def test_pallas_decode_single_chunk_cross_cell():
@@ -142,8 +148,8 @@ def test_pallas_decode_single_chunk_cross_cell():
     got = np.array(got)
     np.testing.assert_allclose(got[1], 0.0, atol=1e-6)
     mask = np.arange(len(ctx)) != 1
-    np.testing.assert_allclose(got[mask], expected[mask], rtol=2e-3,
-                               atol=2e-3)
+    np.testing.assert_allclose(got[mask], expected[mask], rtol=1e-2,
+                               atol=1e-2)
 
 
 def numpy_prefill(q, k, v, context_lens, kv_valid, scale, window=None,
@@ -235,8 +241,8 @@ def test_pallas_decode_int8_kv_scale():
         jnp.array(q), jnp.array(k_int), jnp.array(v_int),
         jnp.array(bt), jnp.array(ctx), scale=scale, kv_scale=S,
         pages_per_chunk=4, interpret=True)
-    np.testing.assert_allclose(np.array(got), expected, rtol=2e-3,
-                               atol=2e-3)
+    np.testing.assert_allclose(np.array(got), expected, rtol=1e-2,
+                               atol=1e-2)
 
 
 def test_pallas_decode_alibi():
@@ -256,8 +262,8 @@ def test_pallas_decode_alibi():
                                  jnp.array(slopes),
                                  scale=scale, pages_per_chunk=4,
                                  interpret=True)
-    np.testing.assert_allclose(np.array(got), expected, rtol=2e-3,
-                               atol=2e-3)
+    np.testing.assert_allclose(np.array(got), expected, rtol=1e-2,
+                               atol=1e-2)
 
 
 @pytest.mark.parametrize("num_q_heads,num_kv_heads,pages_per_chunk", [
@@ -311,8 +317,8 @@ def test_pallas_decode_fused_write(num_q_heads, num_kv_heads,
         pages_per_chunk=pages_per_chunk, interpret=True)
     got = np.asarray(out)
     mask = ctx > 0
-    np.testing.assert_allclose(got[mask], want[mask], rtol=2e-3,
-                               atol=2e-3)
+    np.testing.assert_allclose(got[mask], want[mask], rtol=1e-2,
+                               atol=1e-2)
     np.testing.assert_allclose(got[~mask], 0.0, atol=1e-6)
     np.testing.assert_allclose(np.asarray(got_k), np.asarray(ref_k),
                                atol=1e-6)
@@ -349,8 +355,8 @@ def test_pallas_decode_fused_write_int8():
         jnp.asarray(bt), jnp.asarray(ctx), None,
         jnp.asarray(knew), jnp.asarray(vnew), scale=0.1, kv_scale=S,
         pages_per_chunk=4, interpret=True)
-    np.testing.assert_allclose(np.asarray(out), want, rtol=2e-3,
-                               atol=2e-3)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-2,
+                               atol=1e-2)
     np.testing.assert_array_equal(np.asarray(got_k), np.asarray(ref_k))
     np.testing.assert_array_equal(np.asarray(got_v), np.asarray(ref_v))
 
@@ -388,7 +394,7 @@ def test_pallas_decode_padded_head(d_true):
                                  jnp.array(ctx), scale=scale,
                                  pages_per_chunk=4, interpret=True)
     np.testing.assert_allclose(np.array(got)[..., :d_true], expected,
-                               rtol=2e-3, atol=2e-3)
+                               rtol=1e-2, atol=1e-2)
 
 
 def test_paged_attention_layer_pads_small_heads():
