@@ -315,6 +315,7 @@ class SchedulerConfig:
         max_model_len: int,
         max_paddings: int,
         multi_step: int = 1,
+        max_chunk_tokens: Optional[int] = None,
     ) -> None:
         if max_num_batched_tokens is not None:
             self.max_num_batched_tokens = max_num_batched_tokens
@@ -327,6 +328,13 @@ class SchedulerConfig:
         # Decode steps per scheduling round (>1 = device-side multi-step
         # decode with token feedback; eligibility checked per batch).
         self.multi_step = max(1, multi_step)
+        # Prefill-token cap for rounds that ALSO carry decode work
+        # (chunked prefill): bounds how long an arrival can stall the
+        # decode stream. Pure-prefill rounds (nothing running) use the
+        # full max_num_batched_tokens budget. 0 disables mixing —
+        # prompts then wait for a dedicated round like the reference.
+        self.max_chunk_tokens = max_chunk_tokens \
+            if max_chunk_tokens is not None else 2048
         self._verify_args()
 
     def _verify_args(self) -> None:

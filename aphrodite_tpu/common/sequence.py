@@ -55,12 +55,16 @@ class SequenceStatus(enum.Enum):
 class SequenceData:
     """Token ids + cumulative logprob for one sequence."""
 
-    __slots__ = ("prompt_token_ids", "output_token_ids", "cumulative_logprob")
+    __slots__ = ("prompt_token_ids", "output_token_ids",
+                 "cumulative_logprob", "num_computed_tokens")
 
     def __init__(self, prompt_token_ids: List[int]) -> None:
         self.prompt_token_ids = prompt_token_ids
         self.output_token_ids: List[int] = []
         self.cumulative_logprob = 0.0
+        # Prompt tokens whose KV is already written (chunked prefill
+        # progress). 0 = nothing prefilled; reset on recompute-preempt.
+        self.num_computed_tokens = 0
 
     def append_token_id(self, token_id: int, logprob: float) -> None:
         self.output_token_ids.append(token_id)
@@ -310,6 +314,9 @@ class SequenceGroupMetadata:
         persistent_data: Dict[int, dict],
         prefix: Optional[Prefix] = None,
         lora_request=None,
+        computed_ctx: int = 0,
+        chunk_len: Optional[int] = None,
+        is_final_chunk: bool = True,
     ) -> None:
         self.request_id = request_id
         self.is_prompt = is_prompt
@@ -319,6 +326,12 @@ class SequenceGroupMetadata:
         self.persistent_data = persistent_data
         self.prefix = prefix
         self.lora_request = lora_request
+        # Chunked prefill: `computed_ctx` tokens are already in the KV
+        # cache; this round computes `chunk_len` tokens starting there
+        # (None = the rest). Only the final chunk samples a token.
+        self.computed_ctx = computed_ctx
+        self.chunk_len = chunk_len
+        self.is_final_chunk = is_final_chunk
 
     @property
     def lora_int_id(self) -> int:

@@ -228,35 +228,54 @@ class AphroditeEngine:
     # -- the step --
 
     def step(self) -> List[RequestOutput]:
-        """One engine iteration: one new token per running seq, or — for
-        eligible decode batches with multi_step>1 — a device-side burst of
-        K tokens per seq with one host sync total (reference step
-        :754-828; the burst is the TPU answer to per-step launch/transfer
-        latency)."""
+        """One engine iteration = one scheduling round. A round carries
+        prompt chunks and/or a decode batch (chunked prefill: both ride
+        one round, reference step :754-828 runs one or the other); an
+        eligible decode batch with multi_step>1 runs as a device-side
+        burst of K tokens per seq. A combined round enqueues the prefill
+        program and the burst back-to-back and pays ONE host sync."""
         seq_group_metadata_list, scheduler_outputs = \
             self.scheduler.schedule()
 
         if scheduler_outputs.is_empty():
-            return self._process_model_outputs([], scheduler_outputs)
+            return self._process_round(None, [], scheduler_outputs)
 
-        burst, extra_cap = self._burst_steps(seq_group_metadata_list,
-                                             scheduler_outputs)
-        if burst > 1:
+        n_chunks = len(scheduler_outputs.prompt_chunks)
+        prompt_mds = seq_group_metadata_list[:n_chunks]
+        decode_mds = seq_group_metadata_list[n_chunks:]
+        burst, extra_cap = (self._burst_steps(decode_mds,
+                                              scheduler_outputs)
+                            if decode_mds else (1, None))
+
+        if prompt_mds and decode_mds:
+            prompt_output, decode_outputs = \
+                self.executor.execute_combined(
+                    prompt_mds, decode_mds,
+                    scheduler_outputs.blocks_to_swap_in,
+                    scheduler_outputs.blocks_to_swap_out,
+                    scheduler_outputs.blocks_to_copy,
+                    num_steps=burst, extra_cap=extra_cap)
+            return self._process_round(prompt_output, decode_outputs,
+                                       scheduler_outputs)
+
+        if decode_mds and burst > 1:
             outputs_list = self.executor.execute_decode_burst(
-                seq_group_metadata_list,
+                decode_mds,
                 scheduler_outputs.blocks_to_swap_in,
                 scheduler_outputs.blocks_to_swap_out,
                 scheduler_outputs.blocks_to_copy,
                 num_steps=burst, extra_cap=extra_cap)
-            return self._process_burst_outputs(outputs_list,
-                                               scheduler_outputs)
+            return self._process_round(None, outputs_list,
+                                       scheduler_outputs)
 
         output = self.executor.execute_model(
             seq_group_metadata_list,
             scheduler_outputs.blocks_to_swap_in,
             scheduler_outputs.blocks_to_swap_out,
             scheduler_outputs.blocks_to_copy)
-        return self._process_model_outputs(output, scheduler_outputs)
+        if prompt_mds:
+            return self._process_round(output, [], scheduler_outputs)
+        return self._process_round(None, [output], scheduler_outputs)
 
     def _burst_steps(self, seq_group_metadata_list,
                      scheduler_outputs):
@@ -270,7 +289,7 @@ class AphroditeEngine:
         full-logprob needs — everything the device loop can't feed back.
         """
         max_steps = self.scheduler_config.multi_step
-        if max_steps <= 1 or scheduler_outputs.prompt_run:
+        if max_steps <= 1:
             return 1, None
         if self.model_config.get_sliding_window() is not None:
             return 1, None
@@ -306,7 +325,7 @@ class AphroditeEngine:
         # Bucket to powers of two: each burst length is its own compiled
         # scan program, and compiles are expensive. Round UP when the
         # overshoot is small (overshot rows' extra tokens are dropped by
-        # _process_burst_outputs): e.g. 31 remaining runs one 32-burst
+        # _process_round): e.g. 31 remaining runs one 32-burst
         # instead of the 16+8+4+2+1 ladder of ever-worse per-step
         # rates. Round DOWN when the waste would exceed the per-burst
         # overhead (~2-3 steps' worth of device time).
@@ -319,58 +338,55 @@ class AphroditeEngine:
         # sequences' block tables and satisfy the next round's
         # reservation.
         granted = self.scheduler.reserve_decode_burst(
-            seq_group_metadata_list, want - 1, extra_cap)
+            seq_group_metadata_list, want - 1, extra_cap,
+            groups=scheduler_outputs.decode_groups)
         return 1 << ((1 + granted).bit_length() - 1), extra_cap
 
-    def _process_burst_outputs(
-            self, outputs_list: List[SamplerOutput],
+    # -- output processing (reference :550-752) --
+
+    def _process_round(
+            self, prompt_output: Optional[SamplerOutput],
+            decode_outputs_list: List[SamplerOutput],
             scheduler_outputs: SchedulerOutputs) -> List[RequestOutput]:
-        scheduled_seq_groups = scheduler_outputs.scheduled_seq_groups
-        tokens_of = {id(g): 0 for g in scheduled_seq_groups}
-        for output in outputs_list:
-            for seq_group, outputs in zip(scheduled_seq_groups, output):
+        """Apply one round's sampled tokens: final prompt chunks first
+        (mid-prompt chunks wrote KV but sample nothing), then each decode
+        step's outputs (a burst passes several)."""
+        touched: List = []
+        tokens_of = {}
+        if prompt_output:
+            for chunk, outputs in zip(scheduler_outputs.prompt_chunks,
+                                      prompt_output):
+                if not chunk.is_final:
+                    continue
+                self._process_sequence_group_outputs(chunk.group, outputs)
+                touched.append(chunk.group)
+                tokens_of[id(chunk.group)] = len(outputs.samples)
+        decode_groups = scheduler_outputs.decode_groups
+        for group in decode_groups:
+            tokens_of[id(group)] = 0
+        for output in decode_outputs_list:
+            for seq_group, outputs in zip(decode_groups, output):
                 if seq_group.is_finished():
                     continue        # burst overran this group's stop
                 self._process_sequence_group_outputs(seq_group, outputs)
-                # Burst eligibility currently means single-seq groups,
-                # but count per sample so widening it keeps stats right.
                 tokens_of[id(seq_group)] += len(outputs.samples)
-        self._record_latencies(scheduled_seq_groups,
-                               tokens_of=tokens_of)
+        touched.extend(decode_groups)
+        self._record_latencies(touched, tokens_of=tokens_of)
         self.scheduler.free_finished_seq_groups()
 
         request_outputs = [
-            RequestOutput.from_seq_group(g) for g in scheduled_seq_groups
+            RequestOutput.from_seq_group(g) for g in touched
         ]
         for seq_group in scheduler_outputs.ignored_seq_groups:
             request_outputs.append(RequestOutput.from_seq_group(seq_group))
         if self.stat_logger is not None:
+            # Reference semantics: the token sampled off a prefill
+            # counts under prompt throughput; generation counts decode
+            # rows only (K per row for a K-step burst).
             self.stat_logger.log(self._get_stats(
                 scheduler_outputs,
-                generation_tokens=sum(tokens_of.values())))
-        return request_outputs
-
-    # -- output processing (reference :550-752) --
-
-    def _process_model_outputs(
-            self, output: SamplerOutput,
-            scheduler_outputs: SchedulerOutputs) -> List[RequestOutput]:
-        scheduled_seq_groups = scheduler_outputs.scheduled_seq_groups
-        for seq_group, outputs in zip(scheduled_seq_groups, output):
-            self._process_sequence_group_outputs(seq_group, outputs)
-        self._record_latencies(scheduled_seq_groups)
-
-        self.scheduler.free_finished_seq_groups()
-
-        request_outputs: List[RequestOutput] = []
-        for seq_group in scheduled_seq_groups:
-            request_outputs.append(RequestOutput.from_seq_group(seq_group))
-        for seq_group in scheduler_outputs.ignored_seq_groups:
-            request_outputs.append(RequestOutput.from_seq_group(seq_group))
-
-        if self.stat_logger is not None:
-            self.stat_logger.log(
-                self._get_stats(scheduler_outputs))
+                generation_tokens=sum(tokens_of[id(g)]
+                                      for g in decode_groups)))
         return request_outputs
 
     def _record_latencies(self, scheduled_seq_groups,
@@ -604,20 +620,19 @@ class AphroditeEngine:
         num_prompt_tokens = 0
         num_generation_tokens = 0
         if scheduler_outputs is not None:
-            if scheduler_outputs.prompt_run:
-                num_prompt_tokens = scheduler_outputs.num_batched_tokens
-            else:
-                # A multi-step burst passes the exact count it produced.
-                num_generation_tokens = generation_tokens \
-                    if generation_tokens is not None \
-                    else scheduler_outputs.num_batched_tokens
+            num_prompt_tokens = scheduler_outputs.num_prefill_tokens
+            # A multi-step burst passes the exact count it produced.
+            num_generation_tokens = generation_tokens \
+                if generation_tokens is not None \
+                else scheduler_outputs.num_decode_tokens
 
         ttfts, self._ttft_samples = self._ttft_samples, []
         tpots, self._tpot_samples = self._tpot_samples, []
         e2es, self._e2e_samples = self._e2e_samples, []
         return Stats(
             now=now,
-            num_running=len(self.scheduler.running),
+            num_running=(len(self.scheduler.running) +
+                         len(self.scheduler.prefilling)),
             num_waiting=len(self.scheduler.waiting),
             num_swapped=len(self.scheduler.swapped),
             gpu_cache_usage=gpu_cache_usage,

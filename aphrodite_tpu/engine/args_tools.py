@@ -48,6 +48,7 @@ class EngineArgs:
     max_num_seqs: int = 256
     max_paddings: int = 256
     multi_step: int = 1
+    max_chunk_tokens: Optional[int] = None
     disable_log_stats: bool = False
     revision: Optional[str] = None
     tokenizer_revision: Optional[str] = None
@@ -119,6 +120,10 @@ class EngineArgs:
         parser.add_argument("--multi-step", type=int, default=1,
                             help="decode steps per scheduling round "
                                  "(device-side token feedback)")
+        parser.add_argument("--max-chunk-tokens", type=int, default=None,
+                            help="prefill-token cap for rounds that also "
+                                 "carry decode work (chunked prefill); "
+                                 "0 disables mixing")
         parser.add_argument("--disable-log-stats", action="store_true")
         parser.add_argument("--revision", type=str, default=None)
         parser.add_argument("--tokenizer-revision", type=str, default=None)
@@ -167,7 +172,8 @@ class EngineArgs:
         scheduler_config = SchedulerConfig(
             self.max_num_batched_tokens, self.max_num_seqs,
             model_config.max_model_len, self.max_paddings,
-            multi_step=self.multi_step)
+            multi_step=self.multi_step,
+            max_chunk_tokens=self.max_chunk_tokens)
         device_config = DeviceConfig(self.device)
         lora_config = None
         if self.enable_lora:

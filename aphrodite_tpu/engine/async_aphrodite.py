@@ -230,7 +230,11 @@ class AsyncAphrodite:
         for request_output in request_outputs:
             self._request_tracker.process_request_output(
                 request_output, verbose=self.log_requests)
-        return len(request_outputs) > 0
+        # A chunked-prefill round can legitimately emit no outputs (it
+        # only wrote prompt KV); the loop must keep stepping while any
+        # request is mid-flight, not just while outputs flow.
+        return (len(request_outputs) > 0
+                or self.engine.has_unfinished_requests())
 
     async def run_engine_loop(self) -> None:
         has_requests_in_progress = False

@@ -252,3 +252,54 @@ class TPUExecutor:
             num_steps, blocks_to_copy, extra_cap)
         self.cache_engine.kv_caches = new_caches
         return outputs
+
+    def execute_combined(
+        self,
+        prompt_metadata: List[SequenceGroupMetadata],
+        decode_metadata: List[SequenceGroupMetadata],
+        blocks_to_swap_in: Dict[int, int],
+        blocks_to_swap_out: Dict[int, int],
+        blocks_to_copy: Dict[int, List[int]],
+        num_steps: int,
+        extra_cap=None,
+    ) -> Tuple[SamplerOutput, List[SamplerOutput]]:
+        """One combined round: prompt chunks AND the decode batch. The
+        fast path enqueues the prefill program and the decode burst
+        back-to-back (the burst consumes the prefill's donated KV
+        handles, so the device serializes them) and pays ONE host sync
+        for both results — an arrival costs its prefill's device time,
+        not a dedicated scheduling round. Sampling configs off the fused
+        path (host processors, logprobs, best_of>1, burst-ineligible
+        decode) fall back to two synced steps within the round."""
+        self._pre_step(prompt_metadata + decode_metadata,
+                       blocks_to_swap_in, blocks_to_swap_out)
+        kv = self.model_runner._apply_block_copies(
+            self.cache_engine.kv_caches, blocks_to_copy)
+
+        handle = None
+        if num_steps > 1:
+            handle, kv = self.model_runner.dispatch_prompt(
+                prompt_metadata, kv)
+        if handle is not None:
+            bhandle, kv = self.model_runner.dispatch_burst(
+                decode_metadata, kv, num_steps, extra_cap)
+            self.cache_engine.kv_caches = kv
+            p_np, b_np = jax.device_get((handle.packed, bhandle.packed))
+            prompt_out = self.model_runner.finalize_step(
+                handle, np.asarray(p_np))
+            decode_outs = self.model_runner.finalize_burst(
+                bhandle, np.asarray(b_np))
+            return prompt_out, decode_outs
+
+        # Sequential fallback (two syncs): raw-logits prompt sampling
+        # and/or a burst-ineligible decode batch.
+        prompt_out, kv = self.model_runner.execute_model(
+            prompt_metadata, kv)
+        if num_steps > 1:
+            decode_outs, kv = self.model_runner.execute_decode_burst(
+                decode_metadata, kv, num_steps, extra_cap=extra_cap)
+        else:
+            out, kv = self.model_runner.execute_model(decode_metadata, kv)
+            decode_outs = [out]
+        self.cache_engine.kv_caches = kv
+        return prompt_out, decode_outs

@@ -72,6 +72,22 @@ def _pow2_bucket(value: int, lo: int = 16) -> int:
     return b
 
 
+class StepHandle:
+    """An enqueued-but-unsynced device step: the packed result is still
+    on device; `ModelRunner.finalize_step/finalize_burst` turns the
+    pulled numpy array into SamplerOutputs. Lets a combined round
+    enqueue prefill + decode burst back-to-back and sync once."""
+
+    __slots__ = ("packed", "sampling", "plan", "num_steps")
+
+    def __init__(self, packed, sampling, plan,
+                 num_steps: Optional[int] = None) -> None:
+        self.packed = packed
+        self.sampling = sampling
+        self.plan = plan
+        self.num_steps = num_steps
+
+
 class ModelRunner:
     """Drives one model replica (single chip or one SPMD mesh)."""
 
@@ -299,27 +315,39 @@ class ModelRunner:
         padded_batch = _bucket(batch, _PREFILL_BATCH_BUCKETS)
 
         prompt_lens: List[int] = []
+        ctxs: List[int] = []
         seq_groups, seq_data_map = [], {}
         use_prefix = False
         newly_computed = []
         for md in seq_group_metadata_list:
             seq_id = next(iter(md.seq_data))
             data = md.seq_data[seq_id]
-            # Chunk to compute = tokens not yet in cache (prefix cached).
-            ctx = 0
-            if md.prefix is not None:
-                if md.prefix.computed:
-                    ctx = md.prefix.get_length()
-                    use_prefix = True
-                else:
-                    # This prefill writes the prefix KV; later requests
-                    # sharing it skip recompute (reference prefix_pos).
-                    newly_computed.append(md.prefix)
-            prompt_lens.append(data.get_len() - ctx)
+            # Chunk to compute = tokens not yet in cache. The scheduler
+            # folds prefix-cache hits into computed_ctx; hand-built
+            # metadata (tests) may carry only the prefix, so honor
+            # both — clamped so at least the last token is computed
+            # (a prefix covering the whole prompt must not produce an
+            # empty chunk / out-of-range sampler row).
+            ctx = md.computed_ctx
+            if md.prefix is not None and md.prefix.computed:
+                ctx = max(ctx, md.prefix.get_length())
+            ctx = min(ctx, data.get_len() - 1)
+            end = data.get_len() if md.chunk_len is None \
+                else min(ctx + md.chunk_len, data.get_len())
+            if md.prefix is not None and not md.prefix.computed \
+                    and end >= md.prefix.get_length():
+                # This chunk finishes writing the prefix KV. Marking is
+                # DEFERRED until the step is actually dispatched (see
+                # mark_prefixes): rows later in this same batch must
+                # still compute the prefix themselves, and a bailed
+                # dispatch must not leave the pool claiming KV that was
+                # never written.
+                newly_computed.append(md.prefix)
+            use_prefix = use_prefix or ctx > 0
+            ctxs.append(ctx)
+            prompt_lens.append(end - ctx)
             seq_groups.append(([seq_id], md.sampling_params))
             seq_data_map[seq_id] = data
-        for prefix in newly_computed:
-            prefix.computed = True
 
         max_len = max(prompt_lens)
         padded_len = _pow2_bucket(max_len)
@@ -348,11 +376,9 @@ class ModelRunner:
             seq_id = next(iter(md.seq_data))
             data = md.seq_data[seq_id]
             all_tokens = data.get_token_ids()
-            ctx = 0
-            if md.prefix is not None and md.prefix.computed:
-                ctx = md.prefix.get_length()
-            chunk = all_tokens[ctx:]
-            n = len(chunk)
+            ctx = ctxs[i]
+            n = prompt_lens[i]
+            chunk = all_tokens[ctx:ctx + n]
             ids[i, :n] = chunk
             pos[i, :n] = np.arange(ctx, ctx + n)
             ctx_lens[i] = ctx
@@ -438,8 +464,17 @@ class ModelRunner:
         inputs = dict(input_ids=jnp.asarray(ids), positions=jnp.asarray(pos),
                       metadata=metadata, sel=jnp.asarray(sel),
                       num_rows=num_rows,
-                      is_prompt=True, use_prefix=use_prefix)
+                      is_prompt=True, use_prefix=use_prefix,
+                      newly_computed=newly_computed)
         return inputs, sampling
+
+    @staticmethod
+    def _mark_prefixes(inputs: dict) -> None:
+        """Flip prefixes to computed once the step writing their KV has
+        actually been enqueued (never at prepare time: a bailed dispatch
+        or a same-batch sharer must not see phantom KV)."""
+        for prefix in inputs.get("newly_computed", ()):
+            prefix.computed = True
 
     def _prepare_decode(
         self, seq_group_metadata_list: List[SequenceGroupMetadata]
@@ -597,6 +632,7 @@ class ModelRunner:
                 kv_caches, inputs["metadata"], inputs["sel"],
                 is_prompt=inputs["is_prompt"],
                 use_prefix=inputs["use_prefix"])
+            self._mark_prefixes(inputs)
             if has_processors:
                 output = self.sampler(logits[:inputs["num_rows"]],
                                       sampling)
@@ -629,6 +665,7 @@ class ModelRunner:
             is_prompt=inputs["is_prompt"],
             use_prefix=inputs["use_prefix"],
             max_best_of=plan.max_best_of, num_topk=plan.num_topk)
+        self._mark_prefixes(inputs)
         t2 = _time.perf_counter() if timing else 0.0
         packed_np = np.asarray(packed)                     # ONE sync
         t4 = _time.perf_counter() if timing else 0.0
@@ -642,6 +679,48 @@ class ModelRunner:
                   f"{(t4 - t2) * 1e3:.0f} ms, finalize "
                   f"{(t5 - t4) * 1e3:.0f} ms", flush=True)
         return output, kv_caches
+
+    def dispatch_prompt(
+        self,
+        seq_group_metadata_list: List[SequenceGroupMetadata],
+        kv_caches: List[Tuple[jax.Array, jax.Array]],
+    ) -> Tuple[Optional[StepHandle], List[Tuple[jax.Array, jax.Array]]]:
+        """Enqueue the prompt step WITHOUT syncing (fused-sampler path
+        only). Returns (None, kv_caches untouched) when the batch needs
+        the raw-logits route (host logits processors, logprobs,
+        best_of>1) — the caller falls back to synced steps."""
+        inputs, sampling = self._prepare_prompt(seq_group_metadata_list)
+        if any(p.logits_processors for _, p in sampling.seq_groups):
+            return None, kv_caches
+        plan = self.sampler.plan(sampling, pad_to=inputs["sel"].shape[0])
+        if plan.need_logprobs or plan.max_best_of != 1 or \
+                plan.num_topk != 0:
+            return None, kv_caches
+        params = self._params_with_lora(
+            seq_group_metadata_list, inputs["input_ids"].shape[0],
+            [1] * len(seq_group_metadata_list))
+        packed, kv_caches = self._step_sample_fn(
+            params, inputs["input_ids"], inputs["positions"], kv_caches,
+            inputs["metadata"], inputs["sel"], plan.tensors,
+            jnp.asarray(plan.bases), jnp.asarray(plan.salt1),
+            jnp.asarray(plan.salt2), is_prompt=True,
+            use_prefix=inputs["use_prefix"],
+            max_best_of=plan.max_best_of, num_topk=plan.num_topk)
+        self._mark_prefixes(inputs)
+        return StepHandle(packed, sampling, plan), kv_caches
+
+    def finalize_step(self, handle: StepHandle,
+                      packed_np: np.ndarray) -> SamplerOutput:
+        return self.sampler.finalize(handle.sampling, handle.plan,
+                                     packed_np, None)
+
+    def finalize_burst(self, handle: StepHandle,
+                       all_packed: np.ndarray) -> List[SamplerOutput]:
+        return [
+            self.sampler.finalize(handle.sampling, handle.plan,
+                                  all_packed[t], None)
+            for t in range(handle.num_steps)
+        ]
 
     def execute_decode_burst(
         self,
@@ -657,7 +736,31 @@ class ModelRunner:
         groups, no history-dependent sampling stages) is enforced by the
         engine."""
         kv_caches = self._apply_block_copies(kv_caches, blocks_to_copy)
+        handle, kv_caches = self.dispatch_burst(
+            seq_group_metadata_list, kv_caches, num_steps, extra_cap)
+        import os as _os
+        import time as _time
+        timing = _os.environ.get("APHRODITE_BURST_TIMING")
+        t1 = _time.perf_counter() if timing else 0.0
+        all_packed = np.asarray(handle.packed)             # ONE sync
+        t2 = _time.perf_counter() if timing else 0.0
+        outputs = self.finalize_burst(handle, all_packed)
+        if timing:
+            t3 = _time.perf_counter()
+            print(f"[burst {num_steps} steps] device+sync "
+                  f"{(t2 - t1) * 1e3:.0f} ms "
+                  f"({(t2 - t1) / num_steps * 1e3:.1f}/step), finalize "
+                  f"{(t3 - t2) * 1e3:.0f} ms", flush=True)
+        return outputs, kv_caches
 
+    def dispatch_burst(
+        self,
+        seq_group_metadata_list: List[SequenceGroupMetadata],
+        kv_caches: List[Tuple[jax.Array, jax.Array]],
+        num_steps: int,
+        extra_cap: Optional[Dict[int, int]] = None,
+    ) -> Tuple[StepHandle, List[Tuple[jax.Array, jax.Array]]]:
+        """Enqueue the K-step decode burst without syncing."""
         inputs, sampling = self._prepare_decode(seq_group_metadata_list)
         padded = inputs["input_ids"].shape[0]
         rows_per_group = [
@@ -694,28 +797,10 @@ class ModelRunner:
 
         ids, pos, meta = (inputs["input_ids"], inputs["positions"],
                           inputs["metadata"])
-        import os as _os
-        import time as _time
-        timing = _os.environ.get("APHRODITE_BURST_TIMING")
-        t0 = _time.perf_counter() if timing else 0.0
         packed, kv_caches = self._burst_scan_fn(
             params, ids, pos, kv_caches, meta, tensors, bases, salt1,
             salt2, greedy_mask, jnp.asarray(pos_cap),
             num_steps=num_steps, max_best_of=plan.max_best_of,
             num_topk=plan.num_topk)
-        t1 = _time.perf_counter() if timing else 0.0
-
-        all_packed = np.asarray(packed)                    # ONE sync
-        t2 = _time.perf_counter() if timing else 0.0
-        outputs = [
-            self.sampler.finalize(sampling, plan, all_packed[t], None)
-            for t in range(num_steps)
-        ]
-        if timing:
-            t3 = _time.perf_counter()
-            print(f"[burst {num_steps} steps] dispatch "
-                  f"{(t1 - t0) * 1e3:.0f} ms, device+sync "
-                  f"{(t2 - t1) * 1e3:.0f} ms "
-                  f"({(t2 - t1) / num_steps * 1e3:.1f}/step), finalize "
-                  f"{(t3 - t2) * 1e3:.0f} ms", flush=True)
-        return outputs, kv_caches
+        return StepHandle(packed, sampling, plan,
+                          num_steps=num_steps), kv_caches

@@ -310,6 +310,50 @@ def test_swap_preemption_under_tight_memory(tiny_model_dir):
     assert tight == plenty
 
 
+def test_chunked_prefill_matches_unchunked(tiny_model_dir):
+    """A prompt longer than the chunk budget prefills across several
+    combined rounds (KV written chunk by chunk alongside another
+    request's decode bursts) and must produce exactly the unchunked
+    greedy tokens."""
+    from aphrodite_tpu.engine.args_tools import EngineArgs
+    from aphrodite_tpu.engine.aphrodite_engine import AphroditeEngine
+
+    long_prompt = [(i * 11) % 90 + 5 for i in range(150)]
+    short_prompt = [(i * 5) % 90 + 5 for i in range(20)]
+    sp = SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True)
+
+    def run(chunk):
+        args = EngineArgs(model=tiny_model_dir, load_format="dummy",
+                          dtype="float32", block_size=16,
+                          max_model_len=512, max_num_seqs=8,
+                          swap_space=0.01, disable_log_stats=True,
+                          multi_step=4, max_chunk_tokens=chunk,
+                          skip_tokenizer_init=True)
+        engine = AphroditeEngine(*args.create_engine_configs())
+        # Short request first: its decode stream shares rounds with the
+        # long prompt's chunked prefill.
+        engine.add_request("short", None, sp,
+                           prompt_token_ids=list(short_prompt))
+        engine.step()
+        engine.add_request("long", None, sp,
+                           prompt_token_ids=list(long_prompt))
+        results = {}
+        rounds = 0
+        while engine.has_unfinished_requests():
+            rounds += 1
+            assert rounds < 100
+            for o in engine.step():
+                if o.finished:
+                    results[o.request_id] = tuple(
+                        o.outputs[0].token_ids)
+        return results
+
+    chunked = run(48)     # 150-token prompt -> 48/48/48/6-token chunks
+    whole = run(4096)     # whole prompt in one chunk
+    assert chunked == whole
+    assert set(chunked) == {"short", "long"}
+
+
 def test_long_prompt_beyond_page_bucket(tiny_model_dir):
     """Prompts longer than one table bucket (>8 pages) must prefill and
     decode (regression: _prepare_prompt clamped tables to 8 pages and

@@ -84,10 +84,14 @@ def test_token_budget_splits_prompt_batches():
     assert len(list(out.scheduled_seq_groups)) == 2
     for g in out.scheduled_seq_groups:
         append_tokens(g)
-    # Next schedule: swapped/queued prompt r2 admitted alone.
+    # Next round is COMBINED (chunked prefill): the queued prompt r2
+    # rides along with r0/r1's decode rows instead of waiting for a
+    # dedicated prompt round.
     _, out2 = sched.schedule()
-    assert out2.prompt_run
-    assert [g.request_id for g in out2.scheduled_seq_groups] == ["r2"]
+    assert [c.group.request_id for c in out2.prompt_chunks] == ["r2"]
+    assert all(c.is_final for c in out2.prompt_chunks)
+    assert [g.request_id for g in out2.decode_groups] == ["r0", "r1"]
+    assert out2.num_decode_tokens == 2
 
 
 def test_max_num_seqs_budget():
