@@ -281,14 +281,24 @@ class TPUExecutor:
             handle, kv = self.model_runner.dispatch_prompt(
                 prompt_metadata, kv)
         if handle is not None:
+            import os
+            import time
+            timing = os.environ.get("APHRODITE_BURST_TIMING")
+            t0 = time.perf_counter() if timing else 0.0
             bhandle, kv = self.model_runner.dispatch_burst(
                 decode_metadata, kv, num_steps, extra_cap)
             self.cache_engine.kv_caches = kv
             p_np, b_np = jax.device_get((handle.packed, bhandle.packed))
+            t1 = time.perf_counter() if timing else 0.0
             prompt_out = self.model_runner.finalize_step(
                 handle, np.asarray(p_np))
             decode_outs = self.model_runner.finalize_burst(
                 bhandle, np.asarray(b_np))
+            if timing:
+                print(f"[combined prompts={len(prompt_metadata)} "
+                      f"burst={num_steps}x{len(decode_metadata)}] "
+                      f"device+sync {(t1 - t0) * 1e3:.0f} ms",
+                      flush=True)
             return prompt_out, decode_outs
 
         # Sequential fallback (two syncs): raw-logits prompt sampling

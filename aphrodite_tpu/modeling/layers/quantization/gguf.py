@@ -124,6 +124,37 @@ def q6k_to_kernel(blocks: np.ndarray, out_features: int,
     return qs, d16
 
 
+def gguf_turbo() -> bool:
+    """The default GGUF execution path: requantize every ggml block
+    format at load into symmetric int8 with a scale per (128-input-row,
+    column) group and run the W8A8 int8-MXU kernel
+    (`ops/pallas/quant_matmul.gguf_w8a8_matmul`). The added
+    requantization error is bounded by 0.5 * s128 = amax/254 per
+    128-group — for 4/5/6-bit source formats that is a small fraction
+    of the format's own quantization step (their step is ~amax_32/8 to
+    ~amax_16/32 per sub-group), and tests/quantization pins both the
+    bound and end-to-end greedy parity. APHRODITE_GGUF_EXACT=1 keeps
+    the bit-exact per-format kernels (Q4_K affine / Q8_0 / Q6_K
+    grouped-int8) at round-4 throughput (0.68x reference)."""
+    import os
+    return os.environ.get("APHRODITE_GGUF_EXACT", "") in ("", "0")
+
+
+def dense_to_w8(w: np.ndarray, scale_dtype=np.float32
+                ) -> Tuple[np.ndarray, np.ndarray]:
+    """Requantize a dense [out, in] weight into the W8A8 at-rest form:
+    (qs [in, out] int8, s128 [in/128, out]) with symmetric per-group
+    absmax scales."""
+    wt = np.asarray(w, dtype=np.float32).T                # [in, out]
+    in_f, out_f = wt.shape
+    g = wt.reshape(in_f // 128, 128, out_f)
+    amax = np.abs(g).max(axis=1)                          # [in/128, out]
+    s = np.where(amax > 0, amax / 127.0, 1.0)
+    qs = np.clip(np.round(g / s[:, None, :]), -127, 127)
+    return (qs.reshape(in_f, out_f).astype(np.int8),
+            s.astype(scale_dtype))
+
+
 def dense_to_i8g(w: np.ndarray, scale_dtype=np.float32
                  ) -> Tuple[np.ndarray, np.ndarray]:
     """Requantize a dense [out, in] weight into the grouped-int8 form
@@ -151,15 +182,25 @@ class GGUFLinearMethod(LinearMethod):
 
     def create_weights(self, in_features, out_features, dtype, bias,
                        out_axis, in_axis):
-        # Dummy-init shape (bench/profiling): Q4_K-at-rest layout.
-        params = {
-            "qweight": jnp.zeros((in_features // 8, out_features),
-                                 dtype=jnp.int32),
-            "dl": jnp.zeros((in_features // 32, out_features),
-                            dtype=dtype),
-            "ml": jnp.zeros((in_features // 32, out_features),
-                            dtype=dtype),
-        }
+        # Dummy-init shape (bench/profiling): the form real loads
+        # produce — W8A8 when turbo (the default) and the group shape
+        # allows it (same guard as load_weight), else Q4_K-at-rest.
+        if gguf_turbo() and in_features % 128 == 0:
+            params = {
+                "qs8": jnp.zeros((in_features, out_features),
+                                 dtype=jnp.int8),
+                "s128": jnp.zeros((in_features // 128, out_features),
+                                  dtype=jnp.float32),
+            }
+        else:
+            params = {
+                "qweight": jnp.zeros((in_features // 8, out_features),
+                                     dtype=jnp.int32),
+                "dl": jnp.zeros((in_features // 32, out_features),
+                                dtype=dtype),
+                "ml": jnp.zeros((in_features // 32, out_features),
+                                dtype=dtype),
+            }
         if bias:
             params["bias"] = jnp.zeros((out_features,), dtype=dtype)
         return params
@@ -170,6 +211,8 @@ class GGUFLinearMethod(LinearMethod):
             "dl": P(in_axis, out_axis),
             "ml": P(in_axis, out_axis),
             "qs": P(in_axis, out_axis),
+            "qs8": P(in_axis, out_axis),
+            "s128": P(in_axis, out_axis),
             "d": P(in_axis, out_axis),
             "d16": P(in_axis, out_axis),
             "weight": P(in_axis, out_axis),
@@ -182,6 +225,11 @@ class GGUFLinearMethod(LinearMethod):
                    dtype=jnp.float32) -> jax.Array:
         """Dense [in, out] weight from whichever packed form is present
         (XLA fallback + test oracle)."""
+        if "qs8" in params:
+            rep = jnp.repeat(params["s128"].astype(jnp.float32), 128,
+                             axis=0)
+            return (params["qs8"].astype(jnp.float32) *
+                    rep).astype(dtype)
         if "qweight" in params:
             qw = params["qweight"]
             K = qw.shape[0] * 8
@@ -207,7 +255,20 @@ class GGUFLinearMethod(LinearMethod):
     def apply(self, params: Dict[str, jax.Array],
               x: jax.Array) -> jax.Array:
         lead = x.shape[:-1]
-        if "qweight" in params:
+        if "qs8" in params:
+            K, N = params["qs8"].shape
+            if jax.default_backend() == "tpu":
+                from aphrodite_tpu.ops.pallas.quant_matmul import (
+                    gguf_w8a8_matmul, gguf_w8a8_supported)
+                if gguf_w8a8_supported(K, N):
+                    y = gguf_w8a8_matmul(x.reshape(-1, K),
+                                         params["qs8"],
+                                         params["s128"])
+                    y = y.reshape(*lead, N)
+                    if "bias" in params:
+                        y = y + params["bias"]
+                    return y
+        elif "qweight" in params:
             K = params["qweight"].shape[0] * 8
             N = params["qweight"].shape[1]
             if jax.default_backend() == "tpu":
@@ -256,6 +317,16 @@ class GGUFLinearMethod(LinearMethod):
         if isinstance(hf_tensor, RawGGUF):
             out_f, in_f = hf_tensor.shape
             tname = hf_tensor.type_name
+            if gguf_turbo() and in_f % 128 == 0:
+                # Fast path: one uniform at-rest form for every block
+                # type (mixed sibling groups compose trivially), one
+                # int8-MXU kernel. See gguf_turbo for the error bound.
+                dense = _DEQUANT[tname](hf_tensor.blocks).reshape(
+                    out_f, in_f)
+                qs8, s128 = dense_to_w8(dense)
+                self.pending_rename = "qs8"
+                self.pending_sidecar = {"s128": s128}
+                return qs8
             if tname == "Q6_K":
                 # Native form IS grouped int8 (exact repack) — used
                 # both standalone and inside mixed groups.

@@ -807,6 +807,83 @@ def gguf_i8g_matmul(x: jax.Array, qs: jax.Array, d16: jax.Array, *,
     return out[:m] if padded_m != m else out
 
 
+def _gguf_w8a8_kernel(x_ref, xs_ref, qs_ref, s_ref, o_ref, acc_ref, *,
+                      k_tiles: int):
+    """W8A8 tile: int8 weight rows with a symmetric scale per
+    128-row group, int8 activations. Per group: one int8 x int8 ->
+    int32 MXU dot at full 128 depth, then the group's fp scale row
+    multiplies the int32 partials into the f32 accumulator
+    (scale-after-accumulate). No unpack, no zero point, no x column
+    permutation — the cheapest kernel in this file. This is the GGUF
+    fast path: every ggml block format requantizes into this form at
+    load (see quantization/gguf.py), replacing the per-32-row
+    dequant-to-bf16 kernels whose VPU work and 4-bit affine handling
+    held the GGUF bench row at 0.68x (PROFILE_r04 item 4)."""
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    gs = 128
+    n_groups = s_ref.shape[0]
+    for g in range(n_groups):
+        w8 = qs_ref[g * gs:(g + 1) * gs]
+        x8 = x_ref[:, g * gs:(g + 1) * gs]
+        d = jax.lax.dot_general(x8, w8, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.int32)
+        acc_ref[...] += d.astype(jnp.float32) * \
+            s_ref[g].astype(jnp.float32)
+
+    @pl.when(k == k_tiles - 1)
+    def _flush():
+        o_ref[...] = (acc_ref[...] *
+                      xs_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def gguf_w8a8_supported(in_features: int, out_features: int) -> bool:
+    return in_features % 128 == 0 and out_features % 128 == 0
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def gguf_w8a8_matmul(x: jax.Array, qs: jax.Array, s128: jax.Array, *,
+                     interpret: bool = False) -> jax.Array:
+    """y[m, N] = x[m, K] @ (int8 qs[K, N] * s128[K//128, N]) with int8
+    activations (per-row absmax scales, the same approximation as the
+    GPTQ/AWQ W4A8 bench path)."""
+    m, K = x.shape
+    N = qs.shape[1]
+    G = K // 128
+    block_k = _tile_k(K, 128)
+    block_m, block_n, padded_m = _tile_mn(m, N, jnp.bfloat16)
+    x8, xs = _quantize_activations_int8(x)
+    if padded_m != m:
+        x8 = jnp.pad(x8, ((0, padded_m - m), (0, 0)))
+        xs = jnp.pad(xs, ((0, padded_m - m), (0, 0)))
+    k_tiles = K // block_k
+    grid = (padded_m // block_m, N // block_n, k_tiles)
+    gpt = block_k // 128
+
+    out = pl.pallas_call(
+        functools.partial(_gguf_w8a8_kernel, k_tiles=k_tiles),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_m, block_k), lambda i, n, k: (i, k)),
+            pl.BlockSpec((block_m, 1), lambda i, n, k: (i, 0)),
+            pl.BlockSpec((block_k, block_n), lambda i, n, k: (k, n)),
+            pl.BlockSpec((gpt, 1, block_n), lambda i, n, k: (k, 0, n)),
+        ],
+        out_specs=pl.BlockSpec((block_m, block_n),
+                               lambda i, n, k: (i, n)),
+        out_shape=jax.ShapeDtypeStruct((padded_m, N), x.dtype),
+        scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(x8, xs, qs, s128.reshape(G, 1, N))
+    return out[:m] if padded_m != m else out
+
+
 # ---------------------------------------------- SqueezeLLM 4-bit LUT --
 
 def _sqllm_kernel(x_ref, qw_ref, lut_ref, o_ref, acc_ref, *,

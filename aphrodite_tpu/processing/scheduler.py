@@ -333,11 +333,59 @@ class Scheduler:
 
         self.waiting.extendleft(deferred)
 
+    def _waiting_backlog_at_least(self, budget: int) -> bool:
+        """True once the waiting deque holds >= budget queued prompt
+        tokens (early-exit: the deque can be thousands deep and this
+        runs every round)."""
+        pending = 0
+        for group in self.waiting:
+            for seq in group.get_seqs(status=SequenceStatus.WAITING):
+                pending += seq.get_len() - seq.data.num_computed_tokens
+                if pending >= budget:
+                    return True
+        return False
+
     def _schedule(self) -> SchedulerOutputs:
         blocks_to_swap_in: Dict[int, int] = {}
         blocks_to_swap_out: Dict[int, int] = {}
         blocks_to_copy: Dict[int, List[int]] = {}
         now = time.monotonic()
+
+        # 0. Batch-building phase: while a FULL prefill round's worth of
+        # prompt work is queued across SEVERAL waiting groups, at least
+        # as many as are running (offline batches, request floods —
+        # breadth, not one long prompt), run pure prompt rounds with the
+        # full token budget: prompts keep absolute priority exactly like
+        # the reference, and decode starts once the batch is built
+        # (decoding a partial batch while prompts trickle in costs
+        # straggler rounds at the tail — measured 7.1k -> 4.6k
+        # out-tok/s on the offline bench). A single long prompt never
+        # triggers this; it chunk-mixes with decode below (the serving
+        # regime). During a sustained flood this stalls decode in favor
+        # of goodput — the same trade the reference's prompt-priority
+        # scheduler makes.
+        if not self.swapped:
+            budget = self.scheduler_config.max_num_batched_tokens
+            if (len(self.waiting) > 1
+                    and len(self.waiting) >= len(self.running)
+                    and self._waiting_backlog_at_least(budget)):
+                chunks: List[PromptChunk] = []
+                ignored: List[SequenceGroup] = []
+                seq_lens: List[int] = []
+                self._continue_prefills(seq_lens, budget, chunks)
+                self._admit_prompts(seq_lens, budget, chunks, ignored)
+                if chunks or ignored:
+                    return SchedulerOutputs(
+                        prompt_chunks=chunks,
+                        decode_groups=[],
+                        num_prefill_tokens=(len(seq_lens) * max(seq_lens)
+                                            if seq_lens else 0),
+                        num_decode_tokens=0,
+                        blocks_to_swap_in={},
+                        blocks_to_swap_out={},
+                        blocks_to_copy={},
+                        ignored_seq_groups=ignored,
+                    )
 
         # 1. Decode batch: reserve one slot per running sequence,
         # preempting from the back of the priority order when pages run

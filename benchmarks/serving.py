@@ -75,6 +75,38 @@ async def run(args) -> dict:
             tpots.append((t1 - (first or t1)) / (n_out - 1))
         e2es.append(t1 - t0)
 
+    async def bucket_warmup() -> None:
+        """Compile the workload's bucket lattice DETERMINISTICALLY (the
+        reference captures CUDA graphs for every batch size at startup,
+        model_runner.py:654, for the same reason). Replaying the arrival
+        schedule only compiles the buckets the warmup pass's own timing
+        happens to walk; the measured pass (different service times)
+        walks others and pays ~10-20 s remote compiles mid-measurement
+        (observed as 30 s TTFT p99 tails at request rate 2.0).
+        All-at-once batches at each batch bucket cover the prefill
+        bucket x table-width x burst-length lattice for this workload
+        shape; the persistent compile cache makes later runs ~free."""
+        caps = [b for b in (1, 2, 4, 8, 12, 16, 24, 32, 48, 64, 96, 128,
+                            192, 256)
+                if b <= min(args.max_num_seqs, args.num_requests)]
+        done = 0
+
+        async def one_warm(i: int, n_out: int) -> None:
+            sp = SamplingParams(temperature=0.0, max_tokens=n_out,
+                                ignore_eos=True)
+            async for _ in engine.generate(
+                    None, sp, f"warm-{i}",
+                    prompt_token_ids=prompts[i % len(prompts)]):
+                pass
+
+        for b in caps:
+            await asyncio.gather(*[
+                one_warm(done + j, args.output_len) for j in range(b)])
+            done += b
+        # Tail burst lengths (4/2/1 appear when every row is near its
+        # stop) + the odd-length walk.
+        await asyncio.gather(*[one_warm(done + j, 13) for j in range(4)])
+
     async def drive() -> float:
         # Fresh rng per pass: warmup replays the SAME Poisson arrival
         # schedule as the measured pass, so the batch-size bucket walk
@@ -93,6 +125,8 @@ async def run(args) -> dict:
         await asyncio.gather(*tasks)
         return time.perf_counter() - t0
 
+    if int(getattr(args, "warmup", 0) or 0):
+        await bucket_warmup()
     for _ in range(int(getattr(args, "warmup", 0) or 0)):
         await drive()
         ttfts.clear()
