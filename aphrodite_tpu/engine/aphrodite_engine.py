@@ -268,6 +268,14 @@ class AphroditeEngine:
             return self._process_round(None, outputs_list,
                                        scheduler_outputs)
 
+        if prompt_mds and not scheduler_outputs.blocks_to_swap_in \
+                and not scheduler_outputs.blocks_to_swap_out \
+                and self._prompt_fast_path_ok(prompt_mds):
+            pipelined = self._pipelined_prompt_rounds(
+                prompt_mds, scheduler_outputs)
+            if pipelined is not None:
+                return pipelined
+
         output = self.executor.execute_model(
             seq_group_metadata_list,
             scheduler_outputs.blocks_to_swap_in,
@@ -276,6 +284,66 @@ class AphroditeEngine:
         if prompt_mds:
             return self._process_round(output, [], scheduler_outputs)
         return self._process_round(None, [output], scheduler_outputs)
+
+    @staticmethod
+    def _prompt_fast_path_ok(prompt_mds) -> bool:
+        """Cheap metadata-level precheck mirroring dispatch_prompt's
+        authoritative plan-based bail conditions, so raw-logits rounds
+        skip the pipelined probe instead of paying the padded batch
+        build twice."""
+        for md in prompt_mds:
+            p = md.sampling_params
+            if (p.logits_processors or p.logprobs is not None
+                    or p.prompt_logprobs is not None or p.best_of > 1):
+                return False
+        return True
+
+    def _pipelined_prompt_rounds(self, prompt_mds, scheduler_outputs):
+        """Batch-building: enqueue up to 4 consecutive pure-prefill
+        rounds (they touch disjoint fresh groups and depend on no
+        sampled token) and pay ONE sync — each avoided round saves a
+        host<->device round trip plus the inter-round host gap. Returns
+        None when the sampling config needs the synced path."""
+        handle = self.executor.dispatch_prompt_round(
+            prompt_mds, scheduler_outputs.blocks_to_copy)
+        if handle is None:
+            return None
+        rounds = [scheduler_outputs]
+        handles = [handle]
+        while len(handles) < 4:
+            nxt = self.scheduler.schedule_prompt_only()
+            if nxt is None:
+                break
+            mds2, outputs2 = nxt
+            if not mds2:
+                # Ignored-only round (over-limit prompts dropped, none
+                # admitted): no device work, but the FINISHED_IGNORED
+                # outputs must still flow to their streams.
+                rounds.append(outputs2)
+                handles.append([])
+                break
+            if not self._prompt_fast_path_ok(mds2):
+                break       # next step() serves it via the synced path
+            h2 = self.executor.dispatch_prompt_round(
+                mds2, outputs2.blocks_to_copy)
+            rounds.append(outputs2)
+            if h2 is None:
+                # Raw-logits sampling config mid-stream: run this round
+                # synced; earlier dispatches are already in flight.
+                out2, kv = self.executor.model_runner.execute_model(
+                    mds2, self.executor.cache_engine.kv_caches)
+                self.executor.cache_engine.kv_caches = kv
+                handles.append(out2)        # already finalized
+                break
+            handles.append(h2)
+        pending = [h for h in handles if hasattr(h, "packed")]
+        finalized = iter(self.executor.finalize_prompt_rounds(pending))
+        request_outputs = []
+        for outputs_i, h in zip(rounds, handles):
+            out_i = next(finalized) if hasattr(h, "packed") else h
+            request_outputs.extend(
+                self._process_round(out_i, [], outputs_i))
+        return request_outputs
 
     def _burst_steps(self, seq_group_metadata_list,
                      scheduler_outputs):

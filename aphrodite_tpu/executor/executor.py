@@ -253,6 +253,31 @@ class TPUExecutor:
         self.cache_engine.kv_caches = new_caches
         return outputs
 
+    def dispatch_prompt_round(
+        self,
+        prompt_metadata: List[SequenceGroupMetadata],
+        blocks_to_copy: Dict[int, List[int]],
+    ):
+        """Enqueue one pure-prefill round WITHOUT syncing (fast sampler
+        path only; None = caller must run the synced path). Consecutive
+        batch-building rounds chain on the donated KV handles, so the
+        device runs them back-to-back while the host schedules ahead."""
+        self._pre_step(prompt_metadata, {}, {})
+        kv = self.model_runner._apply_block_copies(
+            self.cache_engine.kv_caches, blocks_to_copy)
+        handle, kv = self.model_runner.dispatch_prompt(
+            prompt_metadata, kv)
+        self.cache_engine.kv_caches = kv
+        return handle
+
+    def finalize_prompt_rounds(self, handles):
+        """One transfer for every pending round's packed results."""
+        pulled = jax.device_get([h.packed for h in handles])
+        return [
+            self.model_runner.finalize_step(h, np.asarray(p))
+            for h, p in zip(handles, pulled)
+        ]
+
     def execute_combined(
         self,
         prompt_metadata: List[SequenceGroupMetadata],

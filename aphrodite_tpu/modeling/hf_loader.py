@@ -226,18 +226,28 @@ def hf_model_weights_iterator(
 def initialize_dummy_params(model, seed: int = 0,
                             scale: float = 1e-3) -> Dict:
     """Small random weights for profiling/benchmarks without a checkpoint
-    (reference `--load-format dummy`, `hf_downloader.py:377-391`)."""
-    # eval_shape: never materialize the zero-init tree — at 7B+ scale a
-    # concrete init_params() plus the dummy tree is 2x weights in HBM.
+    (reference `--load-format dummy`, `hf_downloader.py:377-391`).
+
+    Quantized integer payloads (packed codes, zero points, int8 rows)
+    get random bit patterns too — all-zero codes make every weight a
+    per-group constant, which degenerates accuracy-sensitive harnesses
+    (the W4A8 drift artifact measured a near-linear model). Index-like
+    integer leaves (g_idx) stay zeros: random values there would be
+    out-of-range indices, not data."""
     shapes = jax.eval_shape(model.init_params)
-    flat, treedef = jax.tree_util.tree_flatten(shapes)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(shapes)
     key = jax.random.PRNGKey(seed)
     keys = jax.random.split(key, len(flat))
     out = []
-    for k, leaf in zip(keys, flat):
+    for k, (path, leaf) in zip(keys, flat):
+        name = str(path[-1].key) if path else ""
         if jnp.issubdtype(leaf.dtype, jnp.floating):
             out.append(jax.random.uniform(k, leaf.shape, leaf.dtype,
                                           minval=-scale, maxval=scale))
+        elif name in ("qweight", "qzeros", "qs", "qs8"):
+            info = jnp.iinfo(leaf.dtype)
+            out.append(jax.random.randint(
+                k, leaf.shape, info.min, info.max, dtype=leaf.dtype))
         else:
             out.append(jnp.zeros(leaf.shape, leaf.dtype))
     return jax.tree_util.tree_unflatten(treedef, out)

@@ -345,6 +345,51 @@ class Scheduler:
                     return True
         return False
 
+    def _schedule_batch_building(self) -> Optional[SchedulerOutputs]:
+        """A pure-prefill round when the batch-building condition holds
+        (see _schedule step 0), else None. Exposed via
+        schedule_prompt_only so the engine can PIPELINE consecutive
+        builder rounds: prompt rounds touch disjoint fresh groups and
+        depend on no prior round's sampled tokens, so their device
+        programs can be enqueued back-to-back and synced once."""
+        if self.swapped or len(self.waiting) <= 1 or \
+                len(self.waiting) < len(self.running):
+            return None
+        budget = self.scheduler_config.max_num_batched_tokens
+        if not self._waiting_backlog_at_least(budget):
+            return None
+        chunks: List[PromptChunk] = []
+        ignored: List[SequenceGroup] = []
+        seq_lens: List[int] = []
+        self._continue_prefills(seq_lens, budget, chunks)
+        self._admit_prompts(seq_lens, budget, chunks, ignored)
+        if not chunks and not ignored:
+            return None
+        return SchedulerOutputs(
+            prompt_chunks=chunks,
+            decode_groups=[],
+            num_prefill_tokens=(len(seq_lens) * max(seq_lens)
+                                if seq_lens else 0),
+            num_decode_tokens=0,
+            blocks_to_swap_in={},
+            blocks_to_swap_out={},
+            blocks_to_copy={},
+            ignored_seq_groups=ignored,
+        )
+
+    def schedule_prompt_only(
+        self
+    ) -> Optional[Tuple[List[SequenceGroupMetadata], SchedulerOutputs]]:
+        """Next batch-building round, or None outside that regime."""
+        outputs = self._schedule_batch_building()
+        if outputs is None:
+            return None
+        mds = [
+            self._group_metadata(c.group, is_prompt=True, chunk=c)
+            for c in outputs.prompt_chunks
+        ]
+        return mds, outputs
+
     def _schedule(self) -> SchedulerOutputs:
         blocks_to_swap_in: Dict[int, int] = {}
         blocks_to_swap_out: Dict[int, int] = {}
@@ -364,28 +409,9 @@ class Scheduler:
         # regime). During a sustained flood this stalls decode in favor
         # of goodput — the same trade the reference's prompt-priority
         # scheduler makes.
-        if not self.swapped:
-            budget = self.scheduler_config.max_num_batched_tokens
-            if (len(self.waiting) > 1
-                    and len(self.waiting) >= len(self.running)
-                    and self._waiting_backlog_at_least(budget)):
-                chunks: List[PromptChunk] = []
-                ignored: List[SequenceGroup] = []
-                seq_lens: List[int] = []
-                self._continue_prefills(seq_lens, budget, chunks)
-                self._admit_prompts(seq_lens, budget, chunks, ignored)
-                if chunks or ignored:
-                    return SchedulerOutputs(
-                        prompt_chunks=chunks,
-                        decode_groups=[],
-                        num_prefill_tokens=(len(seq_lens) * max(seq_lens)
-                                            if seq_lens else 0),
-                        num_decode_tokens=0,
-                        blocks_to_swap_in={},
-                        blocks_to_swap_out={},
-                        blocks_to_copy={},
-                        ignored_seq_groups=ignored,
-                    )
+        builder = self._schedule_batch_building()
+        if builder is not None:
+            return builder
 
         # 1. Decode batch: reserve one slot per running sequence,
         # preempting from the back of the priority order when pages run

@@ -354,6 +354,30 @@ def test_chunked_prefill_matches_unchunked(tiny_model_dir):
     assert set(chunked) == {"short", "long"}
 
 
+def test_pipelined_builder_rounds_match_single(tiny_model_dir):
+    """Batch-building with a small prefill budget splits admissions
+    into several pure-prefill rounds that the engine dispatches
+    back-to-back with one sync; tokens must match the single-round
+    config exactly."""
+    from aphrodite_tpu.endpoints.llm import LLM
+    prompts_ids = [[(i * 13 + j * 3) % 90 + 5 for j in range(16)]
+                   for i in range(8)]
+    sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+
+    def run(budget):
+        llm = LLM(model=tiny_model_dir, load_format="dummy",
+                  dtype="float32", block_size=16, max_model_len=64,
+                  max_num_seqs=16, swap_space=0.01, multi_step=4,
+                  max_num_batched_tokens=budget,
+                  skip_tokenizer_init=True)
+        out = llm.generate(prompt_token_ids=[list(p) for p in
+                                             prompts_ids],
+                           sampling_params=sp)
+        return [tuple(o.outputs[0].token_ids) for o in out]
+
+    assert run(64) == run(2048)
+
+
 def test_long_prompt_beyond_page_bucket(tiny_model_dir):
     """Prompts longer than one table bucket (>8 pages) must prefill and
     decode (regression: _prepare_prompt clamped tables to 8 pages and
