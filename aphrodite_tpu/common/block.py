@@ -9,8 +9,6 @@ from __future__ import annotations
 
 from typing import List
 
-_BLANK_TOKEN_ID = -1
-
 
 class Device:
     GPU = 0  # retained name for parity; means "TPU HBM" here
@@ -18,38 +16,11 @@ class Device:
     CPU = 1
 
 
-class LogicalTokenBlock:
-    """A block of token ids in a sequence, host-side bookkeeping only."""
-
-    __slots__ = ("block_number", "block_size", "token_ids", "num_tokens")
-
-    def __init__(self, block_number: int, block_size: int) -> None:
-        self.block_number = block_number
-        self.block_size = block_size
-        self.token_ids: List[int] = [_BLANK_TOKEN_ID] * block_size
-        self.num_tokens = 0
-
-    def is_empty(self) -> bool:
-        return self.num_tokens == 0
-
-    def get_num_empty_slots(self) -> int:
-        return self.block_size - self.num_tokens
-
-    def is_full(self) -> bool:
-        return self.num_tokens == self.block_size
-
-    def append_tokens(self, token_ids: List[int]) -> None:
-        assert len(token_ids) <= self.get_num_empty_slots()
-        curr_idx = self.num_tokens
-        self.token_ids[curr_idx:curr_idx + len(token_ids)] = token_ids
-        self.num_tokens += len(token_ids)
-
-    def get_token_ids(self) -> List[int]:
-        return self.token_ids[:self.num_tokens]
-
-    def get_last_token_id(self) -> int:
-        assert self.num_tokens > 0
-        return self.token_ids[self.num_tokens - 1]
+# The reference also keeps a LogicalTokenBlock with per-block token-id
+# lists (`aphrodite/common/block.py:9`); here a sequence's logical
+# block structure is pure arithmetic on its token count (see
+# Sequence.logical_token_blocks), so only the physical page objects
+# need real state.
 
 
 class PhysicalTokenBlock:

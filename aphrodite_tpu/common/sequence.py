@@ -1,18 +1,21 @@
 """Sequence data model: per-request token state and scheduler metadata.
 
-Reference semantics: `aphrodite/common/sequence.py:15,52,101,233,354,395,434,
-458` (SequenceStatus/SequenceData/Sequence/SequenceGroup/
-SequenceGroupMetadata/SequenceOutput/SequenceGroupOutput/SamplerOutput).
-These are host-side Python structures; the device only ever sees the
-fixed-shape batch descriptors the executor builds from them.
+Covers the roles of the reference's `aphrodite/common/sequence.py:15,52,
+101,233,354,395,434,458` (SequenceStatus/SequenceData/Sequence/
+SequenceGroup/SequenceGroupMetadata/SequenceOutput/SequenceGroupOutput/
+SamplerOutput) with one TPU-native simplification: the reference
+maintains per-block token-id lists (`LogicalTokenBlock` append/full
+bookkeeping) because its CPU path re-reads them; here KV content only
+ever reaches the device through slot mappings built from token COUNTS,
+so a sequence's logical-block structure is pure arithmetic on its
+length and `logical_token_blocks` is a derived view.
 """
 from __future__ import annotations
 
 import copy
 import enum
-from typing import Dict, List, Optional, Union
+from typing import Dict, List, Optional
 
-from aphrodite_tpu.common.block import LogicalTokenBlock
 from aphrodite_tpu.common.prefix import Prefix
 from aphrodite_tpu.common.sampling_params import SamplingParams
 
@@ -31,25 +34,28 @@ class SequenceStatus(enum.Enum):
 
     @staticmethod
     def is_finished(status: "SequenceStatus") -> bool:
-        return status in (
-            SequenceStatus.FINISHED_STOPPED,
-            SequenceStatus.FINISHED_LENGTH_CAPPED,
-            SequenceStatus.FINISHED_ABORTED,
-            SequenceStatus.FINISHED_IGNORED,
-        )
+        return status in _FINISHED
 
     @staticmethod
     def get_finished_reason(status: "SequenceStatus") -> Optional[str]:
-        if status == SequenceStatus.FINISHED_STOPPED:
-            return "stop"
-        if status == SequenceStatus.FINISHED_LENGTH_CAPPED:
-            return "length"
-        if status == SequenceStatus.FINISHED_ABORTED:
-            return "abort"
-        if status == SequenceStatus.FINISHED_IGNORED:
-            # Ignored sequences are prompts longer than max_model_len: length.
-            return "length"
-        return None
+        return _FINISH_REASON.get(status)
+
+
+_FINISHED = frozenset({
+    SequenceStatus.FINISHED_STOPPED,
+    SequenceStatus.FINISHED_LENGTH_CAPPED,
+    SequenceStatus.FINISHED_ABORTED,
+    SequenceStatus.FINISHED_IGNORED,
+})
+
+_FINISH_REASON = {
+    SequenceStatus.FINISHED_STOPPED: "stop",
+    SequenceStatus.FINISHED_LENGTH_CAPPED: "length",
+    SequenceStatus.FINISHED_ABORTED: "abort",
+    # An ignored prompt exceeded max_model_len: report it like a
+    # length stop, matching the reference's API surface.
+    SequenceStatus.FINISHED_IGNORED: "length",
+}
 
 
 class SequenceData:
@@ -62,8 +68,8 @@ class SequenceData:
         self.prompt_token_ids = prompt_token_ids
         self.output_token_ids: List[int] = []
         self.cumulative_logprob = 0.0
-        # Prompt tokens whose KV is already written (chunked prefill
-        # progress). 0 = nothing prefilled; reset on recompute-preempt.
+        # Prompt tokens whose KV is already written (chunked-prefill
+        # progress); reset to 0 on recompute-preemption.
         self.num_computed_tokens = 0
 
     def append_token_id(self, token_id: int, logprob: float) -> None:
@@ -71,7 +77,7 @@ class SequenceData:
         self.cumulative_logprob += logprob
 
     def get_len(self) -> int:
-        return len(self.output_token_ids) + len(self.prompt_token_ids)
+        return len(self.prompt_token_ids) + len(self.output_token_ids)
 
     def get_prompt_len(self) -> int:
         return len(self.prompt_token_ids)
@@ -83,9 +89,8 @@ class SequenceData:
         return self.prompt_token_ids + self.output_token_ids
 
     def get_last_token_id(self) -> int:
-        if not self.output_token_ids:
-            return self.prompt_token_ids[-1]
-        return self.output_token_ids[-1]
+        tail = self.output_token_ids or self.prompt_token_ids
+        return tail[-1]
 
     def __repr__(self) -> str:
         return (f"SequenceData(prompt_len={len(self.prompt_token_ids)}, "
@@ -94,7 +99,7 @@ class SequenceData:
 
 
 class Sequence:
-    """One generation stream: token data, logical blocks, detok state."""
+    """One generation stream: token data, page math, detok state."""
 
     def __init__(
         self,
@@ -108,55 +113,37 @@ class Sequence:
         self.prompt = prompt
         self.block_size = block_size
         self.lora_request = lora_request
-
         self.data = SequenceData(prompt_token_ids)
-        self.output_logprobs: SampleLogprobs = []
-        self.output_text = ""
-
-        self.logical_token_blocks: List[LogicalTokenBlock] = []
-        self._append_tokens_to_blocks(prompt_token_ids)
         self.status = SequenceStatus.WAITING
 
-        # Incremental detokenization state
-        # (reference: transformers_utils/tokenizer.py:246).
+        self.output_logprobs: SampleLogprobs = []
+        self.output_text = ""
+        # Incremental detokenization cursor
+        # (transformers_utils/tokenizer.py detokenize_incrementally).
         self.prefix_offset = 0
         self.read_offset = 0
         self.tokens: Optional[List[str]] = None
 
-        # Stateful-sampler state (mirostat mu) round-trips host-side per
-        # sequence (reference: sequence.py persistent_data,
-        # sampling_metadata.py:13-28).
+        # Stateful-sampler state (mirostat mu) round-trips host-side
+        # per sequence (see sampling_metadata.PersistentMetadata).
         self.persistent_data: dict = {}
 
     @property
     def lora_int_id(self) -> int:
         return self.lora_request.lora_int_id if self.lora_request else 0
 
-    def _append_logical_block(self) -> None:
-        block = LogicalTokenBlock(
-            block_number=len(self.logical_token_blocks),
-            block_size=self.block_size,
-        )
-        self.logical_token_blocks.append(block)
-
-    def _append_tokens_to_blocks(self, token_ids: List[int]) -> None:
-        cursor = 0
-        while cursor < len(token_ids):
-            if not self.logical_token_blocks:
-                self._append_logical_block()
-            last_block = self.logical_token_blocks[-1]
-            if last_block.is_full():
-                self._append_logical_block()
-                last_block = self.logical_token_blocks[-1]
-            num_empty_slots = last_block.get_num_empty_slots()
-            last_block.append_tokens(token_ids[cursor:cursor +
-                                               num_empty_slots])
-            cursor += num_empty_slots
+    @property
+    def logical_token_blocks(self) -> range:
+        """Derived block structure: the ceil-div page count of the
+        token length. A `range` so `len()` (the only operation the
+        block manager and tests perform) stays O(1) with nothing to
+        maintain on append/fork."""
+        size = self.block_size
+        return range((self.get_len() + size - 1) // size)
 
     def append_token_id(self, token_id: int,
                         logprobs: Dict[int, float]) -> None:
         assert token_id in logprobs
-        self._append_tokens_to_blocks([token_id])
         self.output_logprobs.append(logprobs)
         self.data.append_token_id(token_id, logprobs[token_id])
 
@@ -184,25 +171,27 @@ class Sequence:
     def get_beam_search_score(self,
                               length_penalty: float = 1.0,
                               seq_len: Optional[int] = None,
-                              eos_token_id: Optional[int] = None) -> float:
+                              eos_token_id: Optional[int] = None
+                              ) -> float:
         """GNMT-style length-normalized cumulative logprob."""
         if seq_len is None:
             seq_len = self.get_len()
             if (eos_token_id is not None
                     and self.get_last_token_id() == eos_token_id):
                 seq_len -= 1
-        return self.get_cumulative_logprob() / (seq_len**length_penalty)
+        return self.get_cumulative_logprob() / (seq_len ** length_penalty)
 
     def is_finished(self) -> bool:
-        return SequenceStatus.is_finished(self.status)
+        return self.status in _FINISHED
 
     def fork(self, new_seq_id: int) -> "Sequence":
-        new_seq = copy.deepcopy(self)
-        new_seq.seq_id = new_seq_id
-        return new_seq
+        child = copy.deepcopy(self)
+        child.seq_id = new_seq_id
+        return child
 
     def __repr__(self) -> str:
-        return (f"Sequence(seq_id={self.seq_id}, status={self.status.name}, "
+        return (f"Sequence(seq_id={self.seq_id}, "
+                f"status={self.status.name}, "
                 f"num_blocks={len(self.logical_token_blocks)})")
 
 
@@ -225,48 +214,49 @@ class SequenceGroup:
         self.prefix = prefix
         self.lora_request = lora_request
         self.prompt_logprobs: Optional[PromptLogprobs] = None
-        # Latency bookkeeping (reference sequence.py RequestMetrics):
-        # stamped by the engine as tokens arrive, read by _get_stats.
+        # Latency stamps (reference RequestMetrics): written by the
+        # engine as tokens arrive, drained by _get_stats.
         self.first_token_time: Optional[float] = None
         self.last_token_time: float = arrival_time
         self.finished_time: Optional[float] = None
 
     @property
     def prompt(self) -> str:
-        return next(iter(self.seqs_dict.values())).prompt
+        return self._any_seq().prompt
 
     @property
     def prompt_token_ids(self) -> List[int]:
-        return next(iter(self.seqs_dict.values())).data.prompt_token_ids
+        return self._any_seq().data.prompt_token_ids
 
     @property
     def lora_int_id(self) -> int:
         return self.lora_request.lora_int_id if self.lora_request else 0
 
+    def _any_seq(self) -> Sequence:
+        return next(iter(self.seqs_dict.values()))
+
     def get_max_num_running_seqs(self) -> int:
-        """Max number of sequences running in parallel, now or in future."""
-        if self.sampling_params.use_beam_search:
-            return self.sampling_params.best_of
-        if self.sampling_params.best_of > self.num_seqs():
-            # Prompt stage: best_of children will fork at first step.
-            return self.sampling_params.best_of
+        """Upper bound on simultaneously-running sequences over the
+        request's remaining lifetime (the scheduler's seat count)."""
+        params = self.sampling_params
+        if params.use_beam_search or params.best_of > self.num_seqs():
+            # Beam width, or a prompt whose best_of children have not
+            # forked yet.
+            return params.best_of
         return self.num_unfinished_seqs()
 
-    def get_seqs(
-        self,
-        status: Optional[SequenceStatus] = None,
-    ) -> List[Sequence]:
+    def get_seqs(self, status: Optional[SequenceStatus] = None
+                 ) -> List[Sequence]:
+        seqs = self.seqs_dict.values()
         if status is None:
-            return list(self.seqs_dict.values())
-        return [seq for seq in self.seqs_dict.values() if seq.status == status]
+            return list(seqs)
+        return [s for s in seqs if s.status == status]
 
     def get_unfinished_seqs(self) -> List[Sequence]:
-        return [
-            seq for seq in self.seqs_dict.values() if not seq.is_finished()
-        ]
+        return [s for s in self.seqs_dict.values() if not s.is_finished()]
 
     def get_finished_seqs(self) -> List[Sequence]:
-        return [seq for seq in self.seqs_dict.values() if seq.is_finished()]
+        return [s for s in self.seqs_dict.values() if s.is_finished()]
 
     def num_seqs(self, status: Optional[SequenceStatus] = None) -> int:
         return len(self.get_seqs(status))
@@ -278,9 +268,10 @@ class SequenceGroup:
         return len(self.get_finished_seqs())
 
     def find(self, seq_id: int) -> Sequence:
-        if seq_id not in self.seqs_dict:
-            raise ValueError(f"Sequence {seq_id} not found.")
-        return self.seqs_dict[seq_id]
+        try:
+            return self.seqs_dict[seq_id]
+        except KeyError:
+            raise ValueError(f"Sequence {seq_id} not found.") from None
 
     def add(self, seq: Sequence) -> None:
         if seq.seq_id in self.seqs_dict:
@@ -288,12 +279,11 @@ class SequenceGroup:
         self.seqs_dict[seq.seq_id] = seq
 
     def remove(self, seq_id: int) -> None:
-        if seq_id not in self.seqs_dict:
+        if self.seqs_dict.pop(seq_id, None) is None:
             raise ValueError(f"Sequence {seq_id} not found.")
-        del self.seqs_dict[seq_id]
 
     def is_finished(self) -> bool:
-        return all(seq.is_finished() for seq in self.seqs_dict.values())
+        return all(s.is_finished() for s in self.seqs_dict.values())
 
     def __repr__(self) -> str:
         return (f"SequenceGroup(request_id={self.request_id}, "
@@ -302,7 +292,10 @@ class SequenceGroup:
 
 
 class SequenceGroupMetadata:
-    """Per-step scheduling metadata handed to the executor for one group."""
+    """Per-round scheduling metadata handed to the executor for one
+    group. Chunked prefill rides here: `computed_ctx` tokens are
+    already in the KV cache and this round computes `chunk_len` more
+    (None = the rest); only the final chunk samples a token."""
 
     def __init__(
         self,
@@ -326,9 +319,6 @@ class SequenceGroupMetadata:
         self.persistent_data = persistent_data
         self.prefix = prefix
         self.lora_request = lora_request
-        # Chunked prefill: `computed_ctx` tokens are already in the KV
-        # cache; this round computes `chunk_len` tokens starting there
-        # (None = the rest). Only the final chunk samples a token.
         self.computed_ctx = computed_ctx
         self.chunk_len = chunk_len
         self.is_final_chunk = is_final_chunk
@@ -342,7 +332,8 @@ class SequenceOutput:
     """One sampled token for one (parent) sequence."""
 
     def __init__(self, parent_seq_id: int, output_token: int,
-                 logprobs: Dict[int, float], persistent_data: dict) -> None:
+                 logprobs: Dict[int, float],
+                 persistent_data: dict) -> None:
         self.parent_seq_id = parent_seq_id
         self.output_token = output_token
         self.logprobs = logprobs

@@ -322,14 +322,19 @@ class AphroditeEngine:
                 rounds.append(outputs2)
                 handles.append([])
                 break
-            if not self._prompt_fast_path_ok(mds2):
-                break       # next step() serves it via the synced path
-            h2 = self.executor.dispatch_prompt_round(
-                mds2, outputs2.blocks_to_copy)
+            # schedule_prompt_only() has already committed this round's
+            # admissions (pages allocated, chunk progress advanced), so
+            # an ineligible round must still EXECUTE — synced — not be
+            # dropped: its KV writes and sampled tokens are owed.
+            h2 = None
+            if self._prompt_fast_path_ok(mds2):
+                h2 = self.executor.dispatch_prompt_round(
+                    mds2, outputs2.blocks_to_copy)
             rounds.append(outputs2)
             if h2 is None:
                 # Raw-logits sampling config mid-stream: run this round
-                # synced; earlier dispatches are already in flight.
+                # synced; earlier dispatches are already in flight and
+                # touch disjoint groups.
                 out2, kv = self.executor.model_runner.execute_model(
                     mds2, self.executor.cache_engine.kv_caches)
                 self.executor.cache_engine.kv_caches = kv

@@ -32,6 +32,7 @@ def main() -> None:
     parser.add_argument("--prompt-len", type=int, default=2000)
     parser.add_argument("--output-len", type=int, default=1024)
     parser.add_argument("--multi-step", type=int, default=32)
+    parser.add_argument("--block-size", type=int, default=16)
     parser.add_argument("--warmup", type=int, default=1)
     args = parser.parse_args()
     if args.model == "synthetic-7b":
@@ -50,7 +51,8 @@ def main() -> None:
         kv_cache_dtype=args.kv_cache_dtype,
         max_model_len=args.prompt_len + args.output_len + 16,
         max_num_seqs=1, skip_tokenizer_init=True,
-        disable_log_stats=True, multi_step=args.multi_step))
+        disable_log_stats=True, multi_step=args.multi_step,
+        block_size=args.block_size))
     vocab = engine.model_config.get_vocab_size()
     rng = np.random.RandomState(0)
     prompt = rng.randint(5, vocab - 5, size=args.prompt_len).tolist()

@@ -12,7 +12,12 @@ def make_seq(seq_id=0, prompt_len=5, block_size=4):
 def test_logical_blocks():
     seq = make_seq(prompt_len=10, block_size=4)
     assert len(seq.logical_token_blocks) == 3
-    assert seq.logical_token_blocks[-1].num_tokens == 2
+    # Derived view: the count tracks appends exactly (ceil-div), both
+    # within the tail block and across the boundary.
+    assert len(make_seq(prompt_len=8, block_size=4)
+               .logical_token_blocks) == 2
+    assert len(make_seq(prompt_len=9, block_size=4)
+               .logical_token_blocks) == 3
     seq.append_token_id(100, {100: -0.5})
     seq.append_token_id(101, {101: -0.5})
     assert len(seq.logical_token_blocks) == 3
