@@ -334,7 +334,9 @@ class AphroditeEngine:
             if h2 is None:
                 # Raw-logits sampling config mid-stream: run this round
                 # synced; earlier dispatches are already in flight and
-                # touch disjoint groups.
+                # touch disjoint groups. _pre_step still applies (LoRA
+                # adapter slots must activate for THIS round's groups).
+                self.executor._pre_step(mds2, {}, {})
                 out2, kv = self.executor.model_runner.execute_model(
                     mds2, self.executor.cache_engine.kv_caches)
                 self.executor.cache_engine.kv_caches = kv

@@ -21,7 +21,7 @@ hadamard transform extension. TPU design:
 from __future__ import annotations
 
 import math
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 import jax
@@ -249,11 +249,41 @@ class QuipLinearMethod(LinearMethod):
                 "uses for other sizes are not available. Use a "
                 "use_rand=true checkpoint (had_left/had_right ship in "
                 "the checkpoint) or power-of-two dims.")
+        from aphrodite_tpu.ops.pallas.quant_matmul import (
+            squeezellm_supported)
+        if squeezellm_supported(q_in, q_out):
+            params = {
+                # 4-bit AT REST: the E8P alphabet is only 12 distinct
+                # quarter-integer values (+-{1,3,5,7,9,11}/4), so the
+                # 2-bit codes re-encode LOSSLESSLY into 4-bit LUT codes
+                # at load and run through the fused SqueezeLLM LUT
+                # kernel (codes stay packed in HBM; 16-way select is
+                # the TPU-native form of the reference's in-kernel
+                # 256-entry gather, origin_order.cu:648-674). 2x the
+                # reference's at-rest bytes buys exact math on a
+                # kernel measured 8x its reference row.
+                "qweight": jnp.zeros((q_in // 8, q_out),
+                                     dtype=jnp.int32),
+                "lookup_table": jnp.zeros((q_out, 16),
+                                          dtype=jnp.float32),
+                "Wscale": jnp.ones((), dtype=jnp.float32),
+                "SU": jnp.ones((in_features,), dtype=dtype),
+                "SV": jnp.ones((out_features,), dtype=dtype),
+            }
+            if had_l is not None:
+                params["had_left"] = jnp.asarray(had_l,
+                                                 dtype=jnp.float32)
+            if had_r is not None:
+                params["had_right"] = jnp.asarray(had_r,
+                                                  dtype=jnp.float32)
+            if bias:
+                params["bias"] = jnp.zeros((out_features,), dtype=dtype)
+            return params
         params = {
-            # int8 AT REST: every decompressed E8P value is a quarter
-            # integer in [-32, 31.75], so value*4 is EXACTLY int8 —
-            # half the bf16 footprint with bit-identical dequant
-            # (w = int8 * 0.25), executed by the fused int8 kernel.
+            # Fallback for shapes the LUT kernel can't tile — int8 AT
+            # REST: every decompressed E8P value is a quarter integer
+            # in [-32, 31.75], so value*4 is EXACTLY int8 (w = int8 *
+            # 0.25), executed by the fused int8 kernel.
             "weight": jnp.zeros((q_in, q_out), dtype=jnp.int8),
             "Wscale": jnp.ones((), dtype=jnp.float32),
             "SU": jnp.ones((in_features,), dtype=dtype),
@@ -271,6 +301,7 @@ class QuipLinearMethod(LinearMethod):
         # QuIP layers don't shard (reference raises on TP, quip.py:91);
         # replicate.
         specs = {"weight": P(None, None), "Wscale": P(),
+                 "qweight": P(None, None), "lookup_table": P(None, None),
                  "SU": P(None), "SV": P(None)}
         for name in ("had_left", "had_right"):
             specs[name] = P(None, None)
@@ -280,8 +311,12 @@ class QuipLinearMethod(LinearMethod):
 
     def apply(self, params: Dict[str, jax.Array],
               x: jax.Array) -> jax.Array:
-        w = params["weight"]                      # [q_in, q_out]
-        q_in, q_out = w.shape
+        w = params.get("weight")                  # [q_in, q_out] or None
+        if w is not None:
+            q_in, q_out = w.shape
+        else:
+            q_in = params["qweight"].shape[0] * 8
+            q_out = params["qweight"].shape[1]
         in_features = params["SU"].shape[0]
         out_features = params["SV"].shape[0]
         had_l = params.get("had_left")
@@ -295,7 +330,25 @@ class QuipLinearMethod(LinearMethod):
         # Wscale stays a traced multiply — float(tracer) would fail
         # under jit.
         xr = xr * params["Wscale"].astype(jnp.float32)
-        if w.dtype == jnp.int8:
+        if "qweight" in params:
+            # 4-bit LUT codes at rest (see create_weights).
+            from aphrodite_tpu.ops.pallas.quant_matmul import (
+                squeezellm_matmul, squeezellm_supported)
+            qw = params["qweight"]
+            lut = params["lookup_table"]
+            if jax.default_backend() == "tpu" and \
+                    squeezellm_supported(q_in, q_out):
+                out = squeezellm_matmul(xr.astype(jnp.bfloat16), qw,
+                                        lut).astype(jnp.float32)
+            else:
+                # One copy of the packing convention: reuse the GPTQ
+                # row unpack (same 8-nibbles-along-K layout).
+                from aphrodite_tpu.modeling.layers.quantization.gptq \
+                    import _unpack_rows
+                codes = _unpack_rows(qw, 4)          # [q_in, q_out]
+                wd = lut[jnp.arange(q_out)[None, :], codes]
+                out = xr @ wd.astype(jnp.float32)
+        elif w.dtype == jnp.int8:
             # Quarter-integer codes at rest (see create_weights).
             from aphrodite_tpu.ops.pallas.quant_matmul import (
                 int8_matmul, int8_supported)
@@ -317,9 +370,51 @@ class QuipLinearMethod(LinearMethod):
     def load_weight(self, params, name: str,
                     hf_tensor: np.ndarray) -> np.ndarray:
         if name == "Qidxs" or name.endswith(".Qidxs"):
+            from aphrodite_tpu.ops.pallas.quant_matmul import (
+                squeezellm_supported)
+            q_out_ck = hf_tensor.shape[0]
+            q_in_ck = hf_tensor.shape[1] * 8
+            if squeezellm_supported(q_in_ck, q_out_ck):
+                qweight, lut = quip_codes4_from_qidxs(hf_tensor)
+                self.pending_rename = "qweight"
+                self.pending_sidecar = {"lookup_table": lut}
+                return qweight
             self.pending_rename = "weight"
             return quip_weight_from_qidxs(hf_tensor)
         return hf_tensor
+
+
+# The complete E8P decompressed alphabet: 12 quarter-integer values
+# (verified exhaustively over all 65,536 codes in tests/quantization/
+# test_quip.py). value*4 is an odd integer in [-11, 11].
+E8P_VALUES4 = np.array([-11, -9, -7, -5, -3, -1, 1, 3, 5, 7, 9, 11],
+                       dtype=np.int64)
+
+
+def quip_codes4_from_qidxs(qidxs: np.ndarray
+                           ) -> Tuple[np.ndarray, np.ndarray]:
+    """Checkpoint Qidxs [q_out, q_in/8] int16 -> the 4-bit LUT at-rest
+    form: (qweight [q_in/8, q_out] int32 — 8 nibble codes along the
+    input dim, SqueezeLLM packing — and lookup_table [q_out, 16] f32).
+    LOSSLESS: the E8P alphabet has 12 distinct values (E8P_VALUES4/4),
+    so each weight maps to a 4-bit index. 4 bits/weight at rest vs the
+    reference's 2 (its CUDA kernel gathers a 256-entry codebook in
+    shared memory per tile, origin_order.cu:648-674 — a per-lane
+    gather with no efficient TPU analog; the 16-way select has one)."""
+    dense = decompress_e8p(np.asarray(qidxs, np.int16))   # [q_out, q_in]
+    v4 = np.round(dense * 4.0).astype(np.int64)
+    codes = np.searchsorted(E8P_VALUES4, v4)
+    assert (E8P_VALUES4[codes] == v4).all(), "value outside E8P alphabet"
+    q_out, q_in = dense.shape
+    lut16 = np.zeros((16,), np.float32)
+    lut16[:12] = E8P_VALUES4.astype(np.float32) / 4.0
+    codes = codes.T.astype(np.int64)                      # [q_in, q_out]
+    c8 = codes.reshape(q_in // 8, 8, q_out)
+    qweight = np.zeros((q_in // 8, q_out), np.int32)
+    for p in range(8):
+        qweight |= (c8[:, p, :] << (4 * p)).astype(
+            np.int64).astype(np.uint32).view(np.int32)
+    return qweight, np.tile(lut16[None, :], (q_out, 1))
 
 
 def quip_weight_from_qidxs(qidxs: np.ndarray) -> np.ndarray:

@@ -352,6 +352,80 @@ def main() -> None:
         rtts.append(rtt)
         row(f"PREFILL kv_write {pb * ps} toks", s * 1e3, LAYERS, "")
 
+    # --- prefill GLUE at the bench 8k-round geometry: everything in a
+    # prompt step that is neither a quant matmul nor attention. These
+    # are the per-layer elementwise terms PROFILE_r04 left lumped as
+    # "~290 ms residual"; each is measured standalone so the PROFILE
+    # artifact can attribute the residual line by line. ---
+    if want("pglue"):
+        from aphrodite_tpu.modeling.layers.activation import silu_and_mul
+        from aphrodite_tpu.modeling.layers.layernorm import (
+            fused_add_rms_norm)
+        from aphrodite_tpu.modeling.layers.rotary_embedding import get_rope
+        from aphrodite_tpu.ops.pallas.quant_matmul import (
+            _quantize_activations_int8)
+        M8 = 8192
+        hid8 = jax.random.normal(key, (M8, HIDDEN), dtype=jnp.bfloat16)
+        wnorm = jnp.ones((HIDDEN,), jnp.bfloat16)
+
+        def nstep(c, i):
+            h, r = c
+            o, r2 = fused_add_rms_norm(h, r, wnorm, 1e-5)
+            return (h + o * jnp.bfloat16(1e-30), r2)
+        s, rtt = device_bench(nstep, (hid8, jnp.zeros_like(hid8)),
+                              slow=True)
+        rtts.append(rtt)
+        row(f"PGLUE fused_add_rms_norm m={M8}", s * 1e3, 2 * LAYERS, "")
+
+        gup = jax.random.normal(key, (M8, 2 * INTER), dtype=jnp.bfloat16)
+
+        def astep(c, i):
+            g = c
+            o = silu_and_mul(g)
+            return g + jnp.pad(o, ((0, 0), (0, INTER))) * \
+                jnp.bfloat16(1e-30)
+        s, rtt = device_bench(astep, gup, slow=True)
+        rtts.append(rtt)
+        row(f"PGLUE silu_and_mul m={M8}", s * 1e3, LAYERS, "")
+
+        rope = get_rope(HEAD_DIM, HEAD_DIM, 4096, 10000.0)
+        # Same shape llama.py hands rope: heads split out.
+        q8 = jax.random.normal(key, (256, 32, HEADS, HEAD_DIM),
+                               dtype=jnp.bfloat16)
+        k8 = jax.random.normal(key, (256, 32, KV_HEADS, HEAD_DIM),
+                               dtype=jnp.bfloat16)
+        pos8 = jnp.tile(jnp.arange(32, dtype=jnp.int32)[None], (256, 1))
+
+        def rstep(c, i):
+            qq, kk = c
+            q2, k2 = rope(pos8, qq, kk)
+            return (qq + q2 * jnp.bfloat16(1e-30),
+                    kk + k2 * jnp.bfloat16(1e-30))
+        s, rtt = device_bench(rstep, (q8, k8), slow=True)
+        rtts.append(rtt)
+        row(f"PGLUE rope 256x32", s * 1e3, LAYERS, "")
+
+        def qstep(c, i):
+            h = c
+            x8, xs = _quantize_activations_int8(h)
+            return h + (x8[:, :1] * xs[:, :1]).astype(jnp.bfloat16) * \
+                jnp.bfloat16(1e-30)
+        s, rtt = device_bench(qstep, hid8, slow=True)
+        rtts.append(rtt)
+        # 4 matmuls quantize per layer (qkv/o/gate_up/down inputs).
+        row(f"PGLUE act int8 quant m={M8}", s * 1e3, 4 * LAYERS, "")
+
+        def permstep(c, i):
+            # The same blockwise [R, pack] transpose _gptq_prologue
+            # applies to x per 128-group (gs=128 -> R=16, pack=8).
+            h = c
+            xp = h.reshape(M8, HIDDEN // 128, 16, 8).swapaxes(
+                2, 3).reshape(M8, HIDDEN)
+            return h + xp * jnp.bfloat16(1e-30)
+        s, rtt = device_bench(permstep, hid8, slow=True)
+        rtts.append(rtt)
+        row(f"PGLUE x plane-permute m={M8}", s * 1e3, 4 * LAYERS, "")
+
     # --- one full decoder layer (GPTQ), as the engine runs it ---
     if want("layer"):
         from types import SimpleNamespace
@@ -527,6 +601,8 @@ def main() -> None:
             initialize_dummy_params)
         from aphrodite_tpu.modeling.input_metadata import InputMetadata
 
+        import aphrodite_tpu.modeling.models.llama as LM
+
         cfg2 = _NS2(
             architectures=["LlamaForCausalLM"], vocab_size=VOCAB,
             hidden_size=HIDDEN, intermediate_size=INTER,
@@ -534,90 +610,114 @@ def main() -> None:
             num_key_value_heads=KV_HEADS, rms_norm_eps=1e-5,
             rope_theta=10000.0, max_position_embeddings=4096,
             tie_word_embeddings=False, hidden_act="silu")
-        pmodel = LlamaForCausalLM(
-            cfg2, dtype=jnp.bfloat16,
-            linear_method=GPTQConfig(4, GROUP).get_linear_method())
-        pparams = initialize_dummy_params(pmodel, seed=0)
         # Bench prefill geometry: 256 seqs x 32 tokens (8192 tokens, 2
         # pages/seq), page-aligned -> the whole-page writer engages.
         PB, PS = 256, 32
         ppp = PS // PAGE
         npg2 = PB * ppp + 1
-        kv2 = [
-            (jnp.zeros((npg2, PAGE, KV_HEADS * HEAD_DIM), jnp.bfloat16),
-             jnp.zeros((npg2, PAGE, KV_HEADS * HEAD_DIM), jnp.bfloat16))
-            for _ in range(LAYERS)
-        ]
-        tbl2 = jnp.asarray(
-            np.arange(PB * ppp).reshape(PB, ppp), jnp.int32)
         cells = PB * ppp
-        pmeta = InputMetadata(
-            slot_mapping=jnp.asarray(np.arange(PB * PS), jnp.int32),
-            block_tables=tbl2,
-            context_lens=jnp.zeros((PB,), jnp.int32),
-            prompt_lens=jnp.full((PB,), PS, jnp.int32),
-            prefill_cells=(
-                jnp.asarray(np.arange(cells), jnp.int32),
-                jnp.asarray(np.arange(cells), jnp.int32),
-                jnp.full((cells,), PAGE, jnp.int32)),
-            is_prompt=True)
-        pids = jnp.ones((PB, PS), jnp.int32)
-        ppos = jnp.tile(jnp.arange(PS, dtype=jnp.int32)[None], (PB, 1))
 
-        def prompt_step(c, t):
-            ids, pos, meta, kv, prm = c
-            hidden, kv = pmodel(prm, ids, pos, kv, meta)
-            flat = hidden.reshape(-1, hidden.shape[-1])
-            sel = jnp.arange(PB, dtype=jnp.int32) * PS + (PS - 1)
-            logits = pmodel.compute_logits(
-                prm, jnp.take(flat, sel, axis=0))
-            ids = jnp.maximum(
-                ids, (logits[:, :1] * 0).astype(jnp.int32))
-            return (ids, pos, meta, kv, prm)
+        def fresh_meta():
+            return InputMetadata(
+                slot_mapping=jnp.asarray(np.arange(PB * PS), jnp.int32),
+                block_tables=jnp.asarray(
+                    np.arange(PB * ppp).reshape(PB, ppp), jnp.int32),
+                context_lens=jnp.zeros((PB,), jnp.int32),
+                prompt_lens=jnp.full((PB,), PS, jnp.int32),
+                prefill_cells=(
+                    jnp.asarray(np.arange(cells), jnp.int32),
+                    jnp.asarray(np.arange(cells), jnp.int32),
+                    jnp.full((cells,), PAGE, jnp.int32)),
+                is_prompt=True)
 
-        s, rtt, _ = device_bench(
-            prompt_step, (pids, ppos, pmeta, kv2, pparams), slow=True,
-            donate=True)
-        rtts.append(rtt)
-        row(f"PROMPT step {PB}x{PS} (8k tok, 32L)", s * 1e3, 1, "")
+        def measure_pstep(label, patch=None, with_kv=True):
+            """One whole prompt step, optionally with a glue op patched
+            out of the MODEL (fresh build so rope factories re-run).
+            full - ablated = the op's true IN-CONTEXT cost, fusion and
+            all — the standalone pglue rows overestimate ops XLA fuses
+            into their consumers."""
+            saved = {}
+            for name, fn in (patch or {}).items():
+                saved[name] = getattr(LM, name)
+                setattr(LM, name, fn)
+            try:
+                pmodel = LM.LlamaForCausalLM(
+                    cfg2, dtype=jnp.bfloat16,
+                    linear_method=GPTQConfig(4, GROUP)
+                    .get_linear_method())
+                prm = initialize_dummy_params(pmodel, seed=0)
+                kv = [
+                    (jnp.zeros((npg2, PAGE, KV_HEADS * HEAD_DIM),
+                               jnp.bfloat16),
+                     jnp.zeros((npg2, PAGE, KV_HEADS * HEAD_DIM),
+                               jnp.bfloat16))
+                    for _ in range(LAYERS)
+                ] if with_kv else None
+                pids = jnp.ones((PB, PS), jnp.int32)
+                ppos = jnp.tile(
+                    jnp.arange(PS, dtype=jnp.int32)[None], (PB, 1))
 
-        # Cache-less ablation: same prompt step with kv_caches=None
-        # (no page writes at all) — the delta is the whole-page
-        # writer's true in-model cost.
-        def prompt_step_nokv(c, t):
-            ids, pos, meta, prm = c
-            hidden, _ = pmodel(prm, ids, pos, None, meta)
-            flat = hidden.reshape(-1, hidden.shape[-1])
-            sel = jnp.arange(PB, dtype=jnp.int32) * PS + (PS - 1)
-            logits = pmodel.compute_logits(
-                prm, jnp.take(flat, sel, axis=0))
-            ids = jnp.maximum(
-                ids, (logits[:, :1] * 0).astype(jnp.int32))
-            return (ids, pos, meta, prm)
+                def prompt_step(c, t):
+                    ids, pos, meta, kvs, prm2 = c
+                    hidden, kvs = pmodel(prm2, ids, pos, kvs, meta)
+                    flat = hidden.reshape(-1, hidden.shape[-1])
+                    sel = jnp.arange(PB, dtype=jnp.int32) * PS + (PS - 1)
+                    logits = pmodel.compute_logits(
+                        prm2, jnp.take(flat, sel, axis=0))
+                    ids = jnp.maximum(
+                        ids, (logits[:, :1] * 0).astype(jnp.int32))
+                    return (ids, pos, meta, kvs, prm2)
 
-        # Fresh small inputs: the first measurement DONATED (consumed)
-        # its carry; params survive only because the nokv carry drops
-        # kv2 — rebuild the rest. (pparams was consumed too: rebuild.)
-        pparams2 = initialize_dummy_params(pmodel, seed=0)
-        pmeta2 = pmeta  # pytree of small arrays; rebuild leaves
-        pmeta2 = InputMetadata(
-            slot_mapping=jnp.asarray(np.arange(PB * PS), jnp.int32),
-            block_tables=jnp.asarray(
-                np.arange(PB * ppp).reshape(PB, ppp), jnp.int32),
-            context_lens=jnp.zeros((PB,), jnp.int32),
-            prompt_lens=jnp.full((PB,), PS, jnp.int32),
-            prefill_cells=(
-                jnp.asarray(np.arange(cells), jnp.int32),
-                jnp.asarray(np.arange(cells), jnp.int32),
-                jnp.full((cells,), PAGE, jnp.int32)),
-            is_prompt=True)
-        s, rtt, _ = device_bench(
-            prompt_step_nokv,
-            (jnp.ones((PB, PS), jnp.int32),
-             jnp.tile(jnp.arange(PS, dtype=jnp.int32)[None], (PB, 1)),
-             pmeta2, pparams2), slow=True, donate=True)
-        rtts.append(rtt)
-        row(f"PROMPT step {PB}x{PS} NO-KV-write", s * 1e3, 1, "")
+                s, rtt, _ = device_bench(
+                    prompt_step, (pids, ppos, fresh_meta(), kv, prm),
+                    slow=True, donate=True)
+                rtts.append(rtt)
+                row(f"PROMPT step {label}", s * 1e3, 1, "")
+                return s
+            finally:
+                for name, fn in saved.items():
+                    setattr(LM, name, fn)
+
+        class _IdentityRope:
+            def __call__(self, positions, q, k):
+                return q, k
+
+        # Each variant costs ~2 min (model build + two trip-count
+        # compiles of the full 8k step); APHRODITE_PSTEP variants=
+        # comma list selects a subset so runs fit the shell timeout.
+        wanted = os.environ.get(
+            "APHRODITE_PSTEP", "full,nokv,nosilu,nonorm,norope").split(",")
+        if "full" in wanted:
+            measure_pstep(f"{PB}x{PS} (8k tok, 32L)")
+        if "nokv" in wanted:
+            measure_pstep(f"{PB}x{PS} NO-KV-write", with_kv=False)
+        if "nosilu" in wanted:
+            measure_pstep(
+                f"{PB}x{PS} no-silu",
+                patch={"silu_and_mul":
+                       lambda x: x[..., :x.shape[-1] // 2]})
+        if "nonorm" in wanted:
+            measure_pstep(
+                f"{PB}x{PS} no-norm",
+                patch={"fused_add_rms_norm":
+                       lambda h, r, w, eps:
+                       (h, h if r is None else h + r),
+                       "rms_norm": lambda x, w, eps: x})
+        if "norope" in wanted:
+            measure_pstep(
+                f"{PB}x{PS} no-rope",
+                patch={"get_rope": lambda *a, **k: _IdentityRope()})
+        if "noattn" in wanted:
+            class _NoAttention:
+                def __init__(self, *a, **k):
+                    pass
+
+                def __call__(self, q, k, v, k_pages, v_pages, meta):
+                    # Keeps shapes: q is already [b, s, H*d].
+                    return q, k_pages, v_pages
+            measure_pstep(
+                f"{PB}x{PS} no-attention(+write)",
+                patch={"PagedAttention": _NoAttention})
 
     # --- elementwise glue: rmsnorm x2 + silu_and_mul per layer ---
     if want("glue"):

@@ -182,6 +182,62 @@ def test_quip_rejects_non_pow2_without_rand():
                               out_axis=None, in_axis=None)
 
 
+def test_e8p_alphabet_is_12_values():
+    """The 4-bit at-rest re-encoding is lossless iff the decompressed
+    alphabet is exactly E8P_VALUES4/4 — verified over ALL 65,536
+    possible int16 codes."""
+    from aphrodite_tpu.modeling.layers.quantization.quip import (
+        E8P_VALUES4, decompress_e8p)
+    codes = np.arange(65536, dtype=np.uint16).astype(np.int16)
+    dense = decompress_e8p(codes.reshape(256, 256))
+    vals = np.unique(np.round(dense * 4).astype(np.int64))
+    assert set(vals.tolist()) == set(E8P_VALUES4.tolist())
+
+
+def test_quip_codes4_roundtrip_exact():
+    """4-bit LUT form reconstructs the decompressed weights exactly."""
+    from aphrodite_tpu.modeling.layers.quantization.quip import (
+        decompress_e8p, quip_codes4_from_qidxs)
+    rs2 = np.random.RandomState(5)
+    q_out, q_in = 128, 256
+    qidxs = rs2.randint(-2 ** 15, 2 ** 15, (q_out, q_in // 8),
+                        dtype=np.int64).astype(np.int16)
+    dense = decompress_e8p(qidxs)                 # [q_out, q_in]
+    qweight, lut = quip_codes4_from_qidxs(qidxs)
+    shifts = np.arange(8, dtype=np.uint32) * 4
+    codes = ((qweight.astype(np.uint32)[:, None, :] >>
+              shifts[None, :, None]) & 0xF).reshape(q_in, q_out)
+    w = lut[np.arange(q_out)[None, :], codes]     # [q_in, q_out]
+    np.testing.assert_allclose(w, dense.T, atol=0, rtol=0)
+
+
+def test_quip_4bit_apply_matches_int8_path():
+    """The LUT-form forward equals the int8-at-rest forward (both are
+    exact representations of the same decompressed weights)."""
+    from aphrodite_tpu.modeling.layers.quantization.quip import (
+        QuipConfig, quip_codes4_from_qidxs, quip_weight_from_qidxs)
+    rs2 = np.random.RandomState(6)
+    q_in = q_out = 256
+    method = QuipConfig().get_linear_method()
+    qidxs = rs2.randint(-2 ** 15, 2 ** 15, (q_out, q_in // 8),
+                        dtype=np.int64).astype(np.int16)
+    base = {
+        "Wscale": jnp.asarray(0.7, jnp.float32),
+        "SU": jnp.asarray(rs2.choice([-1.0, 1.0], q_in),
+                          jnp.float32),
+        "SV": jnp.asarray(rs2.choice([-1.0, 1.0], q_out),
+                          jnp.float32),
+    }
+    x = jnp.asarray(rs2.randn(3, q_in).astype(np.float32) * 0.1)
+    qweight, lut = quip_codes4_from_qidxs(qidxs)
+    p4 = dict(base, qweight=jnp.asarray(qweight),
+              lookup_table=jnp.asarray(lut))
+    p8 = dict(base, weight=jnp.asarray(quip_weight_from_qidxs(qidxs)))
+    y4 = np.asarray(method.apply(p4, x))
+    y8 = np.asarray(method.apply(p8, x))
+    np.testing.assert_allclose(y4, y8, rtol=2e-3, atol=2e-3)
+
+
 def test_quip_registered():
     from aphrodite_tpu.modeling.layers.quantization import (
         get_quantization_config_cls)
