@@ -338,7 +338,11 @@ class QuipLinearMethod(LinearMethod):
             lut = params["lookup_table"]
             if jax.default_backend() == "tpu" and \
                     squeezellm_supported(q_in, q_out):
-                out = squeezellm_matmul(xr.astype(jnp.bfloat16), qw,
+                # x stays f32 (the kernel dots in x's dtype): the int8
+                # path this replaces also fed f32 activations, and all
+                # 12 LUT values are exactly representable — the whole
+                # path stays numerically identical to dense dequant.
+                out = squeezellm_matmul(xr, qw,
                                         lut).astype(jnp.float32)
             else:
                 # One copy of the packing convention: reuse the GPTQ
