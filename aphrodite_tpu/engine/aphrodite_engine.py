@@ -287,14 +287,22 @@ class AphroditeEngine:
 
     @staticmethod
     def _prompt_fast_path_ok(prompt_mds) -> bool:
-        """Cheap metadata-level precheck mirroring dispatch_prompt's
-        authoritative plan-based bail conditions, so raw-logits rounds
-        skip the pipelined probe instead of paying the padded batch
-        build twice."""
+        """Cheap metadata-level precheck mirroring EVERY one of
+        dispatch_prompt's authoritative plan-based bail conditions
+        (logits processors, need_logprobs, max_best_of != 1,
+        num_topk != 0), so rounds the dispatch would bail on skip the
+        pipelined probe instead of paying the padded batch build
+        twice."""
         for md in prompt_mds:
             p = md.sampling_params
-            if (p.logits_processors or p.logprobs is not None
-                    or p.prompt_logprobs is not None or p.best_of > 1):
+            if (p.logits_processors or p.use_beam_search
+                    or p.prompt_logprobs is not None or p.best_of > 1
+                    # plan.num_topk mirror: the fused program pulls
+                    # top-k logprob rows whenever any row requests
+                    # >= 1 logprobs; logprobs=0 keeps num_topk at 0
+                    # and stays on the fast path (the sampled token's
+                    # own logprob always rides in the packed result).
+                    or (p.logprobs or 0) > 0):
                 return False
         return True
 
@@ -333,13 +341,14 @@ class AphroditeEngine:
             rounds.append(outputs2)
             if h2 is None:
                 # Raw-logits sampling config mid-stream: run this round
-                # synced; earlier dispatches are already in flight and
-                # touch disjoint groups. _pre_step still applies (LoRA
-                # adapter slots must activate for THIS round's groups).
-                self.executor._pre_step(mds2, {}, {})
-                out2, kv = self.executor.model_runner.execute_model(
-                    mds2, self.executor.cache_engine.kv_caches)
-                self.executor.cache_engine.kv_caches = kv
+                # synced THROUGH THE EXECUTOR (prompt-only rounds carry
+                # no swaps, but outputs2's CoW copy plan and the LoRA
+                # adapter activation must still apply — a direct
+                # model_runner call silently dropped blocks_to_copy);
+                # earlier dispatches are already in flight and touch
+                # disjoint groups.
+                out2 = self.executor.execute_model(
+                    mds2, {}, {}, outputs2.blocks_to_copy)
                 handles.append(out2)        # already finalized
                 break
             handles.append(h2)

@@ -327,11 +327,17 @@ class ModelRunner:
             # metadata (tests) may carry only the prefix, so honor
             # both — clamped so at least the last token is computed
             # (a prefix covering the whole prompt must not produce an
-            # empty chunk / out-of-range sampler row).
+            # empty chunk / out-of-range sampler row). The clamp is
+            # PAGE-ALIGNED, mirroring the scheduler's: a full-prefix
+            # hit recomputes its last prefix page (identical KV,
+            # idempotent) rather than start the chunk mid-page, which
+            # would fail the prefill_cells ctx % page gate below and
+            # disable whole-page KV writes for the entire round.
             ctx = md.computed_ctx
             if md.prefix is not None and md.prefix.computed:
                 ctx = max(ctx, md.prefix.get_length())
-            ctx = min(ctx, data.get_len() - 1)
+            ctx = min(ctx, (data.get_len() - 1) // self.page_size *
+                      self.page_size)
             end = data.get_len() if md.chunk_len is None \
                 else min(ctx + md.chunk_len, data.get_len())
             if md.prefix is not None and not md.prefix.computed \

@@ -454,16 +454,19 @@ def gguf_weights_iterator(path: str, at_rest: bool = False
             pass
 
     def group_mode(name: str, frag: str):
-        """How this tensor's merged bucket executes: 'native' (uniform
-        at-rest type), 'i8g' (mixed-but-all-quantized -> shared
-        grouped-int8), or None (dense fallback)."""
+        """(mode, mixed) for this tensor's merged bucket: mode 'native'
+        (uniform at-rest type), 'i8g' (all-quantized -> shared
+        grouped-int8), or None (dense fallback); `mixed` is True only
+        when the siblings actually DISAGREE on type — a uniform
+        non-native group (e.g. all-Q4_0, all-Q6_K) is not mixed and
+        keeps its per-format routing in the linear method."""
         sibs = _STACKED_SIBLINGS.get(frag, (frag,))
         types = {type_of.get(name.replace(frag, s)) for s in sibs}
         if len(types) == 1 and types <= set(_NATIVE_PACKED):
-            return "native"
+            return "native", False
         if types <= set(_DEQUANT):     # incl. uniform Q6_K
-            return "i8g"
-        return None
+            return "i8g", len(types) > 1
+        return None, False
 
     for info in reader.tensors:
         try:
@@ -474,9 +477,10 @@ def gguf_weights_iterator(path: str, at_rest: bool = False
             logger.debug("Skipping GGUF tensor %s", info.name)
             continue
         tname, block, bpb = GGML_TYPES[info.ggml_type]
-        mode = group_mode(name, frag) \
+        mode, mixed = group_mode(name, frag) \
             if (frag := next((f for f in _PROJ_FRAGMENTS
-                              if f".{f}." in name), None)) else None
+                              if f".{f}." in name), None)) \
+            else (None, False)
         if (at_rest and tname in _DEQUANT and
                 len(info.shape) == 2 and mode is not None):
             with open(reader.path, "rb") as f:
@@ -491,7 +495,7 @@ def gguf_weights_iterator(path: str, at_rest: bool = False
                 blocks = _permute_raw_rows(blocks, out_f, in_f, block,
                                            n_kv)
             yield name, RawGGUF(tname, blocks, (out_f, in_f),
-                                compat=(mode == "i8g"))
+                                compat=mixed)
             continue
         arr = reader.load(info)
         if name.endswith("self_attn.q_proj.weight") and n_heads:

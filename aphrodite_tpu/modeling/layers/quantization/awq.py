@@ -121,7 +121,9 @@ class AWQLinearMethod(LinearMethod):
             if awq_supported(in_features, n_packed * 8, cfg.group_size):
                 # APHRODITE_W4A8: int8 activations into the MXU int8
                 # mode — same opt-in/accuracy story as the GPTQ path
-                # (AWQ is always 4-bit, so no bits gate needed).
+                # (AWQ is always 4-bit, so no bits gate needed). The a8
+                # kernel auto-selects classic vs deferred-rescale per
+                # shape; APHRODITE_QMM_DEFERRED pins it for A/B runs.
                 mm = awq_matmul_a8 if os.environ.get(
                     "APHRODITE_W4A8") == "1" else awq_matmul
                 y = mm(x.reshape(-1, in_features), qw,

@@ -125,17 +125,25 @@ def q6k_to_kernel(blocks: np.ndarray, out_features: int,
 
 
 def gguf_turbo() -> bool:
-    """The default GGUF execution path: requantize every ggml block
-    format at load into symmetric int8 with a scale per (128-input-row,
-    column) group and run the W8A8 int8-MXU kernel
+    """The default GGUF execution path for LOSSY source formats:
+    requantize the ggml blocks at load into symmetric int8 with a scale
+    per (128-input-row, column) group and run the W8A8 int8-MXU kernel
     (`ops/pallas/quant_matmul.gguf_w8a8_matmul`). The added
     requantization error is bounded by 0.5 * s128 = amax/254 per
-    128-group — for 4/5/6-bit source formats that is a small fraction
-    of the format's own quantization step (their step is ~amax_32/8 to
+    128-group — for 4/5-bit source formats that is a small fraction of
+    the format's own quantization step (their step is ~amax_32/8 to
     ~amax_16/32 per sub-group), and tests/quantization pins both the
-    bound and end-to-end greedy parity. APHRODITE_GGUF_EXACT=1 keeps
-    the bit-exact per-format kernels (Q4_K affine / Q8_0 / Q6_K
-    grouped-int8) at round-4 throughput (0.68x reference)."""
+    bound and end-to-end greedy parity.
+
+    Q8_0 and Q6_K are EXCLUDED from the turbo requantization: their
+    codes already sit exactly on the int8 grid (native exact kernels —
+    Q8_0 per-32 scales, Q6_K grouped-int8), so re-gridding them onto
+    per-128 scales would ADD error for zero bandwidth win (both forms
+    read int8 + scale rows). They keep their bit-exact paths even with
+    turbo on; members of MIXED sibling groups unify on the exact
+    grouped-int8 form instead (see load_weight). APHRODITE_GGUF_EXACT=1
+    keeps the bit-exact per-format kernels for every format (Q4_K
+    affine rows at round-4 throughput, 0.68x reference)."""
     import os
     return os.environ.get("APHRODITE_GGUF_EXACT", "") in ("", "0")
 
@@ -182,9 +190,12 @@ class GGUFLinearMethod(LinearMethod):
 
     def create_weights(self, in_features, out_features, dtype, bias,
                        out_axis, in_axis):
-        # Dummy-init shape (bench/profiling): the form real loads
-        # produce — W8A8 when turbo (the default) and the group shape
-        # allows it (same guard as load_weight), else Q4_K-at-rest.
+        # Dummy-init shape (bench/profiling): the form real loads of a
+        # LOSSY-format checkpoint produce — W8A8 when turbo (the
+        # default) and the group shape allows it, else Q4_K-at-rest.
+        # (Real loads build buckets from scratch per tensor format —
+        # Q8_0/Q6_K keep exact int8 forms even under turbo — so these
+        # shapes only ever serve dummy weights.)
         if gguf_turbo() and in_features % 128 == 0:
             params = {
                 "qs8": jnp.zeros((in_features, out_features),
@@ -317,27 +328,17 @@ class GGUFLinearMethod(LinearMethod):
         if isinstance(hf_tensor, RawGGUF):
             out_f, in_f = hf_tensor.shape
             tname = hf_tensor.type_name
-            if gguf_turbo() and in_f % 128 == 0:
-                # Fast path: one uniform at-rest form for every block
-                # type (mixed sibling groups compose trivially), one
-                # int8-MXU kernel. See gguf_turbo for the error bound.
-                dense = _DEQUANT[tname](hf_tensor.blocks).reshape(
-                    out_f, in_f)
-                qs8, s128 = dense_to_w8(dense)
-                self.pending_rename = "qs8"
-                self.pending_sidecar = {"s128": s128}
-                return qs8
-            if tname == "Q6_K":
-                # Native form IS grouped int8 (exact repack) — used
-                # both standalone and inside mixed groups.
-                qs, d16 = q6k_to_kernel(hf_tensor.blocks, out_f, in_f)
-                self.pending_rename = "qs"
-                self.pending_sidecar = {"d16": d16}
-                return qs
             if hf_tensor.compat:
-                # Member of a mixed sibling group: unify on grouped
-                # int8 so the merged bucket has one representation.
-                if tname == "Q8_0":
+                # Member of a MIXED sibling group: unify on grouped
+                # int8 so the merged bucket has one representation —
+                # EXACT for the native-int8 formats (Q8_0/Q6_K), a
+                # <=0.4% requantization for the rest. Checked before
+                # turbo so a mixed bucket never splits across forms
+                # and its native-int8 members stay bit-exact.
+                if tname == "Q6_K":
+                    qs, d16 = q6k_to_kernel(hf_tensor.blocks, out_f,
+                                            in_f)
+                elif tname == "Q8_0":
                     qs, d = q8_0_to_kernel(hf_tensor.blocks, out_f,
                                            in_f)
                     d16 = np.repeat(d, 2, axis=0)      # exact
@@ -345,6 +346,24 @@ class GGUFLinearMethod(LinearMethod):
                     dense = _DEQUANT[tname](hf_tensor.blocks).reshape(
                         out_f, in_f)
                     qs, d16 = dense_to_i8g(dense)
+                self.pending_rename = "qs"
+                self.pending_sidecar = {"d16": d16}
+                return qs
+            if gguf_turbo() and in_f % 128 == 0 and \
+                    tname not in ("Q8_0", "Q6_K"):
+                # Fast path for the lossy source formats: one at-rest
+                # form, one int8-MXU kernel. Q8_0/Q6_K are excluded —
+                # they land on the int8 grid exactly via their native
+                # kernels below (see gguf_turbo).
+                dense = _DEQUANT[tname](hf_tensor.blocks).reshape(
+                    out_f, in_f)
+                qs8, s128 = dense_to_w8(dense)
+                self.pending_rename = "qs8"
+                self.pending_sidecar = {"s128": s128}
+                return qs8
+            if tname == "Q6_K":
+                # Native form IS grouped int8 (exact repack).
+                qs, d16 = q6k_to_kernel(hf_tensor.blocks, out_f, in_f)
                 self.pending_rename = "qs"
                 self.pending_sidecar = {"d16": d16}
                 return qs
@@ -359,9 +378,14 @@ class GGUFLinearMethod(LinearMethod):
                 self.pending_rename = "qs"
                 self.pending_sidecar = {"d": d}
                 return qs
-            raise ValueError(
-                f"RawGGUF type {tname} reached the "
-                "linear method; the iterator should dequantize it")
+            # Uniform non-native lossy format (e.g. all-Q4_0 qkv) with
+            # turbo off or an unaligned in_f: shared grouped-int8.
+            dense = _DEQUANT[tname](hf_tensor.blocks).reshape(out_f,
+                                                              in_f)
+            qs, d16 = dense_to_i8g(dense)
+            self.pending_rename = "qs"
+            self.pending_sidecar = {"d16": d16}
+            return qs
         # Dense (load-time-dequantized or fp) tensor: HF [out, in].
         if name == "weight":
             return np.ascontiguousarray(np.asarray(hf_tensor).T)

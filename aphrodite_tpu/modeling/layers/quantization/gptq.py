@@ -138,7 +138,11 @@ class GPTQLinearMethod(LinearMethod):
             # is the only approximation). Off by default — numerics are
             # no longer bit-identical to the W4A16 path. 4-bit only:
             # 8-bit codes minus their zero point span [-256, 254] and
-            # would wrap on the kernel's int8 cast.
+            # would wrap on the kernel's int8 cast. The a8 kernel
+            # auto-selects between the classic and the deferred-rescale
+            # (int32 group accumulator) variants per shape;
+            # APHRODITE_QMM_DEFERRED=1/0 pins it for A/B runs (see the
+            # quant_matmul module docstring).
             mm = gptq_matmul_a8 if (
                 os.environ.get("APHRODITE_W4A8") == "1" and
                 cfg.weight_bits == 4) else gptq_matmul

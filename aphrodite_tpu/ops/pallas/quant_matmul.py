@@ -30,6 +30,20 @@ natively; an in-kernel natural-order unpack would be the same
 per-element shuffle disaster the GPTQ docstring describes).
 Reference: `kernels/quantization/awq/gemm_kernels.cu:1-667` fuses
 dequant into a grouped GEMM the same way.
+
+W4A8 deferred rescale (PROFILE_r05 item 1): the classic W4A8 kernels
+interleave each group's depth-`gs` int8 MXU dot with a
+[block_m, block_n] f32 scale-FMA on the VPU, which gates the MXU at
+~45% of its int8 microbench peak. The `*_a8` wrappers therefore carry
+a second kernel variant that lands every group's int32 dot in its OWN
+VMEM accumulator plane and applies all the scale rows ONCE, batched,
+at k-tile flush. A/B flag: `APHRODITE_QMM_DEFERRED=1/0` forces the
+deferred/classic path; unset, the default is autotune-by-shape
+(deferred for m > 64, classic for small-m decode where 2048-deep
+k-tiles matter more), with an automatic fallback to the classic path
+when the extra int32 planes don't fit the VMEM budget
+(`APHRODITE_QMM_DEFERRED_VMEM_MB`, default 8). The profile harness's
+`--only ab` mode measures both variants at the bench geometries.
 """
 from __future__ import annotations
 
@@ -41,6 +55,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+# jax 0.4.x names the TPU compiler-params dataclass TPUCompilerParams;
+# 0.5+ renames it CompilerParams. Resolve once so every kernel in this
+# file (including the CPU interpret path the tier-1 tests run) works
+# against either.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    pltpu.TPUCompilerParams
 
 
 def _unpack_planes(q: jax.Array, bits: int) -> jax.Array:
@@ -76,7 +97,8 @@ def plane_permutation(K: int, block_k: int, bits: int) -> np.ndarray:
 
 
 
-def _tile_mn(m: int, N: int, dtype, min_bn: int = 128):
+def _tile_mn(m: int, N: int, dtype, min_bn: int = 128,
+             acc_planes: int = 1):
     """Shared M/N tile sizing for the dequant-matmul kernels:
     (block_m, block_n, padded_m), honoring the APHRODITE_QMM_BLOCK_M/N
     env knobs (A/B-tuned in round 2). min_bn is the kernel's smallest
@@ -87,15 +109,22 @@ def _tile_mn(m: int, N: int, dtype, min_bn: int = 128):
     and the ~5 us/cell fixed cost dominates (LATENCY_r03's 12.7 tok/s
     at bs=1 was mostly this); the remedy is DEEPER k tiles (_tile_k
     caps block_k at 1024 for every m — matmuls 77 -> 12 ms/step at
-    m=16, round 4) while block_n stays capped at 2048."""
+    m=16, round 4) while block_n stays capped at 2048.
+
+    `acc_planes > 1` is the deferred-rescale W4A8 budget: the kernel
+    holds that many EXTRA int32 accumulator planes in VMEM, so the
+    default m/n caps halve (256 x 1024) to pay for them."""
     sublane = 16 if dtype == jnp.bfloat16 else 8
-    bm_cap = int(os.environ.get("APHRODITE_QMM_BLOCK_M", "512"))
+    bm_default = "512" if acc_planes <= 1 else "256"
+    bm_cap = int(os.environ.get("APHRODITE_QMM_BLOCK_M", bm_default))
     bm_cap = max(sublane, bm_cap // sublane * sublane)
     block_m = min(bm_cap, -(-m // sublane) * sublane)
     # Full-width lane tiles at every m: the round-2 A/B that capped
     # large-batch tiles at 1024 predates the W4A8 kernels (int8 tiles
     # take half the VMEM); re-measured round 4 at 2048 = +2% bench.
-    bn_cap = int(os.environ.get("APHRODITE_QMM_BLOCK_N", "0")) or 2048
+    bn_default = 2048 if acc_planes <= 1 else 1024
+    bn_cap = int(os.environ.get("APHRODITE_QMM_BLOCK_N", "0")) or \
+        bn_default
     block_n = max((bn for bn in (2048, 1024, 512, 256, 128)
                    if N % bn == 0), default=0)
     if block_n < min_bn:
@@ -120,6 +149,37 @@ def _tile_k(K: int, gs: int, cap: int = 0) -> int:
     while block_k < cap and K % (block_k * 2) == 0:
         block_k *= 2
     return block_k
+
+
+# Deferred-rescale W4A8 selection (see the module docstring). The k
+# tile caps at 512 so the int32 plane count stays at <= 4 for gs=128.
+_DEFERRED_K_CAP = 512
+
+
+def _resolve_deferred(deferred, m: int) -> bool:
+    """A/B selector for the deferred-rescale W4A8 kernels. An explicit
+    `deferred` (the profile harness's A/B hook) wins; then the
+    APHRODITE_QMM_DEFERRED env flag; the default is autotune-by-shape:
+    deferred at batch/prefill geometries (m > 64) where the per-group
+    scale FMAs gate the MXU, classic at small-m decode where the
+    2048-deep k-tiles' grid-cell savings dominate (LATENCY_r05)."""
+    if deferred is not None:
+        return bool(deferred)
+    env = os.environ.get("APHRODITE_QMM_DEFERRED", "")
+    if env in ("0", "1"):
+        return env == "1"
+    return m > 64
+
+
+def _deferred_fits(block_m: int, block_n: int, gpt: int) -> bool:
+    """Whether the deferred path's accumulators (gpt int32 planes plus
+    the f32 plane) fit the scoped-VMEM budget next to the streamed
+    x/weight/zero/scale blocks; outside it the wrappers silently fall
+    back to the classic kernel."""
+    budget_mb = int(os.environ.get("APHRODITE_QMM_DEFERRED_VMEM_MB",
+                                   "8"))
+    return (gpt * 4 + 4) * block_m * block_n <= budget_mb << 20
+
 
 def _kernel(x_ref, qw_ref, z_ref, s_ref, o_ref, acc_ref, *,
             bits: int, k_tiles: int, group_size: int):
@@ -168,7 +228,7 @@ def gptq_supported(in_features: int, out_features: int, bits: int,
 
 
 def _gptq_prologue(x, qzeros, scales, N: int, bits: int, gs: int,
-                   tile_dtype, k_cap: int = 0):
+                   tile_dtype, k_cap: int = 0, acc_planes: int = 1):
     """Shared GPTQ wrapper prologue (one copy of the layout logic for
     the W4A16 and W4A8 kernels): plane-permute and pad x, unpack the
     zero points (+1, AutoGPTQ convention), lift scales to the [G, 1, N]
@@ -181,7 +241,8 @@ def _gptq_prologue(x, qzeros, scales, N: int, bits: int, gs: int,
     # are small, so spend VMEM on big tiles — block_k spans several
     # quant groups (the kernels dequant each group chunk separately).
     block_k = _tile_k(K, gs, cap=k_cap)
-    block_m, block_n, padded_m = _tile_mn(m, N, tile_dtype)
+    block_m, block_n, padded_m = _tile_mn(m, N, tile_dtype,
+                                          acc_planes=acc_planes)
     # Plane-order unpack (see _unpack_planes): permute x's columns to
     # match — per GROUP, since the kernels unpack each group chunk
     # separately. The permutation is exactly a blockwise [R, pack]
@@ -244,7 +305,7 @@ def gptq_matmul(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
                                lambda i, n, k: (i, n)),
         out_shape=jax.ShapeDtypeStruct((padded_m, N), x.dtype),
         scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(x, qweight, z_all, scales3)
@@ -385,7 +446,7 @@ def awq_matmul(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
                                lambda i, n, k: (i, n)),
         out_shape=jax.ShapeDtypeStruct((padded_m, N), x.dtype),
         scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(x, qweight, z_pm, s_pm)
@@ -428,38 +489,95 @@ def _awq_a8_kernel(x_ref, xs_ref, qw_ref, z_ref, s_ref, o_ref,
                       xs_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
 
 
+def _awq_a8_deferred_kernel(x_ref, xs_ref, qw_ref, z_ref, s_ref, o_ref,
+                            acc_ref, g32_ref, *, k_tiles: int,
+                            group_size: int):
+    """Deferred-rescale W4A8 AWQ tile: the lane-plane unpack of
+    `_awq_a8_kernel` with the `_gptq_a8_deferred_kernel` accumulation
+    scheme — per-group int32 planes, all scale rows applied once at
+    k-tile flush."""
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    gs = group_size
+    n_groups = z_ref.shape[0]
+    qw = qw_ref[...]                                  # [bk, bn/8] int32
+    planes = [
+        jax.lax.bitwise_and(jax.lax.shift_right_logical(qw, 4 * p), 0xF)
+        for p in range(8)
+    ]
+    w_pm = jax.lax.concatenate(planes, 1)             # [bk, bn] int32
+    for g in range(n_groups):
+        w8 = (w_pm[g * gs:(g + 1) * gs] - z_ref[g]).astype(jnp.int8)
+        x8 = x_ref[:, g * gs:(g + 1) * gs]
+        g32_ref[g] = jax.lax.dot_general(
+            x8, w8, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+    acc_ref[...] += jnp.sum(
+        g32_ref[...].astype(jnp.float32) *
+        s_ref[...].astype(jnp.float32), axis=0)
+
+    @pl.when(k == k_tiles - 1)
+    def _flush():
+        o_ref[...] = (acc_ref[...] *
+                      xs_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
+
+
 @functools.partial(jax.jit,
-                   static_argnames=("group_size", "interpret"))
+                   static_argnames=("group_size", "interpret",
+                                    "deferred"))
 def awq_matmul_a8(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
                   scales: jax.Array, *, group_size: int,
-                  interpret: bool = False) -> jax.Array:
+                  interpret: bool = False,
+                  deferred=None) -> jax.Array:
     """W4A8 AWQ: per-row int8 activation quantization feeding integer
     dots (see awq_matmul for the layout story; only the dequant->dot
-    arithmetic differs)."""
+    arithmetic differs). `deferred` selects the rescale-at-flush
+    kernel — same contract as gptq_matmul_a8."""
     m, K = x.shape
     N = qweight.shape[1] * 8
     gs = group_size
     G = K // gs
 
-    x8, xs = _quantize_activations_int8(x)
+    use_def = _resolve_deferred(deferred, m)
+    k_cap = _DEFERRED_K_CAP if use_def else 0
+    block_k = _tile_k(K, gs, cap=k_cap)
+    groups_per_tile = block_k // gs
+    block_m, block_n, padded_m = _tile_mn(
+        m, N, jnp.bfloat16, min_bn=1024,
+        acc_planes=groups_per_tile if use_def else 1)
+    if use_def and not _deferred_fits(block_m, block_n,
+                                      groups_per_tile):
+        use_def = False
+        block_k = _tile_k(K, gs)
+        groups_per_tile = block_k // gs
+        block_m, block_n, padded_m = _tile_mn(m, N, jnp.bfloat16,
+                                              min_bn=1024)
 
-    block_k = _tile_k(K, gs)
-    block_m, block_n, padded_m = _tile_mn(m, N, jnp.bfloat16,
-                                          min_bn=1024)
+    x8, xs = _quantize_activations_int8(x)
     if padded_m != m:
         x8 = jnp.pad(x8, ((0, padded_m - m), (0, 0)))
         xs = jnp.pad(xs, ((0, padded_m - m), (0, 0)))
 
     k_tiles = K // block_k
-    groups_per_tile = block_k // gs
     n_tiles = N // block_n
     grid = (padded_m // block_m, n_tiles, k_tiles)
     z_pm, s_pm, order = _awq_zs_plane_major(qzeros, scales, N,
                                             n_tiles, block_n, G)
 
+    kernel = functools.partial(
+        _awq_a8_deferred_kernel if use_def else _awq_a8_kernel,
+        k_tiles=k_tiles, group_size=gs)
+    scratch = [pltpu.VMEM((block_m, block_n), jnp.float32)]
+    if use_def:
+        scratch.append(
+            pltpu.VMEM((groups_per_tile, block_m, block_n), jnp.int32))
+
     out_pm = pl.pallas_call(
-        functools.partial(_awq_a8_kernel, k_tiles=k_tiles,
-                          group_size=gs),
+        kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((block_m, block_k), lambda i, n, k: (i, k)),
@@ -474,8 +592,8 @@ def awq_matmul_a8(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
         out_specs=pl.BlockSpec((block_m, block_n),
                                lambda i, n, k: (i, n)),
         out_shape=jax.ShapeDtypeStruct((padded_m, N), x.dtype),
-        scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        scratch_shapes=scratch,
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(x8, xs, qweight, z_pm, s_pm)
@@ -572,7 +690,7 @@ def gguf_q4k_matmul(x: jax.Array, qweight: jax.Array, dl: jax.Array,
                                lambda i, n, k: (i, n)),
         out_shape=jax.ShapeDtypeStruct((padded_m, N), x.dtype),
         scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(x, qweight, dl.reshape(G, 1, N), ml.reshape(G, 1, N))
@@ -636,7 +754,7 @@ def gguf_q8_matmul(x: jax.Array, qs: jax.Array, d: jax.Array, *,
                                lambda i, n, k: (i, n)),
         out_shape=jax.ShapeDtypeStruct((padded_m, N), x.dtype),
         scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(x, qs, d.reshape(G, 1, N))
@@ -680,44 +798,120 @@ def _gptq_a8_kernel(x_ref, xs_ref, qw_ref, z_ref, s_ref, o_ref,
                       xs_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
 
 
+def _gptq_a8_deferred_kernel(x_ref, xs_ref, qw_ref, z_ref, s_ref, o_ref,
+                             acc_ref, g32_ref, *, bits: int,
+                             k_tiles: int, group_size: int):
+    """Deferred-rescale W4A8 tile (PROFILE_r05 item 1): each group's
+    int8 x int8 dot lands in its OWN int32 VMEM accumulator plane, and
+    the per-group scale rows multiply the int32 partials ONCE, batched,
+    at k-tile flush — the MXU issues its depth-`gs` dots back-to-back
+    instead of waiting on a [block_m, block_n] f32 scale-FMA between
+    every dot (the VPU stall that held the classic `_gptq_a8_kernel`
+    at ~45% of the int8 MXU microbench peak). Costs `groups_per_tile`
+    extra int32 planes of VMEM (~4x accumulator footprint at block_k
+    512), which `_tile_mn(acc_planes=...)` pays for with smaller m/n
+    tiles; same integer arithmetic, same results up to f32 summation
+    order."""
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    pack = 32 // bits
+    gs = group_size
+    rows_per_group = gs // pack
+    n_groups = z_ref.shape[0]
+    # Phase 1 — MXU: unpack + exact integer dots only; nothing touches
+    # the f32 accumulator between groups.
+    for g in range(n_groups):
+        q = _unpack_planes(
+            qw_ref[g * rows_per_group:(g + 1) * rows_per_group], bits)
+        w8 = (q - z_ref[g]).astype(jnp.int8)          # exact: |w|<=2^bits
+        x8 = x_ref[:, g * gs:(g + 1) * gs]
+        g32_ref[g] = jax.lax.dot_general(
+            x8, w8, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+    # Phase 2 — one batched rescale at tile flush: [gpt, bm, bn] int32
+    # planes times the [gpt, 1, bn] scale rows, summed over the group
+    # axis into the f32 accumulator.
+    acc_ref[...] += jnp.sum(
+        g32_ref[...].astype(jnp.float32) *
+        s_ref[...].astype(jnp.float32), axis=0)
+
+    @pl.when(k == k_tiles - 1)
+    def _flush():
+        o_ref[...] = (acc_ref[...] *
+                      xs_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
+
+
 @functools.partial(jax.jit,
-                   static_argnames=("bits", "group_size", "interpret"))
+                   static_argnames=("bits", "group_size", "interpret",
+                                    "deferred"))
 def gptq_matmul_a8(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
                    scales: jax.Array, *, bits: int, group_size: int,
-                   interpret: bool = False) -> jax.Array:
+                   interpret: bool = False,
+                   deferred=None) -> jax.Array:
     """W4A8 variant of gptq_matmul: activations quantize to int8 with a
     per-row scale (absmax) in the XLA prologue, weights stay int4 at
     rest, and the kernel runs integer dots per quantization group. The
     only approximation vs the W4A16 kernel is the activation rounding
     (~0.4% per element, averaging out over the K contraction) —
-    opt-in via APHRODITE_W4A8 (see GPTQLinearMethod.apply)."""
+    opt-in via APHRODITE_W4A8 (see GPTQLinearMethod.apply).
+
+    `deferred` selects the int32-group-accumulator rescale-at-flush
+    kernel (None = APHRODITE_QMM_DEFERRED env, else autotune by shape
+    — see `_resolve_deferred`); both variants compute the same
+    integer dots and differ only in f32 summation order."""
     m, K = x.shape
     N = qweight.shape[1]
     gs = group_size if group_size != -1 else K
     pack = 32 // bits
 
+    use_def = _resolve_deferred(deferred, m)
+    if use_def:
+        # Pre-size the deferred tiles so the VMEM-fit fallback is
+        # decided before the (single) prologue call.
+        bk = _tile_k(K, gs, cap=_DEFERRED_K_CAP)
+        gpt = bk // gs
+        bm, bn, _ = _tile_mn(m, N, jnp.bfloat16, acc_planes=gpt)
+        if not _deferred_fits(bm, bn, gpt):
+            use_def = False
+
     # Row scales are permutation-invariant, so quantize before the
     # shared prologue's column permute.
     x8, xs = _quantize_activations_int8(x)
 
-    # Small-m decode is grid-cell-count bound (the whole weight streams
-    # once per step regardless of m): 2048-deep k-tiles halve the cell
-    # count and measured bs=1 96.9 -> 100.8 tok/s end-to-end. The a8
-    # kernel never materializes the full bf16 tile, so (unlike the
-    # W4A16 kernel, whose 2048-deep tile exceeds the 16 MB scoped VMEM
-    # limit) the deep tile is legal; batch shapes keep 1024 (round-4
-    # A/B winner there).
-    k_cap = 2048 if m <= 64 else 0
+    # Classic path: small-m decode is grid-cell-count bound (the whole
+    # weight streams once per step regardless of m): 2048-deep k-tiles
+    # halve the cell count and measured bs=1 96.9 -> 100.8 tok/s
+    # end-to-end. The a8 kernel never materializes the full bf16 tile,
+    # so (unlike the W4A16 kernel, whose 2048-deep tile exceeds the
+    # 16 MB scoped VMEM limit) the deep tile is legal; batch shapes
+    # keep 1024 (round-4 A/B winner there). Deferred path: 512-deep
+    # tiles keep the int32 plane count at groups_per_tile <= 4.
+    if use_def:
+        k_cap = _DEFERRED_K_CAP
+    else:
+        k_cap = 2048 if m <= 64 else 0
     x8, z_all, scales3, tiles = _gptq_prologue(
-        x8, qzeros, scales, N, bits, gs, jnp.bfloat16, k_cap=k_cap)
+        x8, qzeros, scales, N, bits, gs, jnp.bfloat16, k_cap=k_cap,
+        acc_planes=(bk // gs) if use_def else 1)
     (block_m, block_n, block_k, padded_m, grid,
      groups_per_tile, k_tiles) = tiles
     if padded_m != m:
         xs = jnp.pad(xs, ((0, padded_m - m), (0, 0)))
 
+    kernel = functools.partial(
+        _gptq_a8_deferred_kernel if use_def else _gptq_a8_kernel,
+        bits=bits, k_tiles=k_tiles, group_size=gs)
+    scratch = [pltpu.VMEM((block_m, block_n), jnp.float32)]
+    if use_def:
+        scratch.append(
+            pltpu.VMEM((groups_per_tile, block_m, block_n), jnp.int32))
+
     out = pl.pallas_call(
-        functools.partial(_gptq_a8_kernel, bits=bits, k_tiles=k_tiles,
-                          group_size=gs),
+        kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((block_m, block_k), lambda i, n, k: (i, k)),
@@ -732,8 +926,8 @@ def gptq_matmul_a8(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
         out_specs=pl.BlockSpec((block_m, block_n),
                                lambda i, n, k: (i, n)),
         out_shape=jax.ShapeDtypeStruct((padded_m, N), x.dtype),
-        scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        scratch_shapes=scratch,
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(x8, xs, qweight, z_all, scales3)
@@ -808,7 +1002,7 @@ def gguf_i8g_matmul(x: jax.Array, qs: jax.Array, d16: jax.Array, *,
                                lambda i, n, k: (i, n)),
         out_shape=jax.ShapeDtypeStruct((padded_m, N), x.dtype),
         scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(x, qs, d16.reshape(G, 1, N))
@@ -885,7 +1079,7 @@ def gguf_w8a8_matmul(x: jax.Array, qs: jax.Array, s128: jax.Array, *,
                                lambda i, n, k: (i, n)),
         out_shape=jax.ShapeDtypeStruct((padded_m, N), x.dtype),
         scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(x8, xs, qs, s128.reshape(G, 1, N))
@@ -961,7 +1155,7 @@ def squeezellm_matmul(x: jax.Array, qweight: jax.Array,
                                lambda i, n, k: (i, n)),
         out_shape=jax.ShapeDtypeStruct((padded_m, N), x.dtype),
         scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(x, qweight, lookup_table.T)
@@ -1022,7 +1216,7 @@ def int8_matmul(x: jax.Array, weight: jax.Array, scales: jax.Array, *,
                                lambda i, n, k: (i, n)),
         out_shape=jax.ShapeDtypeStruct((padded_m, N), x.dtype),
         scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(x, weight, scales.reshape(1, N))

@@ -103,7 +103,11 @@ def ring_attention_shard(q: jax.Array, k: jax.Array, v: jax.Array,
         # an unvarying init would type-mismatch the loop carry.
         if hasattr(jax.lax, "pcast"):
             return jax.lax.pcast(x, axis_name, to="varying")
-        return jax.lax.pvary(x, (axis_name,))
+        if hasattr(jax.lax, "pvary"):
+            return jax.lax.pvary(x, (axis_name,))
+        # jax 0.4.x: no varying-axis types in shard_map — the carry
+        # needs no annotation there.
+        return x
 
     init = _varying(
         (jnp.full((b, hkv, group, sq), _NEG_INF, jnp.float32),

@@ -293,8 +293,16 @@ class Scheduler:
             if group.prefix is not None and group.prefix.computed:
                 # Prefix-cached tokens are already in the KV pool; the
                 # chunk walk starts after them (at least the last token
-                # must be computed to sample from it).
-                ctx = min(group.prefix.get_length(), prompt_len - 1)
+                # must be computed to sample from it). The clamp is
+                # PAGE-ALIGNED: a full-prefix hit recomputes its last
+                # prefix page (identical KV, idempotent) instead of
+                # starting the chunk mid-page — one misaligned row
+                # disables the whole-page prefill KV writer for the
+                # ENTIRE round (model_runner gates prefill_cells on
+                # every row's ctx % page_size == 0).
+                ps = self.cache_config.block_size
+                ctx = min(group.prefix.get_length(),
+                          (prompt_len - 1) // ps * ps)
             remaining = prompt_len - ctx
             n = self._fit_chunk(remaining, seq_lens, budget)
             if n <= 0:
@@ -505,6 +513,15 @@ class Scheduler:
             self._continue_prefills(seq_lens, budget, chunks)
             if not preempted and not self.swapped:
                 self._admit_prompts(seq_lens, budget, chunks, ignored)
+        elif self.prefilling:
+            # max_chunk_tokens == 0 disables chunk-mixing for NEW
+            # prompts, but a group mid-prefill (admitted by a
+            # batch-building round, which always runs the full budget)
+            # already holds its FULL page allocation — if it never
+            # advances while decode rows exist it starves holding its
+            # pages indefinitely. Keep draining in-flight prefills at
+            # the full budget; admission stays disabled.
+            self._continue_prefills(seq_lens, full, chunks)
 
         num_prefill_tokens = (len(seq_lens) * max(seq_lens)
                               if seq_lens else 0)

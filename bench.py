@@ -1,7 +1,13 @@
 """Throughput benchmark: offline continuous-batching generation.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": <median>, "samples": [...], "n_runs": 3,
+   "unit": ..., "vs_baseline": N}
+
+`value` is the MEDIAN of `n_runs` (default 3) timed runs, each with
+the GC-disable discipline; the per-run samples ride along so driver
+captures and self-measured numbers stop disagreeing over single-run
+jitter.
 
 Baseline: the reference's peak batched output throughput for Mistral-7B
 fp16 on RTX 4090 is 5489.3 out-tok/s (reference README.md:59; BASELINE.md).
@@ -224,21 +230,32 @@ def main() -> None:
     _run(engine, sp, rng_tokens, steps)
     _log(f"warmup done in {time.perf_counter() - t0:.1f}s")
 
-    # Python GC pauses showed up as ~0.5 s hiccups inside timed runs
-    # (millions of small host objects from output processing); collect
-    # up front and pause collection for the measurement.
+    # Median of 3 timed runs (BENCH_RUNS overrides): single runs spread
+    # several percent run-to-run (chip mood, host jitter), which is how
+    # round 5's self-measured headline and the driver's own capture came
+    # to disagree (7,387.5 vs 6,880.0 out-tok/s). All samples ride in
+    # the JSON so the spread is visible. Python GC pauses showed up as
+    # ~0.5 s hiccups inside timed runs (millions of small host objects
+    # from output processing); collect up front and pause collection for
+    # the duration of EACH measurement.
     import gc
-    gc.collect()
-    gc.disable()
-    try:
-        t0 = time.perf_counter()
-        total_out = _run(engine, sp, rng_tokens, steps)
-        dt = time.perf_counter() - t0
-    finally:
-        gc.enable()
-    _log(f"timed run: {total_out} tokens in {dt:.1f}s")
+    import statistics
+    n_runs = max(1, int(os.environ.get("BENCH_RUNS", "3")))
+    samples = []
+    for r in range(n_runs):
+        gc.collect()
+        gc.disable()
+        try:
+            t0 = time.perf_counter()
+            total_out = _run(engine, sp, rng_tokens, steps)
+            dt = time.perf_counter() - t0
+        finally:
+            gc.enable()
+        samples.append(total_out / dt)
+        _log(f"timed run {r + 1}/{n_runs}: {total_out} tokens in "
+             f"{dt:.1f}s = {samples[-1]:.1f} tok/s")
 
-    toks = total_out / dt
+    toks = statistics.median(samples)
     baseline = BASELINE_BY_QUANT.get(quant, BASELINE_TOKS)
     tag = f"_{quant}" if quant else ""
     if mode != "burst":
@@ -256,6 +273,8 @@ def main() -> None:
         "metric": f"offline_throughput_{size}{tag}",
         "value": round(toks, 1),
         "unit": "out_tok/s",
+        "samples": [round(s, 1) for s in samples],
+        "n_runs": n_runs,
         "vs_baseline": round(toks / baseline, 4),
         "quant": quant, "batch": batch, "steps": steps,
         "kv_dtype": kv_dtype, "baseline": baseline, "tp": tp,

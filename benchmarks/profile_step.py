@@ -102,7 +102,8 @@ def main() -> None:
     ap.add_argument("--ctx", type=int, default=128)
     ap.add_argument("--iters", type=int, default=100)
     ap.add_argument("--only", type=str, default="",
-                    help="comma list: qmm,dense,attn,kv,head,glue")
+                    help="comma list: qmm,a8,ab,dense,attn,kv,head,"
+                         "prefill,pglue,layer,burst,pstep,glue")
     args = ap.parse_args()
     only = set(args.only.split(",")) if args.only else None
 
@@ -183,6 +184,45 @@ def main() -> None:
         flops = 2 * B * K * N
         row(f"W4A8 gptq_matmul {name} [{B},{K}]x[{K},{N}]", s * 1e3,
             LAYERS, f"{flops / s / 1e12:.1f} TF/s")
+
+    # --- W4A8 kernel A/B: classic (per-group scale-FMA after every
+    # int8 dot) vs deferred (int32 group accumulator planes, one
+    # batched rescale at k-tile flush) at the three bench geometries:
+    # m=64 (small decode), 512 (the bench batch), 8192 (one prefill
+    # round). Shape is gate_up, the widest and most time-dominant of
+    # the four per-layer GEMMs; the `deferred` static kwarg pins the
+    # variant so both compile at identical shapes. ---
+    if want("ab"):
+        from aphrodite_tpu.ops.pallas.quant_matmul import gptq_matmul_a8
+        K, N = HIDDEN, 2 * INTER
+        qw = jax.random.randint(key, (K // 8, N), 0, 2**31 - 1,
+                                dtype=jnp.int32)
+        qz = jax.random.randint(key, (K // GROUP, N // 8), 0, 2**31 - 1,
+                                dtype=jnp.int32)
+        sc = jnp.ones((K // GROUP, N), dtype=jnp.bfloat16) * 0.01
+        ab_rows = []
+        for M in (64, 512, 8192):
+            x = jax.random.normal(key, (M, K), dtype=jnp.bfloat16)
+            tfs = {}
+            for label, use_def in (("classic", False),
+                                   ("deferred", True)):
+                def abstep(c, i, qw=qw, qz=qz, sc=sc, d=use_def):
+                    xx = c
+                    o = gptq_matmul_a8(xx, qw, qz, sc, bits=4,
+                                       group_size=GROUP, deferred=d)
+                    return xx + o[:, :1] * jnp.bfloat16(1e-30)
+                s, rtt = device_bench(abstep, x, slow=(M >= 4096))
+                rtts.append(rtt)
+                tfs[label] = 2 * M * K * N / s / 1e12
+                row(f"W4A8 A/B {label} gate_up m={M}", s * 1e3, LAYERS,
+                    f"{tfs[label]:.1f} TF/s")
+            ab_rows.append((M, tfs["classic"], tfs["deferred"]))
+        print(f"\n=== W4A8 kernel A/B "
+              f"(gate_up [m,{K}]x[{K},{N}], effective TF/s) ===")
+        print(f"{'m':>6s} {'classic':>10s} {'deferred':>10s} "
+              f"{'speedup':>9s}")
+        for M, c, d in ab_rows:
+            print(f"{M:6d} {c:10.1f} {d:10.1f} {d / c:8.2f}x")
 
     # --- bf16 dense matmuls, same shapes (MXU roofline comparison) ---
     for name, K, N in (shapes if want("dense") else []):

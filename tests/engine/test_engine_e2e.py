@@ -100,6 +100,43 @@ def test_prompt_logprobs(tiny_llm):
     assert len(out[0].prompt_logprobs) >= 2
 
 
+def test_full_prefix_hit_keeps_page_writer_engaged(tiny_llm):
+    """A computed prefix covering the whole prompt must clamp the chunk
+    start to a page boundary so `prefill_cells` (the whole-page prefill
+    KV writer) stays engaged — the old `min(ctx, len - 1)` clamp put
+    ctx mid-page and silently degraded the ENTIRE round to per-token
+    KV writes."""
+    from aphrodite_tpu.common.prefix import Prefix
+    from aphrodite_tpu.common.sequence import (SequenceData,
+                                               SequenceGroupMetadata)
+    mr = tiny_llm.engine.executor.model_runner
+    page = mr.page_size
+    tokens = list(range(1, 2 * page + 1))       # 2-page prompt
+    prefix = Prefix(tokens, page)               # covers the whole prompt
+    prefix.computed = True
+    md = SequenceGroupMetadata(
+        request_id="pfx-full", is_prompt=True,
+        seq_data={0: SequenceData(tokens)},
+        sampling_params=SamplingParams(temperature=0.0, max_tokens=2,
+                                       ignore_eos=True),
+        block_tables={0: [0, 1]},
+        persistent_data={0: {}},
+        prefix=prefix)
+    saved = mr._prefill_writer_ok
+    # The gate itself is backend-dependent (TPU-only); the ctx/cell
+    # layout math under test is pure host code.
+    mr._prefill_writer_ok = True
+    try:
+        inputs, _ = mr._prepare_prompt([md])
+    finally:
+        mr._prefill_writer_ok = saved
+    meta = inputs["metadata"]
+    assert int(meta.context_lens[0]) == page    # aligned, not 2*page-1
+    assert meta.prefill_cells is not None
+    pid, _, vld = meta.prefill_cells
+    assert int(pid[0]) == 1 and int(vld[0]) == page
+
+
 def test_long_prompt_multiblock(tiny_llm):
     """Prompt spanning several KV pages (block_size=16)."""
     prompt = " ".join(["paged attention works"] * 12)

@@ -138,6 +138,89 @@ def test_int8_matmul_matches_xla(K, N, m):
     assert rel < 2e-5, rel
 
 
+# ------------------------------------------- W4A8 deferred rescale --
+
+def _a8_oracle(x, w_dequant):
+    """Reference for the W4A8 kernels: quantize activations exactly the
+    way the wrappers do, then a plain f32 dequantize-then-dot."""
+    from aphrodite_tpu.ops.pallas.quant_matmul import (
+        _quantize_activations_int8)
+    x8, xs = _quantize_activations_int8(x)
+    return np.asarray((x8.astype(jnp.float32) * xs) @ w_dequant)
+
+
+@pytest.mark.parametrize("m", [1, 64, 512])
+@pytest.mark.parametrize("K", [384, 512])
+def test_gptq_a8_deferred_matches_dequant(m, K):
+    """Deferred-rescale parity, GPTQ int4 g128: the int32-group-
+    accumulator kernel must match (a) the classic a8 kernel to f32
+    summation order and (b) the reference dequantize-then-dot within
+    the existing W4A8 tolerance, across m in {1, 64, 512} and a
+    non-divisible K tail (K=384 -> three single-group k-tiles)."""
+    from aphrodite_tpu.ops.pallas.quant_matmul import gptq_matmul_a8
+    params, x = make_inputs(4, 128, K, 256, m)
+    method = GPTQLinearMethod(GPTQConfig(4, 128))
+    w = method.dequantize(params, jnp.float32)
+    oracle = _a8_oracle(x, w)
+    got = {}
+    for deferred in (False, True):
+        got[deferred] = np.asarray(gptq_matmul_a8(
+            x, params["qweight"], params["qzeros"], params["scales"],
+            bits=4, group_size=128, interpret=True, deferred=deferred))
+        rel = np.abs(oracle - got[deferred]).max() / \
+            (np.abs(oracle).max() + 1e-9)
+        assert rel < 2e-2, (deferred, rel)
+    rel_cd = np.abs(got[True] - got[False]).max() / \
+        (np.abs(got[False]).max() + 1e-9)
+    assert rel_cd < 1e-5, rel_cd
+
+
+@pytest.mark.parametrize("m", [1, 64, 512])
+@pytest.mark.parametrize("K", [384, 512])
+def test_awq_a8_deferred_matches_dequant(m, K):
+    """Deferred-rescale parity for the AWQ lane-plane layout — same
+    contract as the GPTQ case."""
+    from aphrodite_tpu.modeling.layers.quantization.awq import (
+        AWQConfig, AWQLinearMethod)
+    from aphrodite_tpu.ops.pallas.quant_matmul import awq_matmul_a8
+    params, x = make_awq_inputs(128, K, 1024, m)
+    method = AWQLinearMethod(AWQConfig(4, 128))
+    w = method.dequantize(params, jnp.float32)
+    oracle = _a8_oracle(x, w)
+    got = {}
+    for deferred in (False, True):
+        got[deferred] = np.asarray(awq_matmul_a8(
+            x, params["qweight"], params["qzeros"], params["scales"],
+            group_size=128, interpret=True, deferred=deferred))
+        rel = np.abs(oracle - got[deferred]).max() / \
+            (np.abs(oracle).max() + 1e-9)
+        assert rel < 2e-2, (deferred, rel)
+    rel_cd = np.abs(got[True] - got[False]).max() / \
+        (np.abs(got[False]).max() + 1e-9)
+    assert rel_cd < 1e-5, rel_cd
+
+
+def test_deferred_resolution_and_vmem_fallback(monkeypatch):
+    """The deferred selector: explicit arg wins, then the env flag,
+    then autotune-by-shape (m > 64); the VMEM-fit check rejects tile
+    footprints the budget can't hold."""
+    from aphrodite_tpu.ops.pallas.quant_matmul import (
+        _deferred_fits, _resolve_deferred)
+    monkeypatch.delenv("APHRODITE_QMM_DEFERRED", raising=False)
+    assert _resolve_deferred(True, 1) and not _resolve_deferred(False,
+                                                                8192)
+    assert not _resolve_deferred(None, 64)      # decode keeps classic
+    assert _resolve_deferred(None, 512)         # batch goes deferred
+    monkeypatch.setenv("APHRODITE_QMM_DEFERRED", "0")
+    assert not _resolve_deferred(None, 512)
+    monkeypatch.setenv("APHRODITE_QMM_DEFERRED", "1")
+    assert _resolve_deferred(None, 1)
+    # 4 int32 planes + f32 at 256x1024 = 5 MB fits the 8 MB default;
+    # a 1024x2048 tile (40 MB) does not.
+    assert _deferred_fits(256, 1024, 4)
+    assert not _deferred_fits(1024, 2048, 4)
+
+
 def test_awq_apply_fallback_on_cpu():
     from aphrodite_tpu.modeling.layers.quantization.awq import (
         AWQConfig, AWQLinearMethod)

@@ -13,7 +13,8 @@ def make_scheduler(num_gpu_blocks=16,
                    max_num_seqs=8,
                    max_num_batched_tokens=256,
                    max_model_len=256,
-                   max_paddings=256):
+                   max_paddings=256,
+                   max_chunk_tokens=None):
     cache_config = CacheConfig(block_size=BLOCK_SIZE)
     cache_config.num_gpu_blocks = num_gpu_blocks
     cache_config.num_cpu_blocks = num_cpu_blocks
@@ -21,7 +22,8 @@ def make_scheduler(num_gpu_blocks=16,
         max_num_batched_tokens=max_num_batched_tokens,
         max_num_seqs=max_num_seqs,
         max_model_len=max_model_len,
-        max_paddings=max_paddings)
+        max_paddings=max_paddings,
+        max_chunk_tokens=max_chunk_tokens)
     return Scheduler(scheduler_config, cache_config, None)
 
 
@@ -130,6 +132,67 @@ def test_abort():
     sched.abort_seq_group("r1")
     assert not sched.has_unfinished_seqs()
     assert g.get_seqs()[0].status == SequenceStatus.FINISHED_ABORTED
+
+
+def test_chunk_disabled_still_drains_inflight_prefill():
+    """max_chunk_tokens=0 disables chunk-mixing for NEW prompts, but a
+    group mid-prefill (admitted by a batch-building round, which always
+    runs the full budget) holds its full page allocation — it must keep
+    draining while decode rows exist, or it starves holding its pages
+    (regression: `if budget > 0` skipped _continue_prefills entirely)."""
+    sched = make_scheduler(num_gpu_blocks=1024, max_model_len=64,
+                           max_num_batched_tokens=64, max_chunk_tokens=0)
+    # Round 1: C prefills alone and starts decoding.
+    c = make_group("C", prompt_len=8)
+    sched.add_seq_group(c)
+    _, out1 = sched.schedule()
+    assert [g.request_id for g in out1.scheduled_seq_groups] == ["C"]
+    append_tokens(c)
+
+    # Round 2: two queued prompts >= the full budget trigger a
+    # batch-building round; B (64 tokens) only fits a 32-token chunk
+    # next to A's 16 and stays mid-prefill.
+    a = make_group("A", prompt_len=16)
+    b = make_group("B", prompt_len=64)
+    sched.add_seq_group(a)
+    sched.add_seq_group(b)
+    _, out2 = sched.schedule()
+    assert out2.prompt_run
+    assert [c2.group.request_id for c2 in out2.prompt_chunks] == \
+        ["A", "B"]
+    assert not out2.prompt_chunks[1].is_final
+    assert [g.request_id for g in sched.prefilling] == ["B"]
+    append_tokens(a)
+
+    # Round 3: decode rows exist and the chunk budget is 0 — B must
+    # still advance (and finish) instead of starving in `prefilling`.
+    _, out3 = sched.schedule()
+    assert [g.request_id for g in out3.decode_groups] == ["C", "A"]
+    assert [c3.group.request_id for c3 in out3.prompt_chunks] == ["B"]
+    assert out3.prompt_chunks[0].is_final
+    assert not sched.prefilling
+    assert any(g.request_id == "B" for g in sched.running)
+
+
+def test_full_prefix_hit_ctx_clamp_is_page_aligned():
+    """A computed prefix covering the whole prompt must clamp the chunk
+    start to a PAGE boundary (recompute the prefix tail page), not to
+    prompt_len - 1: one mid-page ctx disables the whole-page prefill KV
+    writer for the entire round (model_runner gates prefill_cells on
+    every row's ctx % page_size == 0)."""
+    sched = make_scheduler(num_gpu_blocks=1024)
+    seq = Sequence(next(_seq_counter), "x", list(range(8)), BLOCK_SIZE)
+    group = SequenceGroup("P", [seq], SamplingParams(), arrival_time=0.0)
+    prefix = sched.prefix_pool.add_or_get_prefix(list(range(8)))
+    prefix.computed = True
+    group.prefix = prefix
+    sched.add_seq_group(group)
+    _, out = sched.schedule()
+    (chunk,) = out.prompt_chunks
+    assert chunk.ctx % BLOCK_SIZE == 0
+    assert chunk.ctx == 4            # last page recomputed, not len-1=7
+    assert chunk.is_final
+    assert seq.data.num_computed_tokens == 8
 
 
 def test_fcfs_order_preserved_after_preempt():

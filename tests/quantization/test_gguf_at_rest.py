@@ -336,9 +336,11 @@ def test_w8a8_pallas_matmul_matches_dense(K, N, m):
 
 
 def test_turbo_load_produces_w8_form(tmp_path):
-    """With 128-multiple in_features and turbo on (default), every
-    quantized projection loads as (qs8, s128) and dequantizes within
-    the per-group bound of the exact dequant."""
+    """With 128-multiple in_features and turbo on (default): LOSSY
+    formats load as (qs8, s128) within the per-group bound; uniform
+    Q8_0 groups keep the EXACT native (qs, d) form (excluded from the
+    turbo requantization); mixed groups unify on the grouped-int8
+    (qs, d16) form, exact for their Q8_0 members."""
     from aphrodite_tpu.modeling.gguf import (write_gguf,
                                              gguf_weights_iterator)
     meta = {
@@ -376,30 +378,132 @@ def test_turbo_load_produces_w8_form(tmp_path):
     raw = dict(gguf_weights_iterator(path, at_rest=True))
     dense = dict(gguf_weights_iterator(path, at_rest=False))
     method = GGUFLinearMethod(GGUFConfig())
+    # Per-bucket routing: qkv is MIXED (Q4_0 q/k + Q8_0 v) -> grouped
+    # int8; o_proj and gate/up are uniform Q8_0 -> exact native form;
+    # down is uniform Q4_0 -> turbo w8.
+    expect = {
+        "model.layers.0.self_attn.q_proj.weight": "qs",
+        "model.layers.0.self_attn.k_proj.weight": "qs",
+        "model.layers.0.self_attn.v_proj.weight": "qs",
+        "model.layers.0.self_attn.o_proj.weight": "qs",
+        "model.layers.0.mlp.gate_proj.weight": "qs",
+        "model.layers.0.mlp.up_proj.weight": "qs",
+        "model.layers.0.mlp.down_proj.weight": "qs8",
+    }
     checked = 0
     for nm, tensor in raw.items():
         if type(tensor).__name__ != "RawGGUF":
             continue
-        qs8 = method.load_weight({}, "weight", tensor)
-        assert method.pending_rename == "qs8", nm
-        params = {"qs8": jnp.asarray(qs8)}
+        qs = method.load_weight({}, "weight", tensor)
+        assert method.pending_rename == expect[nm], nm
+        params = {method.pending_rename: jnp.asarray(qs)}
         params.update({k: jnp.asarray(v) for k, v in
                        method.pending_sidecar.items()})
         method.pending_rename = method.pending_sidecar = None
         w_hat = np.asarray(method.dequantize(params, jnp.float32))
         ref = np.asarray(dense[nm], np.float32).T        # [in, out]
-        s_rep = np.repeat(np.asarray(params["s128"]), 128, axis=0)
-        assert (np.abs(w_hat - ref) <= s_rep * 0.51).all(), nm
+        if tensor.type_name == "Q8_0":
+            # Native-exact int8 formats must NOT be requantized.
+            np.testing.assert_allclose(w_hat, ref, rtol=1e-6,
+                                       atol=1e-7, err_msg=nm)
+        elif "s128" in params:
+            s_rep = np.repeat(np.asarray(params["s128"]), 128, axis=0)
+            assert (np.abs(w_hat - ref) <= s_rep * 0.51).all(), nm
+        else:                        # mixed-bucket Q4_0 -> i8g
+            s_rep = np.repeat(np.asarray(params["d16"]), 16, axis=0)
+            assert (np.abs(w_hat - ref) <= np.abs(s_rep) * 0.51).all(), \
+                nm
         checked += 1
     assert checked >= 7
 
 
-def test_engine_turbo_w8_form_end_to_end(tmp_path):
-    """128-multiple in_features + turbo (default): the engine loads
-    projections as (qs8, s128) and serves. Greedy parity with the
-    dense path is NOT asserted here (requantization is approximate by
-    design); the documented bound is pinned by
-    test_turbo_load_produces_w8_form and the e2e drift artifact."""
+def test_turbo_excludes_native_int8_formats(tmp_path):
+    """The satellite regression: with turbo ON (default), uniform Q8_0
+    and Q6_K tensors at a 128-multiple in_features must keep their
+    EXACT forms — the old code requantized them onto per-128 scales
+    (qs8) and threw away their native bit-exactness."""
+    from aphrodite_tpu.modeling.gguf import (write_gguf,
+                                             gguf_weights_iterator)
+    meta = {
+        "general.architecture": "llama",
+        "llama.embedding_length": 256, "llama.block_count": 1,
+        "llama.feed_forward_length": 256,
+        "llama.attention.head_count": 4,
+        "llama.attention.head_count_kv": 2,
+        "llama.context_length": 128, "llama.vocab_size": 64,
+    }
+    t = {
+        "token_embd.weight": (rs.randn(64, 256).astype(np.float32),
+                              "F32"),
+        "output.weight": (rs.randn(64, 256).astype(np.float32), "F32"),
+        "output_norm.weight": (np.ones(256, np.float32), "F32"),
+        "blk.0.attn_norm.weight": (np.ones(256, np.float32), "F32"),
+        "blk.0.ffn_norm.weight": (np.ones(256, np.float32), "F32"),
+        # Uniform Q8_0 everywhere: every projection is native-exact.
+        "blk.0.attn_q.weight": (
+            rs.randn(256, 256).astype(np.float32) * 0.05, "Q8_0"),
+        "blk.0.attn_k.weight": (
+            rs.randn(128, 256).astype(np.float32) * 0.05, "Q8_0"),
+        "blk.0.attn_v.weight": (
+            rs.randn(128, 256).astype(np.float32) * 0.05, "Q8_0"),
+        "blk.0.attn_output.weight": (
+            rs.randn(256, 256).astype(np.float32) * 0.05, "Q8_0"),
+        "blk.0.ffn_gate.weight": (
+            rs.randn(256, 256).astype(np.float32) * 0.05, "Q8_0"),
+        "blk.0.ffn_up.weight": (
+            rs.randn(256, 256).astype(np.float32) * 0.05, "Q8_0"),
+        "blk.0.ffn_down.weight": (
+            rs.randn(256, 256).astype(np.float32) * 0.05, "Q8_0"),
+    }
+    path = str(tmp_path / "native-int8.gguf")
+    write_gguf(path, meta, t)
+    raw = dict(gguf_weights_iterator(path, at_rest=True))
+    dense = dict(gguf_weights_iterator(path, at_rest=False))
+    method = GGUFLinearMethod(GGUFConfig())
+    checked = 0
+    for nm, tensor in raw.items():
+        if type(tensor).__name__ != "RawGGUF":
+            continue
+        assert not tensor.compat, nm       # uniform groups, not mixed
+        qs = method.load_weight({}, "weight", tensor)
+        assert method.pending_rename == "qs", \
+            (nm, method.pending_rename)    # never qs8
+        params = {"qs": jnp.asarray(qs)}
+        params.update({k: jnp.asarray(v) for k, v in
+                       method.pending_sidecar.items()})
+        method.pending_rename = method.pending_sidecar = None
+        w_hat = np.asarray(method.dequantize(params, jnp.float32))
+        ref = np.asarray(dense[nm], np.float32).T        # [in, out]
+        # BIT-EXACT dequant (f32 round-trip tolerance only).
+        np.testing.assert_allclose(w_hat, ref, rtol=1e-6, atol=1e-7,
+                                   err_msg=nm)
+        checked += 1
+    assert checked == 7
+
+    # Q6_K (the test writer can't encode it): a non-compat tensor at a
+    # 128-multiple in_features must route to the exact grouped-int8
+    # repack, never the turbo requantization.
+    from aphrodite_tpu.modeling.gguf import RawGGUF, _deq_q6_k
+    out_f, in_f = 8, 512
+    blocks = random_q6k_blocks(out_f, in_f)
+    tensor = RawGGUF("Q6_K", blocks, (out_f, in_f), compat=False)
+    qs = method.load_weight({}, "weight", tensor)
+    assert method.pending_rename == "qs"
+    assert "d16" in method.pending_sidecar
+    params = {"qs": jnp.asarray(qs)}
+    params.update({k: jnp.asarray(v) for k, v in
+                   method.pending_sidecar.items()})
+    method.pending_rename = method.pending_sidecar = None
+    w_hat = np.asarray(method.dequantize(params, jnp.float32))
+    ref = _deq_q6_k(blocks).reshape(out_f, in_f).T
+    np.testing.assert_allclose(w_hat, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_engine_q8_128_loads_exact_form_end_to_end(tmp_path):
+    """128-multiple in_features + turbo (default): a uniform-Q8_0
+    checkpoint loads its projections in the EXACT native (qs, d) form
+    — NOT the lossy turbo (qs8, s128) requantization, which is
+    reserved for formats without a native int8 path — and serves."""
     from aphrodite_tpu.common.sampling_params import SamplingParams
     from aphrodite_tpu.endpoints.llm import LLM
 
@@ -411,8 +515,9 @@ def test_engine_turbo_w8_form_end_to_end(tmp_path):
               disable_log_stats=True)
     bucket = llm.engine.executor.params[
         "model.layers.0.self_attn.qkv_proj"]
-    assert "qs8" in bucket and "s128" in bucket, bucket.keys()
-    assert bucket["qs8"].dtype == jnp.int8
+    assert "qs" in bucket and "d" in bucket, bucket.keys()
+    assert "qs8" not in bucket
+    assert bucket["qs"].dtype == jnp.int8
     out = llm.generate(
         prompt_token_ids=[[5, 9, 11, 3, 7]],
         sampling_params=SamplingParams(temperature=0.0, max_tokens=6,
