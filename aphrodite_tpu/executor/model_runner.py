@@ -38,6 +38,8 @@ from aphrodite_tpu.modeling.sampling_metadata import (OutputMetadata,
                                                       PersistentMetadata,
                                                       SamplingMetadata)
 from aphrodite_tpu.ops.kv_cache import copy_blocks as _copy_blocks_op
+from aphrodite_tpu.ops.pallas.paged_attention import (
+    build_decode_work_list, choose_pages_per_chunk)
 
 logger = init_logger(__name__)
 
@@ -550,11 +552,38 @@ class ModelRunner:
                 "decode slots share a page — sequence-exclusive-pages "
                 f"precondition violated: {sorted(written)}")
 
+        # Ragged decode work list: flatten (sequence, chunk) pairs over
+        # each row's REAL reserved pages so the attention grid has no
+        # padded cells for short contexts (the classic grid pads every
+        # row to the batch-max context). Chunk counts come from the
+        # reserved table lengths — a safe over-approximation of any
+        # context the burst scan reaches (pos_cap pins rows inside
+        # their reservation), so the list rides the whole burst.
+        ppc = choose_pages_per_chunk(max_pages, self.page_size,
+                                     padded_batch)
+        page_counts = [len(t) for t in tables_list] + \
+            [0] * (padded_batch - batch)
+        nw_real = sum(max(1, -(-c // ppc)) for c in page_counts)
+        # Pad the list to padded_batch * 2^k (clamped to the dense cell
+        # count): each (batch, pages) bucket then exposes only a few
+        # possible work-list lengths, so a fluctuating serving mix
+        # reuses compiles; padding is dead items the kernel skips
+        # without issuing DMAs.
+        chunks_cap = -(-max_pages // ppc)
+        mix = 1
+        while padded_batch * mix < nw_real:
+            mix *= 2
+        wi_seq, wi_chunk = build_decode_work_list(
+            page_counts, ppc,
+            pad_to=padded_batch * min(mix, chunks_cap))
+
         metadata = InputMetadata(
             slot_mapping=jnp.asarray(slots),
             block_tables=jnp.asarray(tables),
             context_lens=jnp.asarray(ctx_lens),
             kv_scale=self.kv_scale,
+            decode_work=(jnp.asarray(wi_seq), jnp.asarray(wi_chunk)),
+            decode_ppc=ppc,
         )
         sampling = SamplingMetadata(
             seq_groups=seq_groups,

@@ -37,6 +37,13 @@ class InputMetadata:
     # layout is page-aligned so whole pages can be written without
     # read-modify-write (ops/pallas/kv_write.write_kv_pages_prefill).
     prefill_cells: Optional[tuple] = None
+    # Ragged decode work list (wi_seq [NW+1], wi_chunk [NW] int32):
+    # (sequence, chunk) pairs flattened over each row's REAL reserved
+    # pages, built by ModelRunner._prepare_decode with
+    # ops/pallas/paged_attention.build_decode_work_list. Rides the
+    # burst-scan carry unchanged (chunk counts come from reserved
+    # pages, a safe over-approximation of any in-burst context).
+    decode_work: Optional[tuple] = None
 
     is_prompt: bool = struct.field(pytree_node=False, default=False)
     # Prefill against a non-empty cached prefix (prefix caching / chunked
@@ -46,6 +53,11 @@ class InputMetadata:
     # caches. Static so every jit / Pallas compile cache keys on it —
     # the scale is a trace-time constant folded into kernel epilogues.
     kv_scale: float = struct.field(pytree_node=False, default=1.0)
+    # pages_per_chunk the decode_work list was built with (0 = no work
+    # list). Static: the kernel's chunk geometry is a trace-time
+    # constant, and the value is a function of the (batch, pages)
+    # bucket, so it adds no compiles of its own.
+    decode_ppc: int = struct.field(pytree_node=False, default=0)
     # Sequence-parallel prefill routing: (Mesh, threshold_tokens) when
     # the engine runs with --sequence-parallel-size > 1, else None.
     # Static (Mesh is hashable): prompts at/above the threshold shard

@@ -77,15 +77,7 @@ class PagedAttention:
         k = k.reshape(batch, seq_len, self.num_kv_heads, self.head_size)
         v = v.reshape(batch, seq_len, self.num_kv_heads, self.head_size)
 
-        # Sliding-window models write to a ROTATING ring slot
-        # (pos % window, computed host-side in _prepare_decode); the
-        # fused kernel derives the write position as ctx-1, which the
-        # window clamp pins — so windowed models keep the slot-mapped
-        # writer path.
-        fused_decode = (k_pages is not None and
-                        not metadata.is_prompt and
-                        self.sliding_window is None and
-                        self._pallas_decode_ok(k_pages))
+        fused_decode = self._fused_decode_ok(k_pages, metadata)
         if k_pages is not None and not fused_decode:
             flat_k = k.reshape(-1, self.num_kv_heads, self.head_size)
             flat_v = v.reshape(-1, self.num_kv_heads, self.head_size)
@@ -144,6 +136,18 @@ class PagedAttention:
         return (out.reshape(batch, seq_len,
                             self.num_heads * self.head_size),
                 k_pages, v_pages)
+
+    def _fused_decode_ok(self, k_pages, metadata) -> bool:
+        """Routing precondition for the fused in-kernel KV write.
+        Sliding-window models write to a ROTATING ring slot
+        (pos % window, computed host-side in _prepare_decode); the
+        fused kernel derives the write position as ctx-1, which the
+        window clamp pins — so windowed models MUST keep the
+        slot-mapped writer path."""
+        return (k_pages is not None and
+                not metadata.is_prompt and
+                self.sliding_window is None and
+                self._pallas_decode_ok(k_pages))
 
     def _pallas_decode_ok(self, k_pages) -> bool:
         quant_ok = k_pages.dtype in (jnp.bfloat16, jnp.float32) or (
@@ -248,33 +252,28 @@ class PagedAttention:
             # clamp pads to a valid page — masked off by context_lens.
             tables = jnp.minimum(metadata.block_tables,
                                  k_pages.shape[0] - 1)
-            # Bigger chunks amortize the per-chunk loop/DMA overhead for
-            # long contexts; largest power-of-two <= 32 dividing the
-            # (bucketed) table width, >= 512 tokens per chunk when the
-            # context allows.
-            pps = tables.shape[1]
-            page_size = k_pages.shape[1]
-            batch = q3.shape[0]
-            # Largest divisor of the table width <= 8 (narrow tables —
-            # e.g. 4 pages at page 32 — must not collapse to 1-page
-            # chunks).
-            ppc = next(d for d in (8, 4, 2, 1) if pps % d == 0)
-            # Bigger chunks only for SMALL batches: the table width is
-            # the batch MAX, so in a mixed large batch one long sequence
-            # would inflate every short sequence's chunk (masked DMA +
-            # compute). Small-batch long-context is where fewer chunk
-            # iterations pay.
-            if batch < 32:
-                while ppc * 2 <= 32 and pps % (ppc * 2) == 0 and \
-                        ppc * page_size < 512:
-                    ppc *= 2
+            # Chunk geometry: when the model runner built a ragged
+            # work list it also fixed pages_per_chunk (the list and the
+            # kernel's chunk walk must agree); otherwise fall back to
+            # the shared policy over the padded table width. The ragged
+            # work-list grid replaces the padded (batch, n_hb) grid
+            # unless APHRODITE_ATTN_RAGGED=0 pins the classic kernel.
+            from aphrodite_tpu.ops.pallas.paged_attention import (
+                choose_pages_per_chunk)
+            work = metadata.decode_work
+            if work is not None and metadata.decode_ppc:
+                ppc = metadata.decode_ppc
+            else:
+                work = None
+                ppc = choose_pages_per_chunk(
+                    tables.shape[1], k_pages.shape[1], q3.shape[0])
             result = paged_decode_attention(
                 q3, k_pages, v_pages, tables,
                 metadata.context_lens, slopes, knew, vnew,
                 scale=self.scale,
                 kv_scale=dequant_scale(k_pages.dtype,
                                        metadata.kv_scale),
-                pages_per_chunk=ppc)
+                pages_per_chunk=ppc, work_items=work)
             if knew is not None:
                 out, k_pages, v_pages = result
                 if self.padded_head != self.head_size:

@@ -3,8 +3,7 @@
 TPU-native replacement for the reference's PagedAttention V1/V2 CUDA
 kernels (`kernels/attention/attention_kernels.cu:717,907`, 951 lines of
 FasterTransformer-derived CUDA). Design (round-3 "token-major" layout,
-chosen from the PROFILE_r03 attribution — the previous head-major
-layout's 4 KB-per-(head,page) DMAs capped attention at 210 GB/s):
+round-6 "ragged work-list" grid):
 
 - KV pages are TOKEN-MAJOR with heads collapsed into lanes:
       k_pages, v_pages: [num_pages, page_size, H * d]
@@ -14,17 +13,32 @@ layout's 4 KB-per-(head,page) DMAs capped attention at 210 GB/s):
   instead of 4 KB — and the layout has no Mosaic tile padding for ANY
   head count (lanes = H*d >= 128 always), so it survives tp-sharding
   down to one local head.
-- Grid: (batch, H // hb) with head-block hb = min(8, largest divisor).
-  The cell's hb kv-heads ride as a LANE block: scores come from one
-  MXU dot [group*hb, hb*d] x [hb*d, chunk] where q is packed
-  block-diagonally (row r holds q in its own head's d lanes, zeros
-  elsewhere) — cross-head products are exactly zero, so no masked
-  score tile and no H-times VPU exp waste (the round-2 allheads
-  kernel's documented flaw).
-- The block table is scalar-prefetched; pages double-buffer into VMEM
-  (chunk c+1 streams while c computes). When every sequence fits one
-  chunk, cells prefetch ACROSS the grid instead (cell i starts cell
-  i+1's loads), hiding page-DMA latency behind compute.
+- RAGGED WORK-LIST GRID (the default; "Ragged Paged Attention",
+  arxiv 2604.15464): the caller flattens (sequence, chunk) pairs into
+  a 1-D list of REAL work items — one item per pages_per_chunk pages a
+  sequence actually reserved, not per batch-max-context cell — and
+  scalar-prefetches it as two int32 arrays (wi_seq, wi_chunk). Grid is
+  (n_hb, num_work_items); short sequences contribute few items, long
+  ones many, and list padding is DEAD items (chunk -1) that skip DMA,
+  compute, and output entirely. ONE unified prefetch ring runs across
+  all items: cell i issues cell i+pf_depth's K+V page copies
+  back-to-back before waiting its own, so page-DMA latency overlaps
+  several cells' compute regardless of how many chunks any sequence
+  has — subsuming the classic kernel's separate single-chunk
+  cross-cell path and its 2-slot multi-chunk double buffer.
+  Work items of one sequence are grid-adjacent, so cross-chunk
+  online-softmax state lives in persistent VMEM scratch (reset at
+  chunk 0, finalized at the sequence's last item) — no inter-cell HBM
+  combine pass. The classic padded (batch, n_hb) grid remains below
+  and is selected by APHRODITE_ATTN_RAGGED=0 or by calling without
+  work_items.
+- Head blocks: hb = min(8, largest divisor of Hkv). The cell's hb
+  kv-heads ride as a LANE block: scores come from one MXU dot
+  [group*hb, hb*d] x [hb*d, chunk] where q is packed block-diagonally
+  (row r holds q in its own head's d lanes, zeros elsewhere) —
+  cross-head products are exactly zero, so no masked score tile and no
+  H-times VPU exp waste (the round-2 allheads kernel's documented
+  flaw).
 - p@V lands as [rows, hb*d]; each row's own head block is extracted
   with hb static lane-slices (masked adds) — no in-register reshape.
 - FUSED KV WRITE (decode steps): pass knew/vnew [batch, n_hb, hb*d]
@@ -33,7 +47,12 @@ layout's 4 KB-per-(head,page) DMAs capped attention at 210 GB/s):
   (lane-sliced per head block, pages aliased in place) — replacing the
   separate page-writer kernel pass entirely: the page was being DMA'd
   in for attention anyway, so the write costs two extra page-sized
-  DMAs instead of a whole second kernel's round trips.
+  DMAs instead of a whole second kernel's round trips. Under the
+  ragged grid only ONE work item per (sequence, head block) issues a
+  write (the chunk holding position ctx-1), so the writeback ring is
+  keyed by an SMEM write counter — the n-th write waits the
+  (n-_WB_SLOTS)-th — instead of by grid cell, which would leave
+  gaps whenever a cell doesn't write.
   PRECONDITIONS (the engine's decode contract): pages are
   sequence-exclusive; position ctx-1 lies within the sequence's
   RESERVED block-table entries (burst reservation guarantees this —
@@ -53,6 +72,9 @@ quantize the injected token into stored units first.
 from __future__ import annotations
 
 import functools
+import os
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -61,19 +83,44 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -2.0**30  # large-but-finite: avoids inf-inf NaNs in corrections
 
-# Fused-write writeback ring depth: cell i reuses slot i % _WB_SLOTS and
-# waits cell i-_WB_SLOTS's DMA, so deeper rings hide more write latency.
+# Fused-write writeback ring depth: write n reuses slot n % _WB_SLOTS and
+# waits write n-_WB_SLOTS's DMA, so deeper rings hide more write latency.
 _WB_SLOTS = 8
 
-# Single-chunk cross-cell read pipeline: cell i starts cell
-# i+_PF_DEPTH's chunk loads; the chunk buffer ring must be deeper than
-# the prefetch distance so a landing load never aliases a live slot.
-import os as _os
-_PF_DEPTH = int(_os.environ.get("APHRODITE_ATTN_PF", "6"))
-if _PF_DEPTH < 1:
-    raise ValueError(
-        f"APHRODITE_ATTN_PF must be >= 1, got {_PF_DEPTH}")
-_CHUNK_SLOTS = _PF_DEPTH + 2
+# Combined K+V read-ring VMEM budget: the prefetch depth is trimmed so
+# the ring never crowds out the rest of the ~16 MB VMEM when chunks are
+# large (small-batch long-context boosts chunk_tokens to 512).
+_RING_BUDGET_BYTES = 8 * 1024 * 1024
+
+# Ragged work-list length buckets (each distinct padded length is one
+# compiled program, and remote compiles cost ~20 s — same
+# power-of-two-and-a-half spacing rationale as the decode batch
+# buckets in executor/model_runner.py).
+_WORK_BUCKETS = [8, 16, 24, 32, 48, 64, 96, 128, 192, 256, 384, 512,
+                 768, 1024, 1536, 2048, 3072, 4096]
+
+
+def _pf_depth() -> int:
+    """Cross-cell read-pipeline depth (cell i starts cell i+depth's
+    chunk loads). Read from APHRODITE_ATTN_PF at CALL time — reading
+    and validating at import killed every import on a bad env var and
+    forced a re-import per A/B sweep point."""
+    raw = os.environ.get("APHRODITE_ATTN_PF", "6")
+    try:
+        depth = int(raw)
+    except ValueError as e:
+        raise ValueError(
+            f"APHRODITE_ATTN_PF must be an integer, got {raw!r}") from e
+    if depth < 1:
+        raise ValueError(f"APHRODITE_ATTN_PF must be >= 1, got {depth}")
+    return depth
+
+
+def ragged_enabled() -> bool:
+    """APHRODITE_ATTN_RAGGED=0 pins the classic padded-grid kernel
+    (the A/B fallback); anything else (or unset) allows the ragged
+    work-list grid when the caller supplies work_items."""
+    return os.environ.get("APHRODITE_ATTN_RAGGED", "1") != "0"
 
 
 def head_block(num_kv_heads: int) -> int:
@@ -84,6 +131,79 @@ def head_block(num_kv_heads: int) -> int:
         if num_kv_heads % hb == 0:
             return hb
     return 1
+
+
+def clamp_pages_per_chunk(pages_per_seq: int, requested: int) -> int:
+    """Largest divisor of the table width that is <= the requested
+    chunk size. The kernel iterates whole chunks over the table, so
+    pages_per_seq % pages_per_chunk must be 0 — but forcing every
+    caller to pre-pad (the old ValueError) punished odd table widths;
+    clamping down costs only smaller chunks."""
+    if requested < 1:
+        raise ValueError(f"pages_per_chunk must be >= 1, got {requested}")
+    for c in range(min(requested, pages_per_seq), 0, -1):
+        if pages_per_seq % c == 0:
+            return c
+    return 1
+
+
+def choose_pages_per_chunk(pages_per_seq: int, page_size: int,
+                           batch: int) -> int:
+    """The shared chunking policy (layer + model runner must agree —
+    the runner builds the ragged work list with it, the layer passes
+    the same value to the kernel). Largest divisor of the table width
+    <= 8, boosted for SMALL batches only: the table width is the batch
+    max, so in a mixed large batch one long sequence would inflate
+    every short sequence's chunk; small-batch long-context is where
+    fewer chunk iterations pay."""
+    ppc = next(d for d in (8, 4, 2, 1) if pages_per_seq % d == 0)
+    if batch < 32:
+        while ppc * 2 <= 32 and pages_per_seq % (ppc * 2) == 0 and \
+                ppc * page_size < 512:
+            ppc *= 2
+    return ppc
+
+
+def _bucket_work(n: int) -> int:
+    for b in _WORK_BUCKETS:
+        if n <= b:
+            return b
+    return -(-n // 1024) * 1024
+
+
+def build_decode_work_list(page_counts, pages_per_chunk: int,
+                           pad_to: int = None):
+    """Flatten ragged per-sequence page work into the 1-D work list the
+    ragged kernel scalar-prefetches.
+
+    page_counts: per batch row (INCLUDING padded rows), the number of
+    real block-table entries the row reserved; rows with 0 pages (pad
+    lanes) still get one fully-masked item so their output lane is
+    written (zeros), preserving the classic kernel's ctx==0 contract.
+
+    Returns (wi_seq [NW+1] int32, wi_chunk [NW] int32) numpy arrays:
+    wi_seq[w] is the batch row of item w, wi_chunk[w] its chunk index.
+    Items of one row are contiguous and chunk-ordered (the kernel's
+    persistent-accumulator contract). List padding beyond the real
+    items is DEAD (chunk -1, seq = len(page_counts) — the kernel's
+    dummy output row); wi_seq carries one trailing -1 sentinel so the
+    last real item detects it is its row's final chunk."""
+    seqs, chunks = [], []
+    for i, npg in enumerate(page_counts):
+        n = max(1, -(-int(npg) // pages_per_chunk))
+        seqs.extend([i] * n)
+        chunks.extend(range(n))
+    nw = len(seqs)
+    padded = _bucket_work(nw) if pad_to is None else pad_to
+    if padded < nw:
+        raise ValueError(f"pad_to={padded} < {nw} real work items")
+    dummy = len(page_counts)
+    wi_seq = np.full((padded + 1,), -1, dtype=np.int32)
+    wi_seq[:nw] = seqs
+    wi_seq[nw:padded] = dummy
+    wi_chunk = np.full((padded,), -1, dtype=np.int32)
+    wi_chunk[:nw] = chunks
+    return wi_seq, wi_chunk
 
 
 def _quantize_row(row, dtype, kv_scale):
@@ -108,6 +228,8 @@ def _decode_kernel_tm(
     page_size: int,
     scale: float,
     kv_scale: float,
+    pf_depth: int,
+    chunk_slots: int,
     has_alibi: bool = False,
     single_chunk: bool = False,
     fused_write: bool = False,
@@ -226,10 +348,10 @@ def _decode_kernel_tm(
 
     if single_chunk:
         # Every sequence fits one chunk: pipeline ACROSS grid cells —
-        # cell i starts cell i+_PF_DEPTH's loads before waiting on its
+        # cell i starts cell i+pf_depth's loads before waiting on its
         # own, so page-DMA latency overlaps several cells' compute
         # (depth 1 left attention at ~450-600 GB/s of the ~820 floor;
-        # depth 6 measures ~690; the buffer ring has _PF_DEPTH+2 slots
+        # depth 6 measures ~690; the buffer ring has pf_depth+2 slots
         # so an in-flight load never lands in a slot still being
         # read). Scratch/semaphores
         # persist across cells, slots by cell index mod ring size.
@@ -238,18 +360,18 @@ def _decode_kernel_tm(
 
         @pl.when(cell == 0)
         def _():
-            # Cells 1.._PF_DEPTH have no predecessor _PF_DEPTH back;
+            # Cells 1..pf_depth have no predecessor pf_depth back;
             # cell 0 seeds their loads (static unroll; NOT `d` — that
             # name is the kernel-wide head_dim alias).
-            for seed_cell in range(min(_PF_DEPTH + 1, total_cells)):
-                start_chunk(0, seed_cell % _CHUNK_SLOTS,
+            for seed_cell in range(min(pf_depth + 1, total_cells)):
+                start_chunk(0, seed_cell % chunk_slots,
                             cell_b=seed_cell // n_hb,
                             cell_j=seed_cell % n_hb)
 
-        @pl.when((cell >= 1) & (cell + _PF_DEPTH < total_cells))
+        @pl.when((cell >= 1) & (cell + pf_depth < total_cells))
         def _():
-            nc = cell + _PF_DEPTH
-            start_chunk(0, jax.lax.rem(nc, _CHUNK_SLOTS),
+            nc = cell + pf_depth
+            start_chunk(0, jax.lax.rem(nc, chunk_slots),
                         cell_b=nc // n_hb,
                         cell_j=jax.lax.rem(nc, n_hb))
     else:
@@ -259,7 +381,7 @@ def _decode_kernel_tm(
 
     def body(c, _):
         if single_chunk:
-            slot = jax.lax.rem(b * n_hb + j, _CHUNK_SLOTS)
+            slot = jax.lax.rem(b * n_hb + j, chunk_slots)
         else:
             slot = jax.lax.rem(c, 2)
 
@@ -384,77 +506,363 @@ def _decode_kernel_tm(
         out_ref.dtype)
 
 
+def _decode_kernel_ragged(
+    # scalar prefetch
+    block_tables_ref,   # [batch+1, pages_per_seq] int32 (SMEM)
+    context_lens_ref,   # [batch+1] int32 (SMEM; row batch is the dummy)
+    wi_seq_ref,         # [NW+1] int32: batch row of item w; [NW] = -1
+    wi_chunk_ref,       # [NW] int32: chunk of item w; -1 = dead padding
+    # inputs (slopes_ref only with has_alibi; knew/vnew only with
+    # fused_write), then outputs, then scratch
+    *refs,
+    hb: int,
+    group: int,
+    head_dim: int,
+    pages_per_chunk: int,
+    page_size: int,
+    scale: float,
+    kv_scale: float,
+    pf_depth: int,
+    chunk_slots: int,
+    has_alibi: bool = False,
+    fused_write: bool = False,
+):
+    refs = list(refs)
+    q_ref, k_hbm, v_hbm = refs[:3]
+    refs = refs[3:]
+    slopes_ref = refs.pop(0) if has_alibi else None
+    if fused_write:
+        knew_ref, vnew_ref = refs[:2]
+        out_ref, kp_out, vp_out = refs[2:5]
+        scratch = refs[5:]
+        (k_buf, v_buf, sems, acc_scr, m_scr, l_scr,
+         kwb, vwb, wbsem, wb_meta) = scratch
+        # reads and writes go through the aliased OUTPUT refs so
+        # in-place semantics hold
+        k_hbm, v_hbm = kp_out, vp_out
+    else:
+        knew_ref = vnew_ref = None
+        out_ref = refs[0]
+        (k_buf, v_buf, sems, acc_scr, m_scr, l_scr) = refs[1:]
+        kwb = vwb = wbsem = wb_meta = None
+
+    j = pl.program_id(0)
+    w = pl.program_id(1)
+    n_hb = pl.num_programs(0)
+    nw = pl.num_programs(1)
+    cell = j * nw + w
+    total_cells = n_hb * nw
+    d = head_dim
+    rows = group * hb
+    chunk_tokens = pages_per_chunk * page_size
+
+    s_idx = wi_seq_ref[w]          # dead items carry the dummy row
+    c = wi_chunk_ref[w]
+    item_live = c >= 0
+    ctx = context_lens_ref[s_idx]
+
+    def lanes_of(cell_j):
+        return pl.ds(cell_j * hb * d, hb * d)
+
+    def chunk_dmas(seq2, c2, j2, slot):
+        # K and V for each page issued back-to-back: one page's two
+        # copies land adjacently in the DMA queue, so the engine
+        # overlaps them instead of draining all K before any V.
+        lanes = lanes_of(j2)
+        copies = []
+        for p in range(pages_per_chunk):  # static unroll
+            page_idx = block_tables_ref[seq2, c2 * pages_per_chunk + p]
+            dst = pl.ds(p * page_size, page_size)
+            copies.append(
+                pltpu.make_async_copy(k_hbm.at[page_idx, :, lanes],
+                                      k_buf.at[slot, dst, :],
+                                      sems.at[slot, 0]))
+            copies.append(
+                pltpu.make_async_copy(v_hbm.at[page_idx, :, lanes],
+                                      v_buf.at[slot, dst, :],
+                                      sems.at[slot, 1]))
+        return copies
+
+    def start_cell(cell2, j2, w2):
+        # Dead targets get no DMAs (and later skip the wait), so list
+        # padding costs no bandwidth — only a skipped grid cell.
+        @pl.when(wi_chunk_ref[w2] >= 0)
+        def _():
+            slot2 = jax.lax.rem(cell2, chunk_slots)
+            for dma in chunk_dmas(wi_seq_ref[w2], wi_chunk_ref[w2],
+                                  j2, slot2):
+                dma.start()
+
+    # ---- unified cross-cell prefetch ring over ALL work items ----
+    @pl.when(cell == 0)
+    def _():
+        if fused_write:
+            wb_meta[0] = 0          # writeback counter
+        # Cells 1..pf_depth have no predecessor pf_depth back; cell 0
+        # seeds their loads (static unroll).
+        for seed in range(min(pf_depth + 1, total_cells)):
+            start_cell(seed, seed // nw, seed % nw)
+
+    @pl.when((cell >= 1) & (cell + pf_depth < total_cells))
+    def _():
+        nc = cell + pf_depth
+        start_cell(nc, nc // nw, jax.lax.rem(nc, nw))
+
+    # Block-diagonal q packing (see _decode_kernel_tm).
+    q = q_ref[0, 0].astype(jnp.float32) * (scale * kv_scale)  # [rows, d]
+    q_rep = jax.lax.concatenate([q] * hb, 1)                  # [rows, hb*d]
+    lane_head = jax.lax.broadcasted_iota(
+        jnp.int32, (rows, hb * d), 1) // d
+    row_head = jax.lax.broadcasted_iota(
+        jnp.int32, (rows, hb * d), 0) // group
+    q_packed = jnp.where(lane_head == row_head, q_rep,
+                         0.0).astype(jnp.bfloat16)
+
+    # Cross-chunk online-softmax state persists in VMEM scratch across
+    # grid cells; a sequence's items are grid-adjacent, so resetting at
+    # its chunk 0 and finalizing at its last item needs no inter-cell
+    # HBM combine pass.
+    @pl.when(item_live & (c == 0))
+    def _():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+
+    if fused_write:
+        pos_new = jnp.maximum(ctx - 1, 0)
+        c_star = pos_new // chunk_tokens
+        r_star = jax.lax.rem(pos_new, chunk_tokens)
+        p_star = r_star // page_size
+        g_star = block_tables_ref[s_idx, pos_new // page_size]
+        is_writer = item_live & (ctx > 0) & (c == c_star)
+
+    @pl.when(item_live)
+    def _():
+        slot = jax.lax.rem(cell, chunk_slots)
+        for dma in chunk_dmas(s_idx, c, j, slot):
+            dma.wait()
+
+        if fused_write:
+            # Only ONE item per (sequence, head block) writes — the
+            # chunk holding position ctx-1 — so the writeback ring is
+            # keyed by an SMEM write counter, not the grid cell: the
+            # n-th write waits the (n-_WB_SLOTS)-th write's DMA before
+            # reusing its buffer slot. wb_meta layout: [0] counter,
+            # [1..WB] page of the slot's outstanding write,
+            # [1+WB..1+2*WB] its head block.
+            @pl.when(is_writer)
+            def _():
+                n = wb_meta[0]
+                s_wb = jax.lax.rem(n, _WB_SLOTS)
+
+                @pl.when(n >= _WB_SLOTS)
+                def _():
+                    pgs = wb_meta[1 + s_wb]
+                    pj = wb_meta[1 + _WB_SLOTS + s_wb]
+                    pltpu.make_async_copy(
+                        kwb.at[s_wb], k_hbm.at[pgs, :, lanes_of(pj)],
+                        wbsem.at[s_wb, 0]).wait()
+                    pltpu.make_async_copy(
+                        vwb.at[s_wb], v_hbm.at[pgs, :, lanes_of(pj)],
+                        wbsem.at[s_wb, 1]).wait()
+
+                pg = pl.ds(p_star * page_size, page_size)
+                rows_p = jax.lax.broadcasted_iota(
+                    jnp.int32, (page_size, k_buf.shape[2]), 0)
+                r_in_page = jax.lax.rem(r_star, page_size)
+                kq = _quantize_row(knew_ref[0, 0], k_buf.dtype,
+                                   kv_scale)
+                vq = _quantize_row(vnew_ref[0, 0], v_buf.dtype,
+                                   kv_scale)
+                kpage = jnp.where(rows_p == r_in_page, kq,
+                                  k_buf[slot, pg, :])
+                vpage = jnp.where(rows_p == r_in_page, vq,
+                                  v_buf[slot, pg, :])
+                k_buf[slot, pg, :] = kpage
+                v_buf[slot, pg, :] = vpage
+                kwb[s_wb] = kpage
+                vwb[s_wb] = vpage
+                pltpu.make_async_copy(
+                    kwb.at[s_wb], k_hbm.at[g_star, :, lanes_of(j)],
+                    wbsem.at[s_wb, 0]).start()
+                pltpu.make_async_copy(
+                    vwb.at[s_wb], v_hbm.at[g_star, :, lanes_of(j)],
+                    wbsem.at[s_wb, 1]).start()
+                wb_meta[1 + s_wb] = g_star
+                wb_meta[1 + _WB_SLOTS + s_wb] = j
+                wb_meta[0] = n + 1
+
+        k = k_buf[slot]                              # [chunk, hb*d]
+        if k.dtype != jnp.bfloat16:                  # int8/fp8 KV dequant
+            k = k.astype(jnp.bfloat16)
+        s = jax.lax.dot_general(
+            q_packed, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)      # [rows, chunk]
+        pos = c * chunk_tokens + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        if slopes_ref is not None:
+            s = s + slopes_ref[0, :, :1] * pos.astype(jnp.float32)
+        live = pos < ctx
+        s = jnp.where(live, s, _NEG_INF)
+
+        m_prev = m_scr[:, :1]                        # [rows, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        corr = jnp.exp(m_prev - m_new)
+        p_exp = jnp.where(live, jnp.exp(s - m_new), 0.0)
+        l_prev = l_scr[:, :1]
+        l_new = l_prev * corr + jnp.sum(p_exp, axis=1, keepdims=True)
+
+        v = v_buf[slot]                              # [chunk, hb*d]
+        if v.dtype != jnp.bfloat16:                  # int8/fp8 KV dequant
+            v = v.astype(jnp.bfloat16)
+        pv = jax.lax.dot_general(
+            p_exp.astype(jnp.bfloat16), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)      # [rows, hb*d]
+        rh = jax.lax.broadcasted_iota(jnp.int32, (rows, d), 0) // group
+        pv_sel = jnp.zeros((rows, d), jnp.float32)
+        for h in range(hb):
+            pv_sel = pv_sel + jnp.where(rh == h,
+                                        pv[:, h * d:(h + 1) * d], 0.0)
+        acc_scr[...] = acc_scr[...] * corr + pv_sel
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+        # This row's final chunk (the next item belongs to another row
+        # — or is the -1 sentinel / dead padding): normalize and write
+        # the output block. Intermediate items leave out_ref alone; the
+        # out index map revisits the same block for adjacent same-row
+        # items, so the last write is the one that lands.
+        @pl.when(wi_seq_ref[w + 1] != s_idx)
+        def _():
+            l_final = l_scr[:, :1]
+            l_safe = jnp.where(l_final == 0.0, 1.0, l_final)
+            out_ref[0, 0] = (acc_scr[...] * (kv_scale / l_safe)).astype(
+                out_ref.dtype)
+
+    if fused_write:
+        # Drain: at most one outstanding writeback per ring slot (the
+        # n-th write waited the (n-WB)-th); the final cell waits each
+        # slot that was ever used.
+        @pl.when(cell == total_cells - 1)
+        def _():
+            n_end = wb_meta[0]
+            for kslot in range(_WB_SLOTS):
+                @pl.when(kslot < n_end)
+                def _(kslot=kslot):
+                    pgs = wb_meta[1 + kslot]
+                    pj = wb_meta[1 + _WB_SLOTS + kslot]
+                    pltpu.make_async_copy(
+                        kwb.at[kslot],
+                        k_hbm.at[pgs, :, lanes_of(pj)],
+                        wbsem.at[kslot, 0]).wait()
+                    pltpu.make_async_copy(
+                        vwb.at[kslot],
+                        v_hbm.at[pgs, :, lanes_of(pj)],
+                        wbsem.at[kslot, 1]).wait()
+
+
+def _ring_slots(pf_depth: int, chunk_tokens: int, lane_bytes: int) -> int:
+    """Read-ring depth: pf_depth+2 slots (a landing load must never
+    alias a live slot), trimmed to the VMEM budget when chunks are
+    large. Floor 3 keeps at least one chunk of cross-cell prefetch."""
+    n_slots = pf_depth + 2
+    per_slot = 2 * chunk_tokens * lane_bytes        # K + V
+    while n_slots > 3 and n_slots * per_slot > _RING_BUDGET_BYTES:
+        n_slots -= 1
+    return n_slots
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("scale", "kv_scale", "pages_per_chunk",
+    static_argnames=("scale", "kv_scale", "pages_per_chunk", "pf_depth",
                      "interpret"))
-def paged_decode_attention(
-    q: jax.Array,             # [batch, num_q_heads, head_dim]
-    k_pages: jax.Array,       # [num_pages, page_size, H * head_dim]
-    v_pages: jax.Array,
-    block_tables: jax.Array,  # [batch, pages_per_seq] int32, 0-padded
-    context_lens: jax.Array,  # [batch] int32
-    alibi_slopes: jax.Array = None,   # [num_q_heads] f32, optional
-    knew: jax.Array = None,   # [batch, Hkv, head_dim]: fused KV write
-    vnew: jax.Array = None,
-    *,
-    scale: float,
-    kv_scale: float = 1.0,
-    pages_per_chunk: int = 8,
-    interpret: bool = False,
+def _paged_decode_impl(
+    q, k_pages, v_pages, block_tables, context_lens, wi_seq, wi_chunk,
+    alibi_slopes, knew, vnew, *, scale, kv_scale, pages_per_chunk,
+    pf_depth, interpret,
 ):
-    """Token-major flash-decoding attention (see module docstring).
-
-    Without knew/vnew: returns attn_out [batch, Hq, d] over the given
-    pages (read-only). With knew/vnew: ALSO writes the current token
-    (position ctx-1 per sequence) into its page in place and returns
-    (attn_out, k_pages, v_pages) — the aliased, updated page arrays.
-    """
     batch, num_q_heads, head_dim = q.shape
     num_pages, page_size, hd = k_pages.shape
-    if hd % head_dim != 0:
-        raise ValueError(f"{hd=} not a multiple of {head_dim=}")
     num_kv_heads = hd // head_dim
     pages_per_seq = block_tables.shape[1]
-    if num_q_heads % num_kv_heads != 0:
-        raise ValueError(f"{num_q_heads=} % {num_kv_heads=}")
     group = num_q_heads // num_kv_heads
-    if pages_per_seq % pages_per_chunk != 0:
-        raise ValueError(
-            f"{pages_per_seq=} must be a multiple of {pages_per_chunk=} "
-            "(pad the block table).")
     hb = head_block(num_kv_heads)
     n_hb = num_kv_heads // hb
     rows = group * hb
     chunk_tokens = pages_per_chunk * page_size
     fused_write = knew is not None
+    ragged = wi_seq is not None
+    lane_bytes = hb * head_dim * k_pages.dtype.itemsize
+    single_chunk = pages_per_seq == pages_per_chunk
 
-    kernel = functools.partial(
-        _decode_kernel_tm,
-        hb=hb,
-        group=group,
-        head_dim=head_dim,
-        pages_per_chunk=pages_per_chunk,
-        page_size=page_size,
-        scale=scale,
-        kv_scale=kv_scale,
-        has_alibi=alibi_slopes is not None,
-        single_chunk=pages_per_seq == pages_per_chunk,
-        fused_write=fused_write,
-    )
     # q rows are kv-head-major, so the rows for head block j are the
     # contiguous slice [j*rows, (j+1)*rows).
     q_blocked = q.reshape(batch, n_hb, rows, head_dim)
+
+    if ragged:
+        # The dummy row (index batch): dead padding items and the last
+        # cell's out block land here; ctx 0 / page 0 keep its DMAs and
+        # masking inert, and the row is sliced off below.
+        q_blocked = jnp.concatenate(
+            [q_blocked, jnp.zeros((1,) + q_blocked.shape[1:],
+                                  q_blocked.dtype)])
+        block_tables = jnp.concatenate(
+            [block_tables, jnp.zeros((1, pages_per_seq), jnp.int32)])
+        context_lens = jnp.concatenate(
+            [context_lens, jnp.zeros((1,), jnp.int32)])
+        nw = wi_chunk.shape[0]
+        n_slots = _ring_slots(pf_depth, chunk_tokens, lane_bytes)
+        kernel = functools.partial(
+            _decode_kernel_ragged,
+            hb=hb, group=group, head_dim=head_dim,
+            pages_per_chunk=pages_per_chunk, page_size=page_size,
+            scale=scale, kv_scale=kv_scale,
+            pf_depth=min(pf_depth, n_slots - 2), chunk_slots=n_slots,
+            has_alibi=alibi_slopes is not None, fused_write=fused_write)
+        grid = (n_hb, nw)
+
+        def qmap(j, w, tbl, cl, ws, wc):
+            return (ws[w], j, 0, 0)
+
+        def smap(j, w, *_):
+            return (j, 0, 0)
+        num_prefetch = 4
+        prefetch = [block_tables, context_lens, wi_seq, wi_chunk]
+        out_rows = batch + 1
+    else:
+        n_slots = _ring_slots(pf_depth, chunk_tokens, lane_bytes) \
+            if single_chunk else 2
+        kernel = functools.partial(
+            _decode_kernel_tm,
+            hb=hb, group=group, head_dim=head_dim,
+            pages_per_chunk=pages_per_chunk, page_size=page_size,
+            scale=scale, kv_scale=kv_scale,
+            pf_depth=min(pf_depth, n_slots - 2) if single_chunk
+            else pf_depth,
+            chunk_slots=n_slots,
+            has_alibi=alibi_slopes is not None,
+            single_chunk=single_chunk, fused_write=fused_write)
+        grid = (batch, n_hb)
+
+        def qmap(b, j, *_):
+            return (b, j, 0, 0)
+
+        def smap(b, j, *_):
+            return (j, 0, 0)
+        num_prefetch = 2
+        prefetch = [block_tables, context_lens]
+        out_rows = batch
+
     in_specs = [
-        pl.BlockSpec((1, 1, rows, head_dim),
-                     lambda b, j, *_: (b, j, 0, 0)),
+        pl.BlockSpec((1, 1, rows, head_dim), qmap),
         pl.BlockSpec(memory_space=pl.ANY),
         pl.BlockSpec(memory_space=pl.ANY),
     ]
-    inputs = [block_tables, context_lens, q_blocked, k_pages, v_pages]
+    inputs = prefetch + [q_blocked, k_pages, v_pages]
+    kp_input_idx = len(prefetch) + 1
     if alibi_slopes is not None:
-        in_specs.append(
-            pl.BlockSpec((1, rows, 128), lambda b, j, *_: (j, 0, 0)))
+        in_specs.append(pl.BlockSpec((1, rows, 128), smap))
         inputs.append(jnp.broadcast_to(
             alibi_slopes.astype(jnp.float32).reshape(n_hb, rows, 1),
             (n_hb, rows, 128)))
@@ -464,15 +872,18 @@ def paged_decode_attention(
         # [batch, n_hb>1, hb*d] is not a legal Mosaic tiling.
         kn = knew.reshape(batch, n_hb, 1, hb * head_dim)
         vn = vnew.reshape(batch, n_hb, 1, hb * head_dim)
-        spec_new = pl.BlockSpec((1, 1, 1, hb * head_dim),
-                                lambda b, j, *_: (b, j, 0, 0))
+        if ragged:
+            kn = jnp.concatenate(
+                [kn, jnp.zeros((1,) + kn.shape[1:], kn.dtype)])
+            vn = jnp.concatenate(
+                [vn, jnp.zeros((1,) + vn.shape[1:], vn.dtype)])
+
+        def nmap(*a):
+            return qmap(*a)[:2] + (0, 0)
+        spec_new = pl.BlockSpec((1, 1, 1, hb * head_dim), nmap)
         in_specs.extend([spec_new, spec_new])
         inputs.extend([kn, vn])
 
-    # The multi-chunk path double-buffers (rem(c, 2)); only the
-    # single-chunk cross-cell pipeline uses the deeper prefetch ring —
-    # don't spend its VMEM otherwise.
-    n_slots = _CHUNK_SLOTS if pages_per_seq == pages_per_chunk else 2
     scratch = [
         pltpu.VMEM((n_slots, chunk_tokens, hb * head_dim),
                    k_pages.dtype),
@@ -483,10 +894,9 @@ def paged_decode_attention(
         pltpu.VMEM((rows, 128), jnp.float32),
         pltpu.VMEM((rows, 128), jnp.float32),
     ]
-    out_shape = [jax.ShapeDtypeStruct((batch, n_hb, rows, head_dim),
+    out_shape = [jax.ShapeDtypeStruct((out_rows, n_hb, rows, head_dim),
                                       q.dtype)]
-    out_specs = [pl.BlockSpec((1, 1, rows, head_dim),
-                              lambda b, j, *_: (b, j, 0, 0))]
+    out_specs = [pl.BlockSpec((1, 1, rows, head_dim), qmap)]
     io_aliases = {}
     if fused_write:
         scratch.extend([
@@ -496,19 +906,24 @@ def paged_decode_attention(
                        v_pages.dtype),
             pltpu.SemaphoreType.DMA((_WB_SLOTS, 2)),
         ])
+        if ragged:
+            # SMEM write-counter + per-slot (page, head block) of the
+            # outstanding writeback (see _decode_kernel_ragged).
+            scratch.append(pltpu.SMEM((1 + 2 * _WB_SLOTS,), jnp.int32))
         out_shape.extend([
             jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
             jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
         ])
         out_specs.extend([pl.BlockSpec(memory_space=pl.ANY),
                           pl.BlockSpec(memory_space=pl.ANY)])
-        # flattened inputs: 0=tables, 1=ctx, 2=q, 3=k_pages, 4=v_pages,
-        # then [slopes], knew, vnew
-        io_aliases = {3: 1, 4: 2}
+        # Flattened input indices of k_pages/v_pages alias kernel
+        # outputs 1/2 (indices shift by the two extra work-list scalar
+        # inputs under the ragged grid).
+        io_aliases = {kp_input_idx: 1, kp_input_idx + 1: 2}
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(batch, n_hb),
+        num_scalar_prefetch=num_prefetch,
+        grid=grid,
         in_specs=in_specs,
         out_specs=out_specs if fused_write else out_specs[0],
         scratch_shapes=scratch,
@@ -522,5 +937,67 @@ def paged_decode_attention(
     )(*inputs)
     if fused_write:
         out, kp, vp = result
-        return out.reshape(batch, num_q_heads, head_dim), kp, vp
-    return result.reshape(batch, num_q_heads, head_dim)
+        return (out[:batch].reshape(batch, num_q_heads, head_dim),
+                kp, vp)
+    return result[:batch].reshape(batch, num_q_heads, head_dim)
+
+
+def paged_decode_attention(
+    q: jax.Array,             # [batch, num_q_heads, head_dim]
+    k_pages: jax.Array,       # [num_pages, page_size, H * head_dim]
+    v_pages: jax.Array,
+    block_tables: jax.Array,  # [batch, pages_per_seq] int32, 0-padded
+    context_lens: jax.Array,  # [batch] int32
+    alibi_slopes: jax.Array = None,   # [num_q_heads] f32, optional
+    knew: jax.Array = None,   # [batch, Hkv, head_dim]: fused KV write
+    vnew: jax.Array = None,
+    *,
+    scale: float,
+    kv_scale: float = 1.0,
+    pages_per_chunk: int = 8,
+    work_items=None,          # (wi_seq [NW+1], wi_chunk [NW]) int32
+    interpret: bool = False,
+):
+    """Token-major flash-decoding attention (see module docstring).
+
+    Without knew/vnew: returns attn_out [batch, Hq, d] over the given
+    pages (read-only). With knew/vnew: ALSO writes the current token
+    (position ctx-1 per sequence) into its page in place and returns
+    (attn_out, k_pages, v_pages) — the aliased, updated page arrays.
+
+    work_items selects the ragged work-list grid (unless pinned off by
+    APHRODITE_ATTN_RAGGED=0): arrays from build_decode_work_list,
+    which MUST have been built with the same pages_per_chunk this call
+    resolves to (choose_pages_per_chunk / clamp_pages_per_chunk give a
+    consistent answer for a given table width). Without work_items the
+    classic padded (batch, n_hb) grid runs.
+
+    pages_per_chunk is clamped DOWN to the largest divisor of the
+    table width, so callers need not pre-pad block tables to a chunk
+    multiple."""
+    batch, num_q_heads, head_dim = q.shape
+    num_pages, page_size, hd = k_pages.shape
+    if hd % head_dim != 0:
+        raise ValueError(f"{hd=} not a multiple of {head_dim=}")
+    num_kv_heads = hd // head_dim
+    if num_q_heads % num_kv_heads != 0:
+        raise ValueError(f"{num_q_heads=} % {num_kv_heads=}")
+    pages_per_seq = block_tables.shape[1]
+    ppc = clamp_pages_per_chunk(pages_per_seq, pages_per_chunk)
+    pf_depth = _pf_depth()      # call-time env read + validation
+    use_ragged = work_items is not None and ragged_enabled()
+    if use_ragged:
+        wi_seq, wi_chunk = work_items
+        wi_seq = jnp.asarray(wi_seq, jnp.int32)
+        wi_chunk = jnp.asarray(wi_chunk, jnp.int32)
+        if wi_seq.shape[0] != wi_chunk.shape[0] + 1:
+            raise ValueError(
+                f"wi_seq must carry one trailing sentinel: "
+                f"{wi_seq.shape[0]=} != {wi_chunk.shape[0]=} + 1")
+    else:
+        wi_seq = wi_chunk = None
+    return _paged_decode_impl(
+        q, k_pages, v_pages, block_tables, context_lens, wi_seq,
+        wi_chunk, alibi_slopes, knew, vnew, scale=scale,
+        kv_scale=kv_scale, pages_per_chunk=ppc, pf_depth=pf_depth,
+        interpret=interpret)

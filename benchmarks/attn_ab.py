@@ -29,16 +29,21 @@ def main() -> None:
     ap.add_argument("--ctx", type=int, default=128)
     ap.add_argument("--page", type=int, default=32)
     ap.add_argument("--fused", action="store_true")
+    ap.add_argument("--ragged", action="store_true",
+                    help="use the ragged work-list grid (also "
+                         "gated by APHRODITE_ATTN_RAGGED)")
     args = ap.parse_args()
 
     import jax
     import jax.numpy as jnp
     from aphrodite_tpu.ops.pallas.paged_attention import (
-        paged_decode_attention)
+        build_decode_work_list, paged_decode_attention)
 
     B, ctx, PAGE = args.batch, args.ctx, args.page
     pages_per_seq = -(-ctx // PAGE)
     ppc = next(d for d in (8, 4, 2, 1) if pages_per_seq % d == 0)
+    work = build_decode_work_list([pages_per_seq] * B, ppc) \
+        if args.ragged else None
     num_pages = B * pages_per_seq + 1
     key = jax.random.PRNGKey(0)
     kp = jax.random.normal(
@@ -61,7 +66,7 @@ def main() -> None:
             qq, kpp, vpp = c
             o, kpp, vpp = paged_decode_attention(
                 qq, kpp, vpp, tables, ctx_lens, None, kn, kn,
-                scale=0.0884, pages_per_chunk=ppc)
+                scale=0.0884, pages_per_chunk=ppc, work_items=work)
             return (qq + o * jnp.bfloat16(1e-30), kpp, vpp)
         s, rtt, _ = device_bench(astep, (q3, kp, vp), donate=True)
     else:
@@ -69,10 +74,11 @@ def main() -> None:
             qq = c
             o = paged_decode_attention(
                 qq, kp, vp, tables, ctx_lens, None, scale=0.0884,
-                pages_per_chunk=ppc)
+                pages_per_chunk=ppc, work_items=work)
             return qq + o * jnp.bfloat16(1e-30)
         s, rtt = device_bench(astep, q3)
     tag = "fused" if args.fused else "read-only"
+    tag += "/ragged" if args.ragged else "/classic"
     print(f"decode_attn[{tag}] b={B} ctx={ctx} page={PAGE} ppc={ppc}: "
           f"{s * 1e6:.1f} us/call = {s * 32 * 1e3:.2f} ms/step(32L)  "
           f"{kv_bytes / s / 1e9:.0f} GB/s KV", flush=True)

@@ -252,18 +252,75 @@ def main() -> None:
     ctx_lens = jnp.full((B,), ctx, dtype=jnp.int32)
     q3 = jax.random.normal(key, (B, HEADS, HEAD_DIM), dtype=jnp.bfloat16)
     kv_bytes = 2 * B * KV_HEADS * ctx * HEAD_DIM * 2
-    for fname, fn in ((("tokenmajor", paged_decode_attention),)
-                      if want("attn") else []):
+    if want("attn"):
+        from aphrodite_tpu.ops.pallas.paged_attention import (
+            build_decode_work_list, choose_pages_per_chunk)
+        # Attribution row: the engine default (ragged work-list grid,
+        # built exactly as ModelRunner._prepare_decode does).
+        attr_ppc = choose_pages_per_chunk(pages_per_seq, PAGE, B)
+        attr_work = build_decode_work_list(
+            [-(-ctx // PAGE)] * B, attr_ppc)
 
-        def astep(c, i, fn=fn):
+        def astep(c, i):
             qq = c
-            o = fn(qq, kp, vp, tables, ctx_lens, None, scale=0.0884,
-                   pages_per_chunk=8)
+            o = paged_decode_attention(
+                qq, kp, vp, tables, ctx_lens, None, scale=0.0884,
+                pages_per_chunk=attr_ppc, work_items=attr_work)
             return qq + o * jnp.bfloat16(1e-30)
         s, rtt = device_bench(astep, q3)
         rtts.append(rtt)
-        row(f"decode_attn {fname} b={B} ctx={ctx}", s * 1e3, LAYERS,
+        row(f"decode_attn ragged b={B} ctx={ctx}", s * 1e3, LAYERS,
             f"{kv_bytes / s / 1e9:.0f} GB/s KV")
+
+        # Classic-vs-ragged A/B at the bench page-32 geometry (mirrors
+        # the W4A8 `--only ab` table): ctx 128 is the bench point
+        # (single-chunk), 512 and 2000 are the multi-chunk serving
+        # shapes the ragged grid targets. Batch shrinks with ctx so
+        # the KV pool stays within HBM.
+        ab_rows = []
+        PAGE32 = 32
+        for ab_ctx, ab_b in ((128, 512), (512, 256), (2000, 64)):
+            pps = -(-max(4, -(-ab_ctx // PAGE32)) // 4) * 4
+            npg = ab_b * pps + 1
+            kp32 = jax.random.normal(
+                key, (npg, PAGE32, KV_HEADS * HEAD_DIM),
+                dtype=jnp.bfloat16)
+            vp32 = jax.random.normal(
+                key, (npg, PAGE32, KV_HEADS * HEAD_DIM),
+                dtype=jnp.bfloat16)
+            tb32 = jnp.asarray(
+                (np.random.permutation(npg - 1) + 1)[:ab_b * pps]
+                .reshape(ab_b, pps), jnp.int32)
+            cl32 = jnp.full((ab_b,), ab_ctx, jnp.int32)
+            q32 = jax.random.normal(key, (ab_b, HEADS, HEAD_DIM),
+                                    dtype=jnp.bfloat16)
+            ab_ppc = choose_pages_per_chunk(pps, PAGE32, ab_b)
+            ab_work = build_decode_work_list(
+                [-(-ab_ctx // PAGE32)] * ab_b, ab_ppc)
+            ab_kv = 2 * ab_b * KV_HEADS * ab_ctx * HEAD_DIM * 2
+            us = {}
+            for label, wk in (("classic", None), ("ragged", ab_work)):
+                def abstep(c, i, kpp=kp32, vpp=vp32, tb=tb32,
+                           cl=cl32, wk=wk, ppc=ab_ppc):
+                    qq = c
+                    o = paged_decode_attention(
+                        qq, kpp, vpp, tb, cl, None, scale=0.0884,
+                        pages_per_chunk=ppc, work_items=wk)
+                    return qq + o * jnp.bfloat16(1e-30)
+                s, rtt = device_bench(abstep, q32)
+                rtts.append(rtt)
+                us[label] = s * 1e6
+                row(f"ATTN A/B {label} b={ab_b} ctx={ab_ctx} "
+                    f"page={PAGE32}", s * 1e3, LAYERS,
+                    f"{ab_kv / s / 1e9:.0f} GB/s KV")
+            ab_rows.append((ab_b, ab_ctx, us["classic"], us["ragged"]))
+        print(f"\n=== decode attention A/B "
+              f"(page {PAGE32}, us/layer, lower is better) ===")
+        print(f"{'batch':>6s} {'ctx':>6s} {'classic':>10s} "
+              f"{'ragged':>10s} {'speedup':>9s}")
+        for ab_b, ab_ctx, c_us, r_us in ab_rows:
+            print(f"{ab_b:6d} {ab_ctx:6d} {c_us:10.1f} {r_us:10.1f} "
+                  f"{c_us / r_us:8.2f}x")
 
     # --- KV page write ---
     fk = jax.random.normal(key, (B, KV_HEADS, HEAD_DIM),
@@ -789,7 +846,7 @@ def main() -> None:
     # FULL-layer cross-check (which already contains the components)
     # are reference rows, not addends.
     excluded = ("bf16 dense", "kv_write prefill-window", "FULL decoder",
-                "PREFILL", "BURST", "PROMPT", "W4A8")
+                "PREFILL", "BURST", "PROMPT", "W4A8", "ATTN A/B")
     for name, ms_call, n, ms_step, note in rows:
         print(f"{name:54s} {ms_call * 1e3:9.1f} {n:4d} {ms_step:8.3f}  "
               f"{note}")

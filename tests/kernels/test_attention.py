@@ -3,6 +3,10 @@ block tables in Python (mirrors the reference's
 ref_single_query_cached_kv_attention, tests/kernels/test_attention.py:45-99),
 plus the Pallas kernel in interpret mode vs the jnp reference.
 
+These tests pin the CLASSIC padded (batch, head-block) grid (the
+APHRODITE_ATTN_RAGGED=0 fallback); the ragged work-list grid and the
+routing/config satellites are covered in test_ragged_attention.py.
+
 KV pages are TOKEN-MAJOR: [num_pages, page_size, Hkv * head_dim]
 (heads collapsed into lanes — see ops/kv_cache.py)."""
 import jax.numpy as jnp

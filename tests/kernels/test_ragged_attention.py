@@ -1,0 +1,446 @@
+"""Ragged work-list decode attention: the flattened (sequence, chunk)
+grid vs the numpy oracle across ragged ctx mixes (multi-chunk, GQA head
+blocks, int8 KV, fused-write equivalence), plus the routing/config
+satellites: call-time APHRODITE_ATTN_PF validation, pages_per_chunk
+clamping, fused-write routing preconditions, and padded-table (page 0)
+masking."""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from aphrodite_tpu.ops.pallas import paged_attention as pa
+from aphrodite_tpu.ops.pallas.paged_attention import (
+    build_decode_work_list, choose_pages_per_chunk,
+    clamp_pages_per_chunk, paged_decode_attention)
+
+from test_attention import make_problem, numpy_paged_attention
+
+# A ragged serving-style mix: single-token, padded (ctx 0), multi-chunk
+# at several chunk counts, and a full-table row (page_size 8,
+# pages_per_seq 8 in make_problem geometry).
+RAGGED_CTX = np.array([1, 0, 40, 64, 17], dtype=np.int32)
+
+
+def ragged_problem(num_q_heads=8, num_kv_heads=2, ppc=2, seed=0):
+    q, kp, vp, bt, _ = make_problem(
+        batch=len(RAGGED_CTX), num_q_heads=num_q_heads,
+        num_kv_heads=num_kv_heads, dim=128, page_size=8,
+        pages_per_seq=8, pages=64, seed=seed)
+    ctx = RAGGED_CTX.copy()
+    pages_i = [-(-int(c) // 8) for c in ctx]
+    work = build_decode_work_list(pages_i, ppc)
+    return q, kp, vp, bt, ctx, work
+
+
+@pytest.mark.parametrize("num_q_heads,num_kv_heads,ppc",
+                         [(4, 4, 2),      # MHA, hb=4
+                          (8, 2, 2),      # GQA group 4
+                          (8, 1, 4),      # MQA
+                          (12, 12, 2),    # hb=6, n_hb=2 head blocks
+                          (8, 2, 8)])     # one chunk spans the table
+def test_ragged_matches_oracle_mixed_ctx(num_q_heads, num_kv_heads,
+                                         ppc):
+    """Ragged ctx mix incl. multi-chunk rows and a ctx=0 pad row (must
+    output exact zeros — its single masked work item still writes its
+    lane). Tolerance 1e-2: bf16 dot operands vs the f32 oracle, same
+    as the classic-kernel tests."""
+    q, kp, vp, bt, ctx, work = ragged_problem(num_q_heads,
+                                              num_kv_heads, ppc)
+    expected = numpy_paged_attention(q, kp, vp, bt,
+                                     np.maximum(ctx, 1), 0.1)
+    expected[ctx == 0] = 0.0
+    got = paged_decode_attention(
+        jnp.array(q), jnp.array(kp), jnp.array(vp), jnp.array(bt),
+        jnp.array(ctx), scale=0.1, pages_per_chunk=ppc,
+        work_items=work, interpret=True)
+    got = np.array(got)
+    np.testing.assert_allclose(got[ctx == 0], 0.0, atol=1e-6)
+    mask = ctx > 0
+    np.testing.assert_allclose(got[mask], expected[mask], rtol=1e-2,
+                               atol=1e-2)
+
+
+def test_ragged_reserved_pages_over_approximation():
+    """The model runner builds chunk counts from RESERVED pages (a
+    burst reserves pages past the live context), so work items whose
+    chunk lies wholly beyond ctx must be inert: fully-masked chunks
+    leave the online-softmax state untouched."""
+    q, kp, vp, bt, ctx, _ = ragged_problem()
+    # Every row claims the full 8-page reservation regardless of ctx.
+    work = build_decode_work_list([8] * len(ctx), 2)
+    expected = numpy_paged_attention(q, kp, vp, bt,
+                                     np.maximum(ctx, 1), 0.1)
+    expected[ctx == 0] = 0.0
+    got = paged_decode_attention(
+        jnp.array(q), jnp.array(kp), jnp.array(vp), jnp.array(bt),
+        jnp.array(ctx), scale=0.1, pages_per_chunk=2,
+        work_items=work, interpret=True)
+    got = np.array(got)
+    mask = ctx > 0
+    np.testing.assert_allclose(got[mask], expected[mask], rtol=1e-2,
+                               atol=1e-2)
+    np.testing.assert_allclose(got[~mask], 0.0, atol=1e-6)
+
+
+def test_ragged_int8_kv():
+    """int8 KV pages under the ragged grid: scale folds into score and
+    epilogue exactly as on the classic grid."""
+    q, kp, vp, bt, ctx, work = ragged_problem()
+    S = 0.05
+    k8 = np.clip(np.round(kp / S), -127, 127).astype(np.int8)
+    v8 = np.clip(np.round(vp / S), -127, 127).astype(np.int8)
+    expected = numpy_paged_attention(q, k8.astype(np.float32) * S,
+                                     v8.astype(np.float32) * S, bt,
+                                     np.maximum(ctx, 1), 0.1)
+    expected[ctx == 0] = 0.0
+    got = paged_decode_attention(
+        jnp.array(q), jnp.array(k8), jnp.array(v8), jnp.array(bt),
+        jnp.array(ctx), scale=0.1, kv_scale=S, pages_per_chunk=2,
+        work_items=work, interpret=True)
+    mask = ctx > 0
+    np.testing.assert_allclose(np.array(got)[mask], expected[mask],
+                               rtol=1e-2, atol=1e-2)
+
+
+def test_ragged_alibi():
+    q, kp, vp, bt, ctx, work = ragged_problem()
+    slopes = np.array([2.0 ** -(i + 1) for i in range(8)],
+                      dtype=np.float32)
+    expected = numpy_paged_attention(q, kp, vp, bt,
+                                     np.maximum(ctx, 1), 0.1,
+                                     alibi_slopes=slopes)
+    expected[ctx == 0] = 0.0
+    got = paged_decode_attention(
+        jnp.array(q), jnp.array(kp), jnp.array(vp), jnp.array(bt),
+        jnp.array(ctx), jnp.array(slopes), scale=0.1,
+        pages_per_chunk=2, work_items=work, interpret=True)
+    mask = ctx > 0
+    np.testing.assert_allclose(np.array(got)[mask], expected[mask],
+                               rtol=1e-2, atol=1e-2)
+
+
+@pytest.mark.parametrize("num_q_heads,num_kv_heads,ppc",
+                         [(8, 2, 2), (8, 2, 4),
+                          (12, 12, 2)])    # hb=6, n_hb=2: the write
+                                           # counter spans two j sweeps
+def test_ragged_fused_write_equals_separate_writer(num_q_heads,
+                                                   num_kv_heads, ppc):
+    """Fused KV injection on the ragged grid must equal
+    write-then-attend (the separate slot-mapped writer), both in
+    attention output and in the final page contents — the fused-write
+    vs separate-writer equivalence check of the acceptance criteria.
+    Covers multi-chunk rows (the write lands in chunk c_star only) and
+    a ctx=0 pad row (no write, zero output)."""
+    from aphrodite_tpu.ops.kv_cache import write_to_kv_cache
+    rng = np.random.default_rng(11)
+    q, kp, vp, bt, ctx, work = ragged_problem(num_q_heads,
+                                              num_kv_heads, ppc)
+    B, d = q.shape[0], 128
+    # Globally sequence-exclusive pages (the engine's decode contract).
+    perm = rng.permutation(kp.shape[0] - 1) + 1
+    for b in range(B):
+        n_pages = -(-int(max(ctx[b], 1)) // 8)
+        bt[b, :n_pages] = perm[b * 8:b * 8 + n_pages]
+    knew = rng.normal(size=(B, num_kv_heads, d)).astype(np.float32)
+    vnew = rng.normal(size=(B, num_kv_heads, d)).astype(np.float32)
+    slots = np.full((B,), kp.shape[0] * 8, dtype=np.int32)
+    for b in range(B):
+        if ctx[b] > 0:
+            pos = ctx[b] - 1
+            slots[b] = bt[b][pos // 8] * 8 + pos % 8
+    ref_k, ref_v = write_to_kv_cache(
+        jnp.asarray(knew), jnp.asarray(vnew), jnp.asarray(kp),
+        jnp.asarray(vp), jnp.asarray(slots))
+    want = numpy_paged_attention(q, np.asarray(ref_k),
+                                 np.asarray(ref_v), bt,
+                                 np.maximum(ctx, 1), 0.1)
+    want[ctx == 0] = 0.0
+    out, got_k, got_v = paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(bt), jnp.asarray(ctx), None, jnp.asarray(knew),
+        jnp.asarray(vnew), scale=0.1, pages_per_chunk=ppc,
+        work_items=work, interpret=True)
+    got = np.asarray(out)
+    mask = ctx > 0
+    np.testing.assert_allclose(got[mask], want[mask], rtol=1e-2,
+                               atol=1e-2)
+    np.testing.assert_allclose(got[~mask], 0.0, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got_k), np.asarray(ref_k),
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(got_v), np.asarray(ref_v),
+                               atol=1e-6)
+
+
+def test_ragged_padded_table_page0_masked():
+    """Padded block-table entries (page 0) beyond a row's real pages
+    must stay masked at ragged ctx mixes: poison page 0 with huge
+    values and check the mix still matches the oracle (which never
+    reads past ctx)."""
+    q, kp, vp, bt, ctx, work = ragged_problem()
+    kp = kp.copy()
+    vp = vp.copy()
+    kp[0] = 1e4
+    vp[0] = 1e4
+    # Rows' pad entries already point at page 0 (make_problem zeros).
+    expected = numpy_paged_attention(q, kp, vp, bt,
+                                     np.maximum(ctx, 1), 0.1)
+    expected[ctx == 0] = 0.0
+    for variant_work in (work, None):     # ragged AND classic grids
+        got = paged_decode_attention(
+            jnp.array(q), jnp.array(kp), jnp.array(vp), jnp.array(bt),
+            jnp.array(ctx), scale=0.1, pages_per_chunk=2,
+            work_items=variant_work, interpret=True)
+        got = np.array(got)
+        assert np.isfinite(got).all()
+        mask = ctx > 0
+        np.testing.assert_allclose(got[mask], expected[mask],
+                                   rtol=1e-2, atol=1e-2)
+        np.testing.assert_allclose(got[~mask], 0.0, atol=1e-6)
+
+
+def test_ragged_env_pin_selects_classic(monkeypatch):
+    """APHRODITE_ATTN_RAGGED=0 pins the classic grid even when a work
+    list is passed (the A/B escape hatch) — and the result still
+    matches."""
+    q, kp, vp, bt, ctx, work = ragged_problem()
+    calls = {}
+    real_impl = pa._paged_decode_impl
+
+    def spy(*a, **kw):
+        calls["wi_seq"] = a[5]
+        return real_impl(*a, **kw)
+    monkeypatch.setattr(pa, "_paged_decode_impl", spy)
+    monkeypatch.setenv("APHRODITE_ATTN_RAGGED", "0")
+    got = pa.paged_decode_attention(
+        jnp.array(q), jnp.array(kp), jnp.array(vp), jnp.array(bt),
+        jnp.array(ctx), scale=0.1, pages_per_chunk=2,
+        work_items=work, interpret=True)
+    assert calls["wi_seq"] is None      # classic grid ran
+    monkeypatch.setenv("APHRODITE_ATTN_RAGGED", "1")
+    pa.paged_decode_attention(
+        jnp.array(q), jnp.array(kp), jnp.array(vp), jnp.array(bt),
+        jnp.array(ctx), scale=0.1, pages_per_chunk=2,
+        work_items=work, interpret=True)
+    assert calls["wi_seq"] is not None  # ragged grid ran
+    expected = numpy_paged_attention(q, kp, vp, bt,
+                                     np.maximum(ctx, 1), 0.1)
+    mask = ctx > 0
+    np.testing.assert_allclose(np.array(got)[mask], expected[mask],
+                               rtol=1e-2, atol=1e-2)
+
+
+# ---- satellite: call-time APHRODITE_ATTN_PF ----
+
+def test_pf_depth_read_at_call_time(monkeypatch):
+    """A bad APHRODITE_ATTN_PF must fail the CALL, not the import (the
+    old module-level read killed every import and froze A/B sweeps to
+    one value per process)."""
+    import importlib
+    monkeypatch.setenv("APHRODITE_ATTN_PF", "banana")
+    importlib.reload(pa)                 # import survives a bad value
+    q, kp, vp, bt, ctx, _ = ragged_problem()
+    with pytest.raises(ValueError, match="APHRODITE_ATTN_PF"):
+        pa.paged_decode_attention(
+            jnp.array(q), jnp.array(kp), jnp.array(vp), jnp.array(bt),
+            jnp.array(ctx), scale=0.1, pages_per_chunk=2,
+            interpret=True)
+    monkeypatch.setenv("APHRODITE_ATTN_PF", "0")
+    with pytest.raises(ValueError, match=">= 1"):
+        pa.paged_decode_attention(
+            jnp.array(q), jnp.array(kp), jnp.array(vp), jnp.array(bt),
+            jnp.array(ctx), scale=0.1, pages_per_chunk=2,
+            interpret=True)
+    # Different depths are selectable in ONE process (no re-import).
+    monkeypatch.setenv("APHRODITE_ATTN_PF", "2")
+    assert pa._pf_depth() == 2
+    monkeypatch.setenv("APHRODITE_ATTN_PF", "7")
+    assert pa._pf_depth() == 7
+    monkeypatch.delenv("APHRODITE_ATTN_PF")
+    importlib.reload(pa)
+
+
+# ---- satellite: pages_per_chunk clamping ----
+
+def test_clamp_pages_per_chunk():
+    assert clamp_pages_per_chunk(12, 8) == 6
+    assert clamp_pages_per_chunk(8, 8) == 8
+    assert clamp_pages_per_chunk(7, 4) == 1
+    assert clamp_pages_per_chunk(64, 16) == 16
+    assert clamp_pages_per_chunk(6, 100) == 6
+    with pytest.raises(ValueError):
+        clamp_pages_per_chunk(8, 0)
+
+
+def test_non_divisor_ppc_clamps_instead_of_raising():
+    """pages_per_seq % pages_per_chunk != 0 used to raise; now the
+    chunk size clamps down to the largest divisor and the result still
+    matches the oracle."""
+    q, kp, vp, bt, ctx = make_problem(batch=3, num_q_heads=8,
+                                      num_kv_heads=2, dim=128,
+                                      page_size=4, pages_per_seq=12,
+                                      pages=64)
+    expected = numpy_paged_attention(q, kp, vp, bt, ctx, 0.1)
+    got = paged_decode_attention(
+        jnp.array(q), jnp.array(kp), jnp.array(vp), jnp.array(bt),
+        jnp.array(ctx), scale=0.1, pages_per_chunk=8,  # -> clamps to 6
+        interpret=True)
+    np.testing.assert_allclose(np.array(got), expected, rtol=1e-2,
+                               atol=1e-2)
+
+
+# ---- work-list builder ----
+
+def test_build_work_list_structure():
+    ws, wc = build_decode_work_list([1, 0, 5, 8], 2, pad_to=12)
+    # rows: 1 page -> 1 chunk; 0 pages -> 1 masked item; 5 -> 3; 8 -> 4
+    assert ws.tolist() == [0, 1, 2, 2, 2, 3, 3, 3, 3,  # 9 real items
+                           4, 4, 4,                    # dead: dummy row
+                           -1]                         # sentinel
+    assert wc.tolist() == [0, 0, 0, 1, 2, 0, 1, 2, 3, -1, -1, -1]
+
+
+def test_build_work_list_bucketing_and_errors():
+    ws, wc = build_decode_work_list([1] * 5, 2)
+    assert wc.shape[0] == 8 and ws.shape[0] == 9   # bucketed to 8
+    assert ws[-1] == -1
+    with pytest.raises(ValueError, match="pad_to"):
+        build_decode_work_list([4, 4], 2, pad_to=3)
+
+
+def test_choose_pages_per_chunk_policy():
+    assert choose_pages_per_chunk(4, 32, 512) == 4
+    assert choose_pages_per_chunk(8, 16, 512) == 8
+    # small-batch boost stops at 512-token chunks
+    assert choose_pages_per_chunk(64, 32, 1) == 16
+    assert choose_pages_per_chunk(64, 16, 1) == 32
+
+
+# ---- satellite: fused-write routing preconditions ----
+
+def _routing_layer(sliding_window):
+    from aphrodite_tpu.modeling.layers.attention import PagedAttention
+    layer = PagedAttention(8, 128, 0.1, num_kv_heads=2,
+                           sliding_window=sliding_window)
+    # Pretend the kernel path is available (CPU test hosts report
+    # backend != tpu); the ROUTING predicate is what's under test.
+    layer._pallas_decode_ok = lambda k_pages: True
+    return layer
+
+
+def test_sliding_window_routes_to_slot_mapped_writer():
+    """Sliding-window models write to a rotating ring slot; the fused
+    kernel derives the write position as ctx-1 — routing them to the
+    fused path would silently write the wrong page. They MUST take the
+    slot-mapped writer."""
+    from aphrodite_tpu.modeling.input_metadata import InputMetadata
+    meta = InputMetadata(
+        slot_mapping=jnp.zeros((2,), jnp.int32),
+        block_tables=jnp.zeros((2, 4), jnp.int32),
+        context_lens=jnp.ones((2,), jnp.int32),
+        is_prompt=False)
+    pages = jnp.zeros((4, 8, 2 * 128), jnp.bfloat16)
+    assert _routing_layer(None)._fused_decode_ok(pages, meta)
+    assert not _routing_layer(1024)._fused_decode_ok(pages, meta)
+    # Prompt steps and cache-less profiling runs never fuse either.
+    assert not _routing_layer(None)._fused_decode_ok(
+        pages, meta.replace(is_prompt=True))
+    assert not _routing_layer(None)._fused_decode_ok(None, meta)
+
+
+def test_layer_passes_work_list_to_kernel(monkeypatch):
+    """PagedAttention._decode must hand metadata.decode_work and the
+    runner's pages_per_chunk through to the kernel (and fall back to
+    the shared chunk policy when no list rides the metadata)."""
+    from aphrodite_tpu.modeling.input_metadata import InputMetadata
+    from aphrodite_tpu.modeling.layers.attention import PagedAttention
+    calls = {}
+
+    def fake_kernel(q3, kpp, vpp, tables, cl, slopes, knew=None,
+                    vnew=None, **kw):
+        calls.update(kw)
+        return jnp.zeros_like(q3)
+    monkeypatch.setattr(pa, "paged_decode_attention", fake_kernel)
+    layer = PagedAttention(8, 128, 0.1, num_kv_heads=2)
+    layer._pallas_decode_ok = lambda k_pages: True
+    pages = jnp.zeros((64, 8, 2 * 128), jnp.float32)
+    work = build_decode_work_list([2, 1], 2)
+    meta = InputMetadata(
+        slot_mapping=jnp.zeros((2,), jnp.int32),
+        block_tables=jnp.zeros((2, 8), jnp.int32),
+        context_lens=jnp.ones((2,), jnp.int32),
+        is_prompt=False,
+        decode_work=(jnp.asarray(work[0]), jnp.asarray(work[1])),
+        decode_ppc=2)
+    q = jnp.zeros((2, 1, 8 * 128), jnp.float32)
+    layer._decode(q, pages, pages, meta)
+    assert calls["pages_per_chunk"] == 2
+    assert calls["work_items"] is meta.decode_work
+    # Without a runner-built list: shared policy, no work items.
+    layer._decode(q, pages, pages, meta.replace(decode_work=None))
+    assert calls["work_items"] is None
+    assert calls["pages_per_chunk"] == choose_pages_per_chunk(8, 8, 2)
+
+
+# ---- model runner: work-list build inside the bucketed burst ----
+
+def test_model_runner_builds_consistent_work_list():
+    """_prepare_decode must emit a decode_work list consistent with
+    its padded tables: chunk counts from each row's REAL reserved
+    pages, the shared pages_per_chunk policy, padded rows one masked
+    item, dead padding to the bucketed length."""
+    from types import SimpleNamespace
+    from aphrodite_tpu.common.sampling_params import SamplingParams
+    from aphrodite_tpu.common.sequence import (SequenceData,
+                                               SequenceGroupMetadata)
+    from aphrodite_tpu.executor.model_runner import ModelRunner
+
+    runner = ModelRunner.__new__(ModelRunner)
+    runner.page_size = 16
+    runner.num_slots = 16 * 1024
+    runner.kv_scale = 1.0
+    runner.pages_bucket = 8
+    runner.model_config = SimpleNamespace(
+        get_sliding_window=lambda: None)
+
+    sp = SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True)
+    mds = []
+    # Ragged mix: 3, 40, and 150 tokens -> 1, 3, and 10 reserved pages.
+    for i, n_tok in enumerate((3, 40, 150)):
+        data = SequenceData(list(range(n_tok)))
+        n_pages = -(-n_tok // 16)
+        mds.append(SequenceGroupMetadata(
+            request_id=str(i), is_prompt=False,
+            seq_data={i: data}, sampling_params=sp,
+            block_tables={i: list(range(100 * i, 100 * i + n_pages))},
+            persistent_data={i: {}}))
+    inputs, _ = ModelRunner._prepare_decode(runner, mds)
+    meta = inputs["metadata"]
+    assert meta.decode_work is not None and meta.decode_ppc > 0
+    ws, wc = (np.asarray(meta.decode_work[0]),
+              np.asarray(meta.decode_work[1]))
+    padded_batch = inputs["input_ids"].shape[0]   # bucketed to 4
+    ppc = meta.decode_ppc
+    assert ppc == choose_pages_per_chunk(
+        meta.block_tables.shape[1], 16, padded_batch)
+    # Every padded row appears, chunks contiguous and chunk-ordered.
+    expected_chunks = [max(1, -(-p // ppc)) for p in (1, 3, 10)] + \
+        [1] * (padded_batch - 3)
+    seqs, chunks = [], []
+    for i, n in enumerate(expected_chunks):
+        seqs.extend([i] * n)
+        chunks.extend(range(n))
+    nw_real = len(seqs)
+    assert ws[:nw_real].tolist() == seqs
+    assert wc[:nw_real].tolist() == chunks
+    # Padding is dead items targeting the dummy row; sentinel closes.
+    assert (wc[nw_real:] == -1).all()
+    assert (ws[nw_real:-1] == padded_batch).all()
+    assert ws[-1] == -1
+    # The padded length follows the padded_batch * 2^k discipline.
+    assert wc.shape[0] % padded_batch == 0
+    # Work-item page walks stay inside the padded table width.
+    max_chunk = wc[:nw_real].max()
+    assert (max_chunk + 1) * ppc <= meta.block_tables.shape[1]

@@ -83,6 +83,23 @@ def main() -> int:
         np.float32)
     check("tokenmajor alibi", refa, gota)
 
+    # -- ragged work-list grid (compiled): mixed real chunk counts,
+    #    a ctx=0 row's masked item, dead list padding --
+    from aphrodite_tpu.ops.pallas.paged_attention import (
+        build_decode_work_list)
+    pages_i = [max(1, -(-int(c) // page)) for c in ctx_np]
+    for ppcr in (2, 4):
+        workr = build_decode_work_list(pages_i, ppcr)
+        gotr = np.asarray(paged_decode_attention(
+            q, kp, vp, bt, ctx, scale=scale, pages_per_chunk=ppcr,
+            work_items=workr), np.float32)
+        check(f"ragged ppc={ppcr} bf16", ref, gotr)
+    got8r = np.asarray(paged_decode_attention(
+        q, kp8, vp8, bt, ctx, scale=scale, kv_scale=S,
+        pages_per_chunk=2,
+        work_items=build_decode_work_list(pages_i, 2)), np.float32)
+    check("ragged int8 KV", ref8, got8r)
+
     # -- head 64/80: padded-lane decode (pages pad head_dim to 128) --
     for d_true in (64, 80):
         dp = 128
@@ -127,10 +144,17 @@ def main() -> int:
         ctx2 = jnp.asarray(ctx2_np)
         kn2 = jnp.asarray(rs.randn(B2, Hkv2, d2) * 0.1, jnp.bfloat16)
         vn2 = jnp.asarray(rs.randn(B2, Hkv2, d2) * 0.1, jnp.bfloat16)
-        for ppc2 in (2, pps2):             # chunked + single-chunk
+        for ppc2, grid in ((2, "classic"), (pps2, "classic"),
+                           (2, "ragged"), (pps2, "ragged")):
+            # Ragged work lists come from each row's RESERVED pages
+            # (the full table width here), the runner's discipline —
+            # chunks past ctx are masked, and the write counter ring
+            # must stay correct with one writer item per row.
+            work2 = build_decode_work_list([pps2] * B2, ppc2) \
+                if grid == "ragged" else None
             outf, kpf, vpf = paged_decode_attention(
                 q2, kp2, vp2, bt2, ctx2, knew=kn2, vnew=vn2,
-                scale=scale, pages_per_chunk=ppc2)
+                scale=scale, pages_per_chunk=ppc2, work_items=work2)
             ekp = np.asarray(kp2, np.float32).copy()
             evp = np.asarray(vp2, np.float32).copy()
             knf = np.asarray(kn2, np.float32).reshape(B2, Hkv2 * d2)
@@ -144,7 +168,7 @@ def main() -> int:
                 evp[pg, (c - 1) % page2] = vnf[i]
             errk = np.abs(np.asarray(kpf, np.float32) - ekp).max()
             errv = np.abs(np.asarray(vpf, np.float32) - evp).max()
-            name = f"fused-write contents {tag} ppc={ppc2}"
+            name = f"fused-write contents {tag} ppc={ppc2} {grid}"
             print(f"{name}: k err {errk:.2e} v err {errv:.2e}")
             if not (errk == 0.0 and errv == 0.0):   # bit-for-bit
                 failures.append((name, max(errk, errv)))
