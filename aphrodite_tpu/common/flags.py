@@ -1,0 +1,293 @@
+"""Central registry of APHRODITE_* runtime environment flags.
+
+Every environment flag the engine (or a bench harness) reads is
+declared here ONCE — typed, defaulted, documented — and read through
+the `get_bool`/`get_int`/`get_float`/`get_str` accessors at CALL time,
+never at import time. The static checker (`python -m tools.aphrocheck`,
+rule family FLAG*) enforces both halves of the contract over the whole
+tree: raw `os.environ` reads of APHRODITE_* names are findings, and so
+are registered-but-never-read or read-but-unregistered names. The
+registration calls below are PARSED STATICALLY by the checker (and by
+`--flags-md`, which generates the README table), so each one must stay
+a single literal `_register(Flag(...))` call.
+
+Validation policy (two deliberate tiers, one per failure class):
+
+- `strict=True` (numeric tuning knobs: tile caps, ring depths, scales):
+  a malformed value raises `FlagError` — a ValueError subclass whose
+  message names the flag and the offending text — at the READ site.
+  These knobs change compiled-kernel geometry; silently ignoring a typo
+  would run the wrong experiment. Reads happen per call, so a bad value
+  fails the call, never the import (the PR-2 `APHRODITE_ATTN_PF`
+  lesson).
+- `strict=False` (booleans and enumerated choices): a malformed value
+  warns and falls back to the default. A typo'd "ture" must never kill
+  a serving step.
+
+This module must import nothing from the rest of the package (the
+logger imports it).
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import warnings
+from typing import Any, Dict, Optional, Tuple
+
+
+class FlagError(ValueError):
+    """A strictly-validated APHRODITE_* flag carried a malformed value.
+
+    Subclasses ValueError so existing `except ValueError` call sites
+    (and tests matching the flag name in the message) keep working.
+    """
+
+
+@dataclasses.dataclass(frozen=True)
+class Flag:
+    """One registered environment flag.
+
+    default=None means the effective default is derived at the call
+    site (e.g. shape-dependent tile caps); such reads pass an explicit
+    `default=` to the accessor.
+    """
+    name: str
+    type: str                 # "bool" | "int" | "float" | "str"
+    default: Any
+    description: str
+    choices: Optional[Tuple[str, ...]] = None
+    minimum: Optional[float] = None
+    strict: bool = False
+    uppercase: bool = False   # normalize the raw value to upper-case
+
+
+_REGISTRY: Dict[str, Flag] = {}
+
+_TRUE_WORDS = ("1", "true", "yes", "on")
+_FALSE_WORDS = ("0", "false", "no", "off", "")
+
+
+def _register(flag: Flag) -> None:
+    if flag.name in _REGISTRY:
+        raise ValueError(f"duplicate flag registration: {flag.name}")
+    _REGISTRY[flag.name] = flag
+
+
+def registry() -> Dict[str, Flag]:
+    """Read-only view of every registered flag (tests, doc gen)."""
+    return dict(_REGISTRY)
+
+
+def is_set(name: str) -> bool:
+    """Whether the flag is present in the environment (registered
+    names only — a typo'd name is a programming error, not False)."""
+    _lookup(name)
+    return name in os.environ
+
+
+def _lookup(name: str) -> Flag:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise FlagError(
+            f"{name} is not a registered flag; add it to "
+            "aphrodite_tpu/common/flags.py") from None
+
+
+def _bad(flag: Flag, raw: str, why: str, default: Any) -> Any:
+    if flag.strict:
+        raise FlagError(f"{flag.name} {why}, got {raw!r}")
+    warnings.warn(
+        f"{flag.name} {why}, got {raw!r}; using default {default!r}",
+        RuntimeWarning, stacklevel=3)
+    return default
+
+
+def get_bool(name: str, default: Optional[bool] = None) -> bool:
+    """Per-call validated boolean read: 1/true/yes/on and
+    0/false/no/off (case-insensitive); anything else warns (or raises,
+    strict flags) and yields the default."""
+    flag = _lookup(name)
+    dflt = flag.default if default is None else default
+    raw = os.environ.get(name)
+    if raw is None:
+        return bool(dflt)
+    word = raw.strip().lower()
+    if word in _TRUE_WORDS:
+        return True
+    if word in _FALSE_WORDS:
+        return False
+    return bool(_bad(flag, raw, "must be a boolean (0/1/true/false)",
+                     dflt))
+
+
+def _get_number(name: str, default, caster, kind: str):
+    flag = _lookup(name)
+    dflt = flag.default if default is None else default
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return dflt
+    try:
+        value = caster(raw)
+    except ValueError:
+        return _bad(flag, raw, f"must be {kind}", dflt)
+    if flag.minimum is not None and value < flag.minimum:
+        return _bad(flag, raw, f"must be >= {flag.minimum:g}", dflt)
+    return value
+
+
+def get_int(name: str, default: Optional[int] = None) -> int:
+    """Per-call validated integer read; strict flags raise FlagError
+    on malformed values (never a bare int() ValueError mid-batch)."""
+    return _get_number(name, default, int, "an integer")
+
+
+def get_float(name: str, default: Optional[float] = None) -> float:
+    """Per-call validated float read (same contract as get_int)."""
+    return _get_number(name, default, float, "a number")
+
+
+def get_str(name: str, default: Optional[str] = None) -> Optional[str]:
+    """Per-call string read; flags declaring `choices` validate
+    membership (warn-and-default unless strict)."""
+    flag = _lookup(name)
+    dflt = flag.default if default is None else default
+    raw = os.environ.get(name)
+    if raw is None:
+        return dflt
+    if flag.uppercase:
+        raw = raw.strip().upper()
+    if flag.choices is not None and raw not in flag.choices:
+        return _bad(flag, raw,
+                    f"must be one of {'/'.join(map(repr, flag.choices))}",
+                    dflt)
+    return raw
+
+
+def flags_markdown() -> str:
+    """The README "Runtime flags" table (`python -m tools.aphrocheck
+    --flags-md` prints this)."""
+    rows = ["| Flag | Type | Default | Description |",
+            "| --- | --- | --- | --- |"]
+    for flag in sorted(_REGISTRY.values(), key=lambda f: f.name):
+        if flag.default is None:
+            dflt = "derived"
+        elif flag.type == "bool":
+            dflt = "1" if flag.default else "0"
+        elif flag.default == "":
+            dflt = "unset"
+        else:
+            dflt = f"`{flag.default}`"
+        rows.append(f"| `{flag.name}` | {flag.type} | {dflt} "
+                    f"| {flag.description} |")
+    return "\n".join(rows)
+
+
+# --------------------------------------------------------------------
+# Registrations. One literal _register(Flag(...)) per flag — parsed
+# statically by tools/aphrocheck (FLAG004/005/006 and --flags-md).
+# --------------------------------------------------------------------
+
+_register(Flag(
+    "APHRODITE_ATTN_PF", "int", 6,
+    "Decode-attention cross-cell DMA prefetch ring depth (cell i "
+    "starts cell i+depth's page loads); trimmed to the VMEM ring "
+    "budget at large chunk sizes.",
+    minimum=1, strict=True))
+
+_register(Flag(
+    "APHRODITE_ATTN_RAGGED", "bool", True,
+    "Ragged work-list decode-attention grid; 0 pins the classic "
+    "padded (batch, head-block) grid for A/B runs."))
+
+_register(Flag(
+    "APHRODITE_W4A8", "bool", False,
+    "GPTQ/AWQ int8-activation MXU path (weights stay int4 at rest; "
+    "per-row activation rounding is the only approximation). The "
+    "GPTQ/AWQ bench default; 0 selects the bit-exact W4A16 kernels."))
+
+_register(Flag(
+    "APHRODITE_QMM_DEFERRED", "str", "",
+    "Pin the W4A8 deferred-rescale kernel variant: 1 forces deferred "
+    "(int32 group accumulators, scales applied at k-tile flush), 0 "
+    "forces classic; unset autotunes by shape (deferred at m > 64).",
+    choices=("", "0", "1")))
+
+_register(Flag(
+    "APHRODITE_QMM_BLOCK_M", "int", None,
+    "Cap on the quant-matmul M tile (rows). Default is kernel-chosen "
+    "(512, or 256 with deferred-rescale accumulator planes).",
+    minimum=1, strict=True))
+
+_register(Flag(
+    "APHRODITE_QMM_BLOCK_N", "int", 0,
+    "Cap on the quant-matmul N tile (lanes); 0 = kernel default "
+    "(2048, or 1024 with deferred-rescale accumulator planes).",
+    minimum=0, strict=True))
+
+_register(Flag(
+    "APHRODITE_QMM_BLOCK_K", "int", 0,
+    "Cap on the quant-matmul K tile (contraction depth); 0 = kernel "
+    "default (1024; 512 for affine/LUT kernels, 2048 small-m W4A8).",
+    minimum=0, strict=True))
+
+_register(Flag(
+    "APHRODITE_QMM_DEFERRED_VMEM_MB", "int", 8,
+    "VMEM budget (MiB) for the deferred-rescale accumulator planes; "
+    "shapes that exceed it silently fall back to the classic kernel.",
+    minimum=1, strict=True))
+
+_register(Flag(
+    "APHRODITE_KV_SCALE", "float", None,
+    "int8 KV-cache dequant scale (owned by the CacheEngine, threaded "
+    "through InputMetadata.kv_scale). Default: ops/kv_quant.py's "
+    "DEFAULT_KV_SCALE.",
+    strict=True))
+
+_register(Flag(
+    "APHRODITE_COMPILE_CACHE", "str", "",
+    "JAX persistent compilation cache: 0 disables, a path redirects; "
+    "unset uses $XDG_CACHE_HOME/aphrodite_tpu/jax_cache (TPU backend "
+    "only — CPU test runs skip persisting)."))
+
+_register(Flag(
+    "APHRODITE_BURST_TIMING", "bool", False,
+    "Print per-burst device+sync timing lines from the scheduler/"
+    "executor hot path (profiling aid)."))
+
+_register(Flag(
+    "APHRODITE_DEBUG_KV", "bool", False,
+    "Enable the host-side sequence-exclusive-pages precondition check "
+    "for the pipelined decode KV writer (debugging aid)."))
+
+_register(Flag(
+    "APHRODITE_TPU_LOG_LEVEL", "str", "INFO",
+    "Root log level for the aphrodite_tpu logger.",
+    choices=("DEBUG", "INFO", "WARNING", "ERROR", "CRITICAL"),
+    uppercase=True))
+
+_register(Flag(
+    "APHRODITE_DISABLE_PALLAS_QUANT", "bool", False,
+    "Force the XLA dequantize-then-dot fallback instead of the fused "
+    "Pallas quant-matmul kernels (debugging / numerics triage)."))
+
+_register(Flag(
+    "APHRODITE_GGUF_EXACT", "bool", False,
+    "Keep the bit-exact per-format GGUF kernels for every block type "
+    "instead of the per-128-group int8 turbo requantization (Q8_0/"
+    "Q6_K stay exact either way)."))
+
+_register(Flag(
+    "APHRODITE_CACHE", "str", None,
+    "Download lock/cache directory for model resolution. Default: "
+    "~/.cache/aphrodite."))
+
+_register(Flag(
+    "APHRODITE_USE_MODELSCOPE", "bool", False,
+    "Resolve model paths via ModelScope snapshots instead of the "
+    "HuggingFace hub."))
+
+_register(Flag(
+    "APHRODITE_PSTEP", "str", "full,nokv,nosilu,nonorm,norope",
+    "Comma list of profile_step.py ablation variants to run (each "
+    "costs ~2 min of compiles; subset to fit shell timeouts)."))

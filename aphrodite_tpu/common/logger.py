@@ -3,8 +3,9 @@
 Plain stdlib logging with a compact format; no colorlog dependency.
 """
 import logging
-import os
 import sys
+
+from aphrodite_tpu.common import flags
 
 _FORMAT = "%(levelname)s %(asctime)s [%(name)s] %(message)s"
 _DATEFMT = "%H:%M:%S"
@@ -20,7 +21,9 @@ def _configure_root() -> None:
     handler.setFormatter(logging.Formatter(_FORMAT, datefmt=_DATEFMT))
     root = logging.getLogger("aphrodite_tpu")
     root.addHandler(handler)
-    root.setLevel(os.environ.get("APHRODITE_TPU_LOG_LEVEL", "INFO").upper())
+    # Registry-validated (warn-and-default INFO on a bad level — a
+    # typo'd env var must not break logging setup at first import).
+    root.setLevel(flags.get_str("APHRODITE_TPU_LOG_LEVEL"))
     root.propagate = False
     _root_configured = True
 

@@ -46,7 +46,8 @@ def _enable_compilation_cache() -> None:
     Opt out with APHRODITE_COMPILE_CACHE=0 or redirect with
     APHRODITE_COMPILE_CACHE=<dir>."""
     import os
-    loc = os.environ.get("APHRODITE_COMPILE_CACHE", "")
+    from aphrodite_tpu.common import flags
+    loc = flags.get_str("APHRODITE_COMPILE_CACHE")
     if loc == "0":
         return
     if not loc:
@@ -56,8 +57,8 @@ def _enable_compilation_cache() -> None:
             "aphrodite_tpu", "jax_cache")
     try:
         import jax
-        if jax.default_backend() == "cpu" and "APHRODITE_COMPILE_CACHE" \
-                not in os.environ:
+        if jax.default_backend() == "cpu" and \
+                not flags.is_set("APHRODITE_COMPILE_CACHE"):
             # CPU compiles are fast and local (tests/dev): persisting
             # every tiny program would just grow the cache unboundedly.
             return

@@ -86,10 +86,12 @@ class CacheEngine:
         # — no process-global (round-2 advisor finding).
         self.kv_scale = 1.0
         if cache_config.cache_dtype == "int8":
-            import os
+            from aphrodite_tpu.common import flags
             from aphrodite_tpu.ops.kv_quant import DEFAULT_KV_SCALE
-            self.kv_scale = float(os.environ.get(
-                "APHRODITE_KV_SCALE", str(DEFAULT_KV_SCALE)))
+            # Strict registry read: a typo'd value raises FlagError
+            # naming the flag instead of a bare float() ValueError.
+            self.kv_scale = flags.get_float(
+                "APHRODITE_KV_SCALE", default=DEFAULT_KV_SCALE)
 
         self.kv_caches: List[KVCache] = self._allocate_device()
         # Host swap pool: per layer [2, pages, page, heads_i*dim] numpy

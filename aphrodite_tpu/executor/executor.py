@@ -18,6 +18,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 import jax
 
+from aphrodite_tpu.common import flags
 from aphrodite_tpu.common.config import (CacheConfig, DeviceConfig,
                                          ModelConfig, ParallelConfig,
                                          SchedulerConfig)
@@ -306,9 +307,8 @@ class TPUExecutor:
             handle, kv = self.model_runner.dispatch_prompt(
                 prompt_metadata, kv)
         if handle is not None:
-            import os
             import time
-            timing = os.environ.get("APHRODITE_BURST_TIMING")
+            timing = flags.get_bool("APHRODITE_BURST_TIMING")
             t0 = time.perf_counter() if timing else 0.0
             bhandle, kv = self.model_runner.dispatch_burst(
                 decode_metadata, kv, num_steps, extra_cap)

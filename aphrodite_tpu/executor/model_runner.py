@@ -25,6 +25,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from aphrodite_tpu.common import flags
 from aphrodite_tpu.common.config import (ModelConfig, ParallelConfig,
                                          SchedulerConfig)
 from aphrodite_tpu.common.logger import init_logger
@@ -52,10 +53,6 @@ logger = init_logger(__name__)
 _DECODE_BATCH_BUCKETS = [1, 2, 4, 8, 12, 16, 24, 32, 48, 64, 96, 128,
                          192, 256, 384, 512]
 
-# Enables the (host-side) sequence-exclusive-pages precondition check
-# for the pipelined decode KV writer ("" or "0" = off).
-import os as _os
-_DEBUG_KV = _os.environ.get("APHRODITE_DEBUG_KV", "") not in ("", "0")
 _PREFILL_BATCH_BUCKETS = [1, 2, 4, 8, 16, 32]
 _PAGES_BUCKET = 8          # block-table width granularity (Pallas chunk)
 
@@ -545,8 +542,9 @@ class ModelRunner:
         # prefetches cell i+1's page before cell i's writeback lands, so
         # two tokens on one page would silently lose a write. CoW in
         # append_slot makes decode pages sequence-exclusive; this guards
-        # the precondition loudly when debugging (advisor r3).
-        if __debug__ and _DEBUG_KV:
+        # the precondition loudly when debugging (advisor r3). Read per
+        # call — a bad env value must never kill the import.
+        if __debug__ and flags.get_bool("APHRODITE_DEBUG_KV"):
             written = [s // self.page_size for s in slot_list]
             assert len(set(written)) == len(written), (
                 "decode slots share a page — sequence-exclusive-pages "
@@ -623,9 +621,8 @@ class ModelRunner:
         kv_caches: List[Tuple[jax.Array, jax.Array]],
         blocks_to_copy: Optional[Dict[int, List[int]]] = None,
     ) -> Tuple[SamplerOutput, List[Tuple[jax.Array, jax.Array]]]:
-        import os as _os
         import time as _time
-        timing = _os.environ.get("APHRODITE_BURST_TIMING")
+        timing = flags.get_bool("APHRODITE_BURST_TIMING")
         t0 = _time.perf_counter() if timing else 0.0
         kv_caches = self._apply_block_copies(kv_caches, blocks_to_copy)
 
@@ -773,9 +770,8 @@ class ModelRunner:
         kv_caches = self._apply_block_copies(kv_caches, blocks_to_copy)
         handle, kv_caches = self.dispatch_burst(
             seq_group_metadata_list, kv_caches, num_steps, extra_cap)
-        import os as _os
         import time as _time
-        timing = _os.environ.get("APHRODITE_BURST_TIMING")
+        timing = flags.get_bool("APHRODITE_BURST_TIMING")
         t1 = _time.perf_counter() if timing else 0.0
         all_packed = np.asarray(handle.packed)             # ONE sync
         t2 = _time.perf_counter() if timing else 0.0

@@ -105,13 +105,14 @@ def resolve_model_path(model_path: str) -> str:
     snapshot_download)."""
     if os.path.isdir(model_path) or os.path.isfile(model_path):
         return model_path
-    lock_dir = os.environ.get("APHRODITE_CACHE",
-                              os.path.expanduser("~/.cache/aphrodite"))
+    from aphrodite_tpu.common import flags
+    lock_dir = flags.get_str(
+        "APHRODITE_CACHE",
+        default=os.path.expanduser("~/.cache/aphrodite"))
     os.makedirs(lock_dir, exist_ok=True)
     lock_path = os.path.join(
         lock_dir, model_path.replace("/", "--") + ".lock")
-    if os.environ.get("APHRODITE_USE_MODELSCOPE", "").lower() in (
-            "1", "true"):
+    if flags.get_bool("APHRODITE_USE_MODELSCOPE"):
         # Reference hf_downloader.py:30-41: ModelScope replaces the HF
         # hub when requested. Same lock: replicas download once.
         try:

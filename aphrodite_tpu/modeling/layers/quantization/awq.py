@@ -115,7 +115,7 @@ class AWQLinearMethod(LinearMethod):
         in_features, n_packed = qw.shape
         lead = x.shape[:-1]
         if jax.default_backend() == "tpu":
-            import os
+            from aphrodite_tpu.common import flags
             from aphrodite_tpu.ops.pallas.quant_matmul import (
                 awq_matmul, awq_matmul_a8, awq_supported)
             if awq_supported(in_features, n_packed * 8, cfg.group_size):
@@ -124,8 +124,8 @@ class AWQLinearMethod(LinearMethod):
                 # (AWQ is always 4-bit, so no bits gate needed). The a8
                 # kernel auto-selects classic vs deferred-rescale per
                 # shape; APHRODITE_QMM_DEFERRED pins it for A/B runs.
-                mm = awq_matmul_a8 if os.environ.get(
-                    "APHRODITE_W4A8") == "1" else awq_matmul
+                mm = awq_matmul_a8 if flags.get_bool(
+                    "APHRODITE_W4A8") else awq_matmul
                 y = mm(x.reshape(-1, in_features), qw,
                        params["qzeros"], params["scales"],
                        group_size=cfg.group_size)

@@ -144,8 +144,8 @@ def gguf_turbo() -> bool:
     grouped-int8 form instead (see load_weight). APHRODITE_GGUF_EXACT=1
     keeps the bit-exact per-format kernels for every format (Q4_K
     affine rows at round-4 throughput, 0.68x reference)."""
-    import os
-    return os.environ.get("APHRODITE_GGUF_EXACT", "") in ("", "0")
+    from aphrodite_tpu.common import flags
+    return not flags.get_bool("APHRODITE_GGUF_EXACT")
 
 
 def dense_to_w8(w: np.ndarray, scale_dtype=np.float32

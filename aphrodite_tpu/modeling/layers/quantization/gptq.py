@@ -129,7 +129,7 @@ class GPTQLinearMethod(LinearMethod):
         in_features = params["g_idx"].shape[0]
         out_features = params["scales"].shape[1]
         if self._use_pallas(in_features, out_features):
-            import os
+            from aphrodite_tpu.common import flags
             from aphrodite_tpu.ops.pallas.quant_matmul import (
                 gptq_matmul, gptq_matmul_a8)
             lead = x.shape[:-1]
@@ -144,7 +144,7 @@ class GPTQLinearMethod(LinearMethod):
             # APHRODITE_QMM_DEFERRED=1/0 pins it for A/B runs (see the
             # quant_matmul module docstring).
             mm = gptq_matmul_a8 if (
-                os.environ.get("APHRODITE_W4A8") == "1" and
+                flags.get_bool("APHRODITE_W4A8") and
                 cfg.weight_bits == 4) else gptq_matmul
             y = mm(
                 x.reshape(-1, in_features), params["qweight"],
@@ -162,8 +162,8 @@ class GPTQLinearMethod(LinearMethod):
         """Fused dequant-matmul kernel on TPU; the XLA dequantize-then-dot
         fallback everywhere else (it materializes the full bf16 weight in
         HBM every call — ~9x the traffic at int4 7B scale)."""
-        import os
-        if os.environ.get("APHRODITE_DISABLE_PALLAS_QUANT"):
+        from aphrodite_tpu.common import flags
+        if flags.get_bool("APHRODITE_DISABLE_PALLAS_QUANT"):
             return False
         from aphrodite_tpu.ops.pallas.quant_matmul import gptq_supported
         return (jax.default_backend() == "tpu" and

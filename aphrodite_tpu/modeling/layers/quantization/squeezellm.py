@@ -104,8 +104,8 @@ class SqueezeLLMLinearMethod(LinearMethod):
         """Fused LUT kernel on TPU (codes stay packed in HBM); the XLA
         gather fallback everywhere else re-materializes the dense
         weight every step."""
-        import os
-        if os.environ.get("APHRODITE_DISABLE_PALLAS_QUANT"):
+        from aphrodite_tpu.common import flags
+        if flags.get_bool("APHRODITE_DISABLE_PALLAS_QUANT"):
             return False
         from aphrodite_tpu.ops.pallas.quant_matmul import (
             squeezellm_supported)

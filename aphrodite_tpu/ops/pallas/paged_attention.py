@@ -72,7 +72,6 @@ quantize the injected token into stored units first.
 from __future__ import annotations
 
 import functools
-import os
 
 import numpy as np
 
@@ -80,6 +79,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from aphrodite_tpu.common import flags
 
 _NEG_INF = -2.0**30  # large-but-finite: avoids inf-inf NaNs in corrections
 
@@ -104,23 +105,17 @@ def _pf_depth() -> int:
     """Cross-cell read-pipeline depth (cell i starts cell i+depth's
     chunk loads). Read from APHRODITE_ATTN_PF at CALL time — reading
     and validating at import killed every import on a bad env var and
-    forced a re-import per A/B sweep point."""
-    raw = os.environ.get("APHRODITE_ATTN_PF", "6")
-    try:
-        depth = int(raw)
-    except ValueError as e:
-        raise ValueError(
-            f"APHRODITE_ATTN_PF must be an integer, got {raw!r}") from e
-    if depth < 1:
-        raise ValueError(f"APHRODITE_ATTN_PF must be >= 1, got {depth}")
-    return depth
+    forced a re-import per A/B sweep point. The registry's strict
+    validation raises FlagError (a ValueError naming the flag) on a
+    malformed or < 1 value."""
+    return flags.get_int("APHRODITE_ATTN_PF")
 
 
 def ragged_enabled() -> bool:
     """APHRODITE_ATTN_RAGGED=0 pins the classic padded-grid kernel
     (the A/B fallback); anything else (or unset) allows the ragged
     work-list grid when the caller supplies work_items."""
-    return os.environ.get("APHRODITE_ATTN_RAGGED", "1") != "0"
+    return flags.get_bool("APHRODITE_ATTN_RAGGED")
 
 
 def head_block(num_kv_heads: int) -> int:

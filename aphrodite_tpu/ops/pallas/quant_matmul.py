@@ -48,13 +48,14 @@ when the extra int32 planes don't fit the VMEM budget
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from aphrodite_tpu.common import flags
 
 # jax 0.4.x names the TPU compiler-params dataclass TPUCompilerParams;
 # 0.5+ renames it CompilerParams. Resolve once so every kernel in this
@@ -115,16 +116,15 @@ def _tile_mn(m: int, N: int, dtype, min_bn: int = 128,
     holds that many EXTRA int32 accumulator planes in VMEM, so the
     default m/n caps halve (256 x 1024) to pay for them."""
     sublane = 16 if dtype == jnp.bfloat16 else 8
-    bm_default = "512" if acc_planes <= 1 else "256"
-    bm_cap = int(os.environ.get("APHRODITE_QMM_BLOCK_M", bm_default))
+    bm_default = 512 if acc_planes <= 1 else 256
+    bm_cap = flags.get_int("APHRODITE_QMM_BLOCK_M", default=bm_default)
     bm_cap = max(sublane, bm_cap // sublane * sublane)
     block_m = min(bm_cap, -(-m // sublane) * sublane)
     # Full-width lane tiles at every m: the round-2 A/B that capped
     # large-batch tiles at 1024 predates the W4A8 kernels (int8 tiles
     # take half the VMEM); re-measured round 4 at 2048 = +2% bench.
     bn_default = 2048 if acc_planes <= 1 else 1024
-    bn_cap = int(os.environ.get("APHRODITE_QMM_BLOCK_N", "0")) or \
-        bn_default
+    bn_cap = flags.get_int("APHRODITE_QMM_BLOCK_N") or bn_default
     block_n = max((bn for bn in (2048, 1024, 512, 256, 128)
                    if N % bn == 0), default=0)
     if block_n < min_bn:
@@ -144,7 +144,7 @@ def _tile_k(K: int, gs: int, cap: int = 0) -> int:
         # 1024 at every m (round-4 A/B: +2% bench over 512 at batch
         # 512 — fewer grid cells beats the extra VMEM).
         cap = 1024
-    cap = int(os.environ.get("APHRODITE_QMM_BLOCK_K", "0")) or cap
+    cap = flags.get_int("APHRODITE_QMM_BLOCK_K") or cap
     block_k = gs
     while block_k < cap and K % (block_k * 2) == 0:
         block_k *= 2
@@ -165,7 +165,7 @@ def _resolve_deferred(deferred, m: int) -> bool:
     2048-deep k-tiles' grid-cell savings dominate (LATENCY_r05)."""
     if deferred is not None:
         return bool(deferred)
-    env = os.environ.get("APHRODITE_QMM_DEFERRED", "")
+    env = flags.get_str("APHRODITE_QMM_DEFERRED")
     if env in ("0", "1"):
         return env == "1"
     return m > 64
@@ -176,8 +176,7 @@ def _deferred_fits(block_m: int, block_n: int, gpt: int) -> bool:
     the f32 plane) fit the scoped-VMEM budget next to the streamed
     x/weight/zero/scale blocks; outside it the wrappers silently fall
     back to the classic kernel."""
-    budget_mb = int(os.environ.get("APHRODITE_QMM_DEFERRED_VMEM_MB",
-                                   "8"))
+    budget_mb = flags.get_int("APHRODITE_QMM_DEFERRED_VMEM_MB")
     return (gpt * 4 + 4) * block_m * block_n <= budget_mb << 20
 
 

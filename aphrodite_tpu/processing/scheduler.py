@@ -26,6 +26,7 @@ import time
 from collections import deque
 from typing import Deque, Dict, Iterable, List, Optional, Tuple, Union
 
+from aphrodite_tpu.common import flags
 from aphrodite_tpu.common.config import (CacheConfig, LoRAConfig,
                                          SchedulerConfig)
 from aphrodite_tpu.common.logger import init_logger
@@ -629,9 +630,8 @@ class Scheduler:
             if needed > free:
                 break
             granted = t
-        import os
-        if granted < max_extra and os.environ.get(
-                "APHRODITE_BURST_TIMING"):
+        if granted < max_extra and \
+                flags.get_bool("APHRODITE_BURST_TIMING"):
             need_full = sum(
                 self.block_manager.burst_blocks_needed(
                     seq, cap(seq, max_extra))

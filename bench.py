@@ -109,8 +109,9 @@ def main() -> None:
             # tp=8 is the bf16 north-star config (weights shard
             # 8-ways, so no quantization needed to fit KV).
             os.environ["BENCH_QUANT"] = "" if tp > 1 else "gptq"
+        from aphrodite_tpu.common import flags
         if os.environ.get("BENCH_QUANT") in ("gptq", "awq") and \
-                "APHRODITE_W4A8" not in os.environ:
+                not flags.is_set("APHRODITE_W4A8"):
             # The GPTQ/AWQ bench rows run the int8-activation MXU path
             # (weights stay int4 at rest; activations round to int8
             # per row — the reference's exllama kernel likewise
@@ -264,8 +265,8 @@ def main() -> None:
         tag += f"_tp{tp}"
     # Activation mode rides in the JSON so W4A8 and W4A16 runs can't
     # be conflated round-over-round.
-    act_mode = "w4a8" if os.environ.get("APHRODITE_W4A8") == "1" \
-        else "w4a16"
+    from aphrodite_tpu.common import flags
+    act_mode = "w4a8" if flags.get_bool("APHRODITE_W4A8") else "w4a16"
     act_applies = quant in ("gptq", "awq")
     # quant/batch/kv ride in the JSON so round-over-round comparisons
     # can't conflate differently-configured runs (round-2 advisor).

@@ -782,8 +782,8 @@ def main() -> None:
         # Each variant costs ~2 min (model build + two trip-count
         # compiles of the full 8k step); APHRODITE_PSTEP variants=
         # comma list selects a subset so runs fit the shell timeout.
-        wanted = os.environ.get(
-            "APHRODITE_PSTEP", "full,nokv,nosilu,nonorm,norope").split(",")
+        from aphrodite_tpu.common import flags
+        wanted = flags.get_str("APHRODITE_PSTEP").split(",")
         if "full" in wanted:
             measure_pstep(f"{PB}x{PS} (8k tok, 32L)")
         if "nokv" in wanted:

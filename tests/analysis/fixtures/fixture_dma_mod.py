@@ -1,0 +1,15 @@
+"""Seeds DMA002: one semaphore array ring-indexed with two different
+moduli on the same path (depth-4 starts, depth-2 waits — the n-th
+wait frees the wrong slot)."""
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def mismatched_ring_kernel(x_hbm, o_ref, buf, sems):
+    i = pl.program_id(0)
+    slot = jax.lax.rem(i, 4)
+    pltpu.make_async_copy(x_hbm, buf.at[slot], sems.at[slot]).start()
+    prev = jax.lax.rem(i, 2)
+    pltpu.make_async_copy(x_hbm, buf.at[prev], sems.at[prev]).wait()
+    o_ref[...] = buf[slot]

@@ -1,0 +1,31 @@
+"""Seeds DMA003: the kernel's ring modulus (_RING = 4, a module
+constant both sides can resolve) wraps past the 2-entry
+SemaphoreType.DMA scratch at the pallas_call site."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_RING = 4
+
+
+def _ring_kernel(x_ref, o_ref, buf, sems):
+    i = pl.program_id(0)
+    slot = jax.lax.rem(i, _RING)
+    pltpu.make_async_copy(x_ref, buf.at[slot], sems.at[slot]).start()
+    pltpu.make_async_copy(x_ref, buf.at[slot], sems.at[slot]).wait()
+    o_ref[...] = buf[slot]
+
+
+def ring(x):
+    return pl.pallas_call(
+        _ring_kernel,
+        grid=(8,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((64, 128), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((2, 8, 128), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )(x)

@@ -1,0 +1,8 @@
+"""Seeds DMA001: an async copy started and never waited (the
+in-flight DMA outlives the kernel)."""
+from jax.experimental.pallas import tpu as pltpu
+
+
+def leaky_kernel(x_hbm, o_ref, buf, sem):
+    pltpu.make_async_copy(x_hbm, buf, sem).start()
+    o_ref[...] = buf[...]

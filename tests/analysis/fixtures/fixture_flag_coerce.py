@@ -1,0 +1,7 @@
+"""Seeds FLAG003: an unvalidated int() coercion wrapped around a raw
+env read (a typo'd value raises a bare ValueError mid-batch)."""
+import os
+
+
+def block_m() -> int:
+    return int(os.environ.get("APHRODITE_FIXTURE_COERCE", "512"))

@@ -1,0 +1,10 @@
+"""Seeds FLAG002: an import-time (module-scope) flag read — through
+the registry accessor, so FLAG001 stays quiet (the rule is about
+WHEN the read happens, not how)."""
+from aphrodite_tpu.common import flags
+
+_DEBUG = flags.get_bool("APHRODITE_DEBUG_KV")
+
+
+def debug_enabled() -> bool:
+    return _DEBUG

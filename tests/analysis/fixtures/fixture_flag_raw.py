@@ -1,0 +1,7 @@
+"""Seeds FLAG001: a raw os.environ read of an APHRODITE_* name
+(per-call, so FLAG002 stays quiet; no coercion, so FLAG003 does)."""
+import os
+
+
+def read_depth() -> str:
+    return os.environ.get("APHRODITE_FIXTURE_RAW", "1")

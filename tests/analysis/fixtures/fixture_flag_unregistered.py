@@ -1,0 +1,7 @@
+"""Seeds FLAG005: a registry-accessor read of a name no registration
+declares (the typo class the registry exists to catch)."""
+from aphrodite_tpu.common import flags
+
+
+def read_missing() -> int:
+    return flags.get_int("APHRODITE_FIXTURE_MISSING", default=0)

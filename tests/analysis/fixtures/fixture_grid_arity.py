@@ -1,0 +1,20 @@
+"""Seeds GRID001: a 2-d grid whose in_spec index map takes only one
+parameter (the out_spec's two-parameter map is correct and must stay
+quiet)."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _copy_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def mismatched(x):
+    return pl.pallas_call(
+        _copy_kernel,
+        grid=(4, 4),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((32, 512), jnp.float32),
+    )(x)

@@ -1,0 +1,26 @@
+"""A stand-in flags registry for the FLAG004/FLAG006 fixture tests
+(passed to the checker as `flags_rel`; parsed statically, never
+imported — the stub defs below only make the file self-consistent).
+
+Seeds:
+- FLAG004: APHRODITE_FIXTURE_UNUSED is registered but no fixture
+  reads it.
+- FLAG006: APHRODITE_FIXTURE_UNDOC is registered with an empty
+  description.
+"""
+
+
+class Flag:  # noqa: D401 — stub, the checker reads the AST only
+    def __init__(self, *args, **kwargs):
+        pass
+
+
+def _register(flag):
+    pass
+
+
+_register(Flag("APHRODITE_FIXTURE_UNUSED", "int", 1,
+               "registered, documented, and read by nobody"))
+_register(Flag("APHRODITE_FIXTURE_UNDOC", "bool", False, ""))
+_register(Flag("APHRODITE_FIXTURE_USED", "bool", False,
+               "read by fixture_registry_reader"))

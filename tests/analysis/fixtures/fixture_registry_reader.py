@@ -1,0 +1,11 @@
+"""Reads two of fixture_registry's flags (per call) so only
+APHRODITE_FIXTURE_UNUSED triggers FLAG004 there."""
+from aphrodite_tpu.common import flags
+
+
+def read_used() -> bool:
+    return flags.get_bool("APHRODITE_FIXTURE_USED")
+
+
+def read_undoc() -> bool:
+    return flags.get_bool("APHRODITE_FIXTURE_UNDOC")

@@ -1,0 +1,6 @@
+"""Seeds SYNC001: .item() in a hot-path (execute_*) function — one
+host sync per element."""
+
+
+def execute_model(handle):
+    return handle.packed.item()

@@ -1,0 +1,10 @@
+"""Seeds SYNC002: np.asarray in a loop in a hot-path function (one
+device sync per iteration; no prior bulk device_get to exempt it)."""
+import numpy as np
+
+
+def execute_model(handles):
+    outs = []
+    for h in handles:
+        outs.append(np.asarray(h.packed))
+    return outs

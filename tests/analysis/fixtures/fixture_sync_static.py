@@ -1,0 +1,15 @@
+"""Seeds SYNC003: a list comprehension passed to a parameter
+declared static at jit time (unhashable static arg — a TypeError at
+call time, or a retrace per call)."""
+import jax
+
+
+def _step(x, tables=None):
+    return x
+
+
+_step_fn = jax.jit(_step, static_argnames=("tables",))
+
+
+def execute_model(x, tables):
+    return _step_fn(x, tables=[t for t in tables])

@@ -1,0 +1,22 @@
+"""Seeds VMEM001: a pallas_call whose scratch alone is provably
+32 MiB (4096 x 2048 f32) — double the 16 MiB per-core budget — with
+no fit-guarded fallback in the enclosing function."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(x_ref, o_ref, acc_ref):
+    o_ref[...] = x_ref[...]
+
+
+def oversized(x):
+    return pl.pallas_call(
+        _kernel,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((4096, 2048), jnp.float32)],
+    )(x)

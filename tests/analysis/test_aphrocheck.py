@@ -1,0 +1,208 @@
+"""Tier-1 gate + self-tests for the aphrocheck static analysis suite.
+
+Three layers:
+
+1. THE GATE: every pass over the real tree (`aphrodite_tpu/`,
+   `bench.py`, `benchmarks/`) must produce zero non-allowlisted
+   findings, the allowlist must hold at most 5 entries, and none of
+   them may be stale.
+2. Seeded-violation fixtures: each rule fires EXACTLY ONCE on its
+   fixture module in tests/analysis/fixtures/ (proving the pass
+   detects what it claims — a checker that never fires is worse than
+   no checker).
+3. Mechanics: allowlist suppression + stale detection, and the CLI
+   (`python -m tools.aphrocheck`) JSON / flags-md surfaces.
+
+Pure AST — no JAX device work; runs under JAX_PLATFORMS=cpu in
+tier-1 and in CI.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tools.aphrocheck import DEFAULT_ALLOWLIST, build_context, run
+from tools.aphrocheck.core import (FLAGS_MODULE, REPO_ROOT, Allowlist,
+                                   collect_files)
+from tools.aphrocheck.passes import (dma_pass, flag_pass, grid_pass,
+                                     sync_pass, vmem_pass)
+from tools.aphrocheck.registry import parse_registry
+
+FIXDIR = os.path.join("tests", "analysis", "fixtures")
+
+
+def _fixture(name: str) -> str:
+    return os.path.join(FIXDIR, name)
+
+
+def _pass_findings(pass_fn, rels, flags_rel=FLAGS_MODULE):
+    ctx, parse_findings = build_context(REPO_ROOT, rels,
+                                        flags_rel=flags_rel)
+    assert not parse_findings, parse_findings
+    return pass_fn(ctx)
+
+
+def _count(findings, rule, path_contains):
+    return sum(1 for f in findings
+               if f.rule == rule and path_contains in f.path)
+
+
+# ------------------------------------------------------------------
+# 1. the gate
+# ------------------------------------------------------------------
+
+def test_repo_is_clean():
+    """Every pass over the real tree: zero non-allowlisted findings,
+    zero stale allowlist entries."""
+    report = run()
+    assert not report.findings, \
+        "aphrocheck findings (fix or allowlist):\n" + \
+        "\n".join(f.render() for f in report.findings)
+    assert not report.stale_allowlist, \
+        "stale allowlist entries (they match nothing — remove them): " \
+        + str([vars(e) for e in report.stale_allowlist])
+
+
+def test_allowlist_budget():
+    allow = Allowlist.load(DEFAULT_ALLOWLIST)
+    assert len(allow.entries) <= 5, \
+        "the allowlist is a budget for intentional exceptions, not " \
+        f"a dumping ground: {len(allow.entries)} entries > 5"
+
+
+def test_scan_covers_benches():
+    """Bench harnesses are scanned so bench-only flags stay
+    registered (the FLAG004/005 contract covers them)."""
+    files = collect_files()
+    assert "bench.py" in files
+    assert any(f.startswith("benchmarks") for f in files)
+    assert any(f.endswith(os.path.join("ops", "pallas",
+                                       "paged_attention.py"))
+               for f in files)
+
+
+# ------------------------------------------------------------------
+# 2. each rule fires exactly once on its seeded fixture
+# ------------------------------------------------------------------
+
+@pytest.mark.parametrize("pass_fn,fixture,rule", [
+    (flag_pass.run, "fixture_flag_raw.py", "FLAG001"),
+    (flag_pass.run, "fixture_flag_import.py", "FLAG002"),
+    (flag_pass.run, "fixture_flag_coerce.py", "FLAG003"),
+    (flag_pass.run, "fixture_flag_unregistered.py", "FLAG005"),
+    (vmem_pass.run, "fixture_vmem.py", "VMEM001"),
+    (dma_pass.run, "fixture_dma_wait.py", "DMA001"),
+    (dma_pass.run, "fixture_dma_mod.py", "DMA002"),
+    (dma_pass.run, "fixture_dma_sem.py", "DMA003"),
+    (grid_pass.run, "fixture_grid_arity.py", "GRID001"),
+    (grid_pass.run, "fixture_grid_args.py", "GRID002"),
+    (sync_pass.run, "fixture_sync_item.py", "SYNC001"),
+    (sync_pass.run, "fixture_sync_loop.py", "SYNC002"),
+    (sync_pass.run, "fixture_sync_static.py", "SYNC003"),
+])
+def test_rule_fires_exactly_once(pass_fn, fixture, rule):
+    findings = _pass_findings(pass_fn, [_fixture(fixture)])
+    hits = [f for f in findings
+            if f.rule == rule and fixture in f.path]
+    assert len(hits) == 1, \
+        f"{rule} fired {len(hits)}x on {fixture} (want exactly 1): " \
+        + "\n".join(f.render() for f in findings)
+
+
+@pytest.mark.parametrize("rule", ["FLAG004", "FLAG006"])
+def test_registry_rules_fire_exactly_once(rule):
+    """FLAG004 (registered-never-read) / FLAG006 (undocumented) fire
+    once each against the fixture stand-in registry."""
+    findings = _pass_findings(
+        flag_pass.run,
+        [_fixture("fixture_registry.py"),
+         _fixture("fixture_registry_reader.py")],
+        flags_rel=_fixture("fixture_registry.py"))
+    hits = [f for f in findings if f.rule == rule]
+    assert len(hits) == 1, \
+        f"{rule}: {[f.render() for f in findings]}"
+    assert "fixture_registry.py" in hits[0].path
+
+
+def test_clean_constructs_stay_quiet():
+    """The DMA003 fixture's correct start/wait pairing and moduli must
+    not also trip DMA001/DMA002 (precision, not just recall)."""
+    findings = _pass_findings(dma_pass.run,
+                              [_fixture("fixture_dma_sem.py")])
+    assert _count(findings, "DMA001", "fixture_dma_sem") == 0
+    assert _count(findings, "DMA002", "fixture_dma_sem") == 0
+    # and the GRID fixtures' correct out_spec maps stay quiet
+    g = _pass_findings(grid_pass.run, [_fixture("fixture_grid_arity.py")])
+    assert _count(g, "GRID001", "fixture_grid_arity") == 1  # in_spec only
+    assert _count(g, "GRID002", "fixture_grid_arity") == 0
+
+
+# ------------------------------------------------------------------
+# 3. allowlist mechanics + CLI
+# ------------------------------------------------------------------
+
+def test_allowlist_suppresses_and_detects_stale(tmp_path):
+    allow = tmp_path / "allow.json"
+    allow.write_text(json.dumps([
+        {"rule": "FLAG001", "path": _fixture("fixture_flag_raw.py"),
+         "contains": "APHRODITE_FIXTURE_RAW",
+         "reason": "seeded fixture violation"},
+        {"rule": "FLAG001", "path": _fixture("fixture_flag_raw.py"),
+         "contains": "THIS-LINE-DOES-NOT-EXIST",
+         "reason": "stale on purpose"},
+    ]))
+    report = run(rels=[_fixture("fixture_flag_raw.py")],
+                 allowlist_path=str(allow),
+                 rule_prefixes=["FLAG"])
+    assert _count(report.findings, "FLAG001", "fixture_flag_raw") == 0
+    assert _count(report.suppressed, "FLAG001",
+                  "fixture_flag_raw") == 1
+    stale = report.stale_allowlist
+    assert len(stale) == 1 and \
+        stale[0].contains == "THIS-LINE-DOES-NOT-EXIST"
+
+
+def test_cli_json_clean_exit():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.aphrocheck", "--json"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["findings"] == []
+    assert payload["stale_allowlist"] == []
+
+
+def test_cli_finds_seeded_violation():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.aphrocheck", "--no-allowlist",
+         _fixture("fixture_flag_raw.py")],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    assert "FLAG001" in proc.stdout
+
+
+def test_cli_flags_md():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.aphrocheck", "--flags-md"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "| Flag | Type | Default | Description |" in proc.stdout
+    assert "APHRODITE_ATTN_PF" in proc.stdout
+
+
+def test_readme_documents_every_flag():
+    """The README "Runtime flags" table (generated via --flags-md)
+    must mention every registered flag — regenerate it when the
+    registry changes."""
+    ctx, _ = build_context(REPO_ROOT, rels=[FLAGS_MODULE])
+    registered = parse_registry(ctx.flags_module)
+    assert registered, "static registry parse came up empty"
+    with open(os.path.join(REPO_ROOT, "README.md"),
+              encoding="utf-8") as f:
+        readme = f.read()
+    missing = [name for name in registered if name not in readme]
+    assert not missing, \
+        "README flags table out of date (run `python -m " \
+        f"tools.aphrocheck --flags-md`): missing {missing}"

@@ -1,0 +1,108 @@
+"""aphrocheck: kernel-contract and engine-invariant static analysis.
+
+Pure-AST checks over `aphrodite_tpu/`, `bench.py`, and
+`benchmarks/` — no JAX, no TPU, no imports of the code under
+analysis. Run as `python -m tools.aphrocheck` (tier-1 runs it via
+`tests/analysis/test_aphrocheck.py`).
+
+Rule families (see each pass module's docstring for the contract):
+
+  FLAG001-006  env-flag registry (aphrodite_tpu/common/flags.py)
+  VMEM001      pallas_call VMEM footprint vs the per-core budget
+  DMA001-003   async-copy start/wait pairing, ring-slot arithmetic,
+               semaphore-array coverage
+  GRID001-002  grid arity vs index-map / scalar-prefetch arity
+  SYNC001-003  execute_model hot-path host-sync / retrace hazards
+
+Intentional exceptions live in `tools/aphrocheck/allowlist.json`;
+entries pin (rule, path, line-content) and go STALE — reported, and
+failed on in tier-1 — when the covered line changes.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import List, Optional, Sequence, Tuple
+
+from tools.aphrocheck.core import (FLAGS_MODULE, REPO_ROOT, Allowlist,
+                                   Finding, Module, collect_files,
+                                   load_modules, parse_file)
+
+DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "allowlist.json")
+
+_RULE_ORDER = ("PARSE", "FLAG", "VMEM", "DMA", "GRID", "SYNC")
+
+
+@dataclasses.dataclass
+class Context:
+    modules: List[Module]
+    flags_module: Optional[Module]
+    vmem_budget: int = 16 * 1024 * 1024
+
+
+@dataclasses.dataclass
+class Report:
+    findings: List[Finding]
+    suppressed: List[Finding]
+    stale_allowlist: list
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings and not self.stale_allowlist
+
+
+def build_context(root: str = REPO_ROOT,
+                  rels: Optional[Sequence[str]] = None,
+                  flags_rel: str = FLAGS_MODULE,
+                  vmem_budget: int = 16 * 1024 * 1024
+                  ) -> Tuple[Context, List[Finding]]:
+    if rels is None:
+        rels = collect_files(root)
+    modules, parse_findings = load_modules(root, rels)
+    flags_module = next(
+        (m for m in modules
+         if m.rel.replace("\\", "/") == flags_rel.replace("\\", "/")),
+        None)
+    if flags_module is None:
+        flags_path = os.path.join(root, flags_rel)
+        if os.path.exists(flags_path):
+            flags_module, err = parse_file(flags_path, flags_rel)
+            if err is not None:
+                parse_findings.append(err)
+    return Context(list(modules), flags_module, vmem_budget), \
+        parse_findings
+
+
+def run(root: str = REPO_ROOT,
+        rels: Optional[Sequence[str]] = None,
+        allowlist_path: Optional[str] = DEFAULT_ALLOWLIST,
+        vmem_budget: int = 16 * 1024 * 1024,
+        rule_prefixes: Optional[Sequence[str]] = None) -> Report:
+    """Run every pass; returns surviving findings, suppressed ones,
+    and stale allowlist entries."""
+    from tools.aphrocheck.passes import ALL_PASSES
+
+    ctx, findings = build_context(root, rels, vmem_budget=vmem_budget)
+    for family, pass_fn in ALL_PASSES:
+        if rule_prefixes and family not in rule_prefixes:
+            continue
+        findings.extend(pass_fn(ctx))
+
+    findings.sort(key=lambda f: (
+        f.path, f.line,
+        next((i for i, p in enumerate(_RULE_ORDER)
+              if f.rule.startswith(p)), 99), f.rule))
+
+    allowlist = Allowlist.load(allowlist_path) if allowlist_path \
+        else Allowlist([])
+    by_rel = {m.rel: m for m in ctx.modules}
+    surviving, suppressed = [], []
+    for f in findings:
+        mod = by_rel.get(f.path)
+        line_text = mod.line_text(f.line) if mod else ""
+        if allowlist.suppresses(f, line_text):
+            suppressed.append(f)
+        else:
+            surviving.append(f)
+    return Report(surviving, suppressed, allowlist.stale_entries())

@@ -1,0 +1,101 @@
+"""CLI for the aphrocheck static analysis suite.
+
+    python -m tools.aphrocheck              # human output, exit 1 on findings
+    python -m tools.aphrocheck --json       # machine output
+    python -m tools.aphrocheck --flags-md   # README "Runtime flags" table
+    python -m tools.aphrocheck --rules FLAG,DMA  # subset of pass families
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+from tools.aphrocheck import DEFAULT_ALLOWLIST, run
+from tools.aphrocheck.core import FLAGS_MODULE, REPO_ROOT
+
+
+def _flags_markdown(root: str) -> str:
+    """Load the registry module standalone (by path, no package
+    import — keeps the CLI independent of the engine's deps) and
+    render its markdown table."""
+    path = os.path.join(root, FLAGS_MODULE)
+    spec = importlib.util.spec_from_file_location(
+        "_aphrodite_flags_standalone", path)
+    mod = importlib.util.module_from_spec(spec)
+    # dataclass creation resolves the defining module via sys.modules
+    sys.modules[spec.name] = mod
+    try:
+        spec.loader.exec_module(mod)
+        return mod.flags_markdown()
+    finally:
+        sys.modules.pop(spec.name, None)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="aphrocheck",
+        description="Kernel-contract / engine-invariant static checks "
+                    "(FLAG, VMEM, DMA, GRID, SYNC rule families).")
+    parser.add_argument("paths", nargs="*",
+                        help="repo-relative files to scan (default: "
+                             "aphrodite_tpu/, bench.py, benchmarks/)")
+    parser.add_argument("--root", default=REPO_ROOT,
+                        help="repository root (default: autodetected)")
+    parser.add_argument("--json", action="store_true",
+                        help="JSON findings on stdout")
+    parser.add_argument("--flags-md", action="store_true",
+                        help="print the generated README runtime-flags "
+                             "table and exit")
+    parser.add_argument("--allowlist", default=DEFAULT_ALLOWLIST,
+                        help="allowlist JSON (default: the checked-in "
+                             "tools/aphrocheck/allowlist.json)")
+    parser.add_argument("--no-allowlist", action="store_true",
+                        help="report every finding, suppressing none")
+    parser.add_argument("--rules", default="",
+                        help="comma list of pass families to run "
+                             "(FLAG,VMEM,DMA,GRID,SYNC)")
+    parser.add_argument("--vmem-budget", type=int,
+                        default=16 * 1024 * 1024,
+                        help="per-core VMEM budget in bytes "
+                             "(default 16 MiB)")
+    args = parser.parse_args(argv)
+
+    if args.flags_md:
+        print(_flags_markdown(args.root))
+        return 0
+
+    report = run(
+        root=args.root,
+        rels=args.paths or None,
+        allowlist_path=None if args.no_allowlist else args.allowlist,
+        vmem_budget=args.vmem_budget,
+        rule_prefixes=[r.strip().upper() for r in args.rules.split(",")
+                       if r.strip()] or None)
+
+    if args.json:
+        print(json.dumps({
+            "findings": [f.to_json() for f in report.findings],
+            "suppressed": [f.to_json() for f in report.suppressed],
+            "stale_allowlist": [vars(e) for e in
+                                report.stale_allowlist],
+        }, indent=2))
+    else:
+        for f in report.findings:
+            print(f.render())
+        for e in report.stale_allowlist:
+            print(f"STALE-ALLOWLIST {e.rule} {e.path} "
+                  f"(contains: {e.contains!r}) — entry matches "
+                  "nothing; remove it")
+        n, s = len(report.findings), len(report.suppressed)
+        print(f"aphrocheck: {n} finding(s), {s} suppressed, "
+              f"{len(report.stale_allowlist)} stale allowlist "
+              f"entr{'y' if len(report.stale_allowlist) == 1 else 'ies'}",
+              file=sys.stderr)
+    return 0 if report.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,512 @@
+"""Shared infrastructure for the aphrocheck passes.
+
+Everything here is pure-AST: the checker never imports the code it
+analyzes (so it runs in milliseconds under JAX_PLATFORMS=cpu with no
+TPU, and a broken module under analysis cannot break the analyzer —
+only a SyntaxError can, which is itself reported as a finding).
+
+Key pieces:
+
+- Finding / Allowlist: stable-rule-ID findings and the checked-in
+  exception list. Allowlist entries pin (rule, path, line-content
+  substring) rather than line numbers, so they survive unrelated
+  edits; entries that match nothing are STALE and reported (and the
+  tier-1 test fails on them).
+- Module: one parsed source file plus parent links and the
+  enclosing-scope / enclosing-branch maps the passes share.
+- Branch paths: every AST node carries the chain of (if-node, arm)
+  decisions above it. Two nodes CONFLICT when they sit in different
+  arms of the same `if` — passes use this to avoid pairing values
+  that can never coexist (e.g. the ragged vs classic grid-spec arms
+  of paged_attention).
+- Interval: [lo, hi] integer bounds with a small abstract evaluator
+  (literals, names via branch-aware constant propagation, arithmetic,
+  min/max, literal-tuple generators) used by the VMEM pass.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+#: Scanned roots, relative to the repo root. Bench harnesses are
+#: scanned too so bench-only flags stay registered (FLAG004/005).
+SCAN_ROOTS = ("aphrodite_tpu", "bench.py", "benchmarks")
+
+#: The registry module — exempt from FLAG001/002/003 (it IS the one
+#: place raw os.environ reads are allowed).
+FLAGS_MODULE = os.path.join("aphrodite_tpu", "common", "flags.py")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str          # stable ID, e.g. "FLAG001"
+    path: str          # repo-relative path
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.rule} {self.path}:{self.line} {self.message}"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Module:
+    """One parsed source file with parent/scope/branch maps."""
+
+    def __init__(self, path: str, rel: str, text: str,
+                 tree: ast.AST) -> None:
+        self.path = path
+        self.rel = rel
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = tree
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1]
+        return ""
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        return Finding(rule, self.rel, getattr(node, "lineno", 0),
+                       message)
+
+    # -- scopes ------------------------------------------------------
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        """Nearest FunctionDef/AsyncFunctionDef/Lambda above node."""
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def top_level_function(self, node: ast.AST) -> Optional[ast.AST]:
+        """Outermost function containing node (kernel bodies nest
+        closures under pl.when — DMA matching aggregates at this
+        granularity)."""
+        top = None
+        cur = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                top = cur
+            cur = self.parents.get(cur)
+        return top
+
+    def at_module_level(self, node: ast.AST) -> bool:
+        """True when node executes at import time (module or class
+        body; any enclosing function defers execution)."""
+        return self.enclosing_function(node) is None
+
+    # -- branch paths ------------------------------------------------
+
+    def branch_path(self, node: ast.AST) -> Tuple[Tuple[int, str], ...]:
+        """((id(if_node), arm), ...) from outermost to innermost."""
+        path: List[Tuple[int, str]] = []
+        cur = node
+        parent = self.parents.get(cur)
+        while parent is not None:
+            if isinstance(parent, (ast.If, ast.IfExp)):
+                if cur in getattr(parent, "body", []) or \
+                        cur is getattr(parent, "body", None):
+                    path.append((id(parent), "then"))
+                elif cur in getattr(parent, "orelse", []) or \
+                        cur is getattr(parent, "orelse", None):
+                    path.append((id(parent), "else"))
+            cur, parent = parent, self.parents.get(parent)
+        path.reverse()
+        return tuple(path)
+
+
+def paths_conflict(a: Sequence[Tuple[int, str]],
+                   b: Sequence[Tuple[int, str]]) -> bool:
+    """Two branch paths conflict when they take different arms of the
+    same `if` — such nodes can never be live together."""
+    arms_a = dict(a)
+    for if_id, arm in b:
+        if arms_a.get(if_id, arm) != arm:
+            return True
+    return False
+
+
+def parse_file(path: str, rel: str) -> Tuple[Optional[Module],
+                                             Optional[Finding]]:
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read()
+    try:
+        tree = ast.parse(text, filename=rel)
+    except SyntaxError as e:
+        return None, Finding("PARSE", rel, e.lineno or 0,
+                             f"syntax error: {e.msg}")
+    return Module(path, rel, text, tree), None
+
+
+def collect_files(root: str = REPO_ROOT,
+                  roots: Sequence[str] = SCAN_ROOTS) -> List[str]:
+    """Repo-relative paths of every scanned .py file, sorted."""
+    out: List[str] = []
+    for entry in roots:
+        full = os.path.join(root, entry)
+        if os.path.isfile(full):
+            out.append(entry)
+            continue
+        for dirpath, dirnames, filenames in os.walk(full):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__",)]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.relpath(
+                        os.path.join(dirpath, fn), root))
+    return sorted(out)
+
+
+def load_modules(root: str, rels: Iterable[str]
+                 ) -> Tuple[List[Module], List[Finding]]:
+    modules, findings = [], []
+    for rel in rels:
+        mod, err = parse_file(os.path.join(root, rel), rel)
+        if err is not None:
+            findings.append(err)
+        else:
+            modules.append(mod)
+    return modules, findings
+
+
+# -- allowlist --------------------------------------------------------
+
+@dataclasses.dataclass
+class AllowEntry:
+    rule: str
+    path: str
+    contains: str      # substring of the source line the finding is on
+    reason: str
+    hits: int = 0
+
+    def matches(self, finding: Finding, line_text: str) -> bool:
+        return (self.rule == finding.rule and
+                self.path == finding.path and
+                self.contains in line_text)
+
+
+class Allowlist:
+    """Checked-in intentional exceptions. JSON list of
+    {rule, path, contains, reason}; `contains` pins the source line's
+    content (not its number), so entries go stale — and are reported —
+    when the code they covered changes."""
+
+    def __init__(self, entries: List[AllowEntry]) -> None:
+        self.entries = entries
+
+    @classmethod
+    def load(cls, path: str) -> "Allowlist":
+        if not os.path.exists(path):
+            return cls([])
+        with open(path, "r", encoding="utf-8") as f:
+            raw = json.load(f)
+        return cls([AllowEntry(e["rule"], e["path"], e["contains"],
+                               e.get("reason", "")) for e in raw])
+
+    def suppresses(self, finding: Finding, line_text: str) -> bool:
+        for entry in self.entries:
+            if entry.matches(finding, line_text):
+                entry.hits += 1
+                return True
+        return False
+
+    def stale_entries(self) -> List[AllowEntry]:
+        return [e for e in self.entries if e.hits == 0]
+
+
+# -- small AST helpers ------------------------------------------------
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    """Dotted name of a call's callee ('pltpu.make_async_copy')."""
+    return dotted_name(call.func)
+
+
+def tail_name(node: ast.AST) -> Optional[str]:
+    """Last attribute segment ('make_async_copy' of any x.y.z chain)."""
+    name = dotted_name(node)
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def int_const(node: ast.AST) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    return None
+
+
+def keyword_arg(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def iter_calls(root: ast.AST) -> Iterable[ast.Call]:
+    for node in ast.walk(root):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+def assignments_of(scope: ast.AST, name: str) -> List[ast.AST]:
+    """Value nodes assigned to `name` anywhere in `scope` (plain
+    Assign targets only; tuple-unpack yields the whole call value,
+    marked by wrapping position)."""
+    out: List[ast.AST] = []
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == name:
+                    out.append(node.value)
+    return out
+
+
+# -- integer interval evaluation (VMEM pass) --------------------------
+
+INF = float("inf")
+
+
+@dataclasses.dataclass(frozen=True)
+class Interval:
+    lo: float
+    hi: float
+
+    @property
+    def exact(self) -> Optional[int]:
+        if self.lo == self.hi and self.lo != INF:
+            return int(self.lo)
+        return None
+
+
+UNKNOWN = Interval(1, INF)   # shape dims are >= 1
+
+
+def _join(a: Interval, b: Interval) -> Interval:
+    return Interval(min(a.lo, b.lo), max(a.hi, b.hi))
+
+
+class IntervalEvaluator:
+    """Branch-aware [lo, hi] bounds for integer shape expressions.
+
+    Scope: one function (plus module-level constants). Names resolve
+    through plain assignments; a name reassigned via AugAssign or in a
+    loop is UNKNOWN (sound: we never narrow a value we cannot track).
+    Flag reads (`flags.get_int(...)`) resolve to their registry/call-
+    site default — the analysis states its assumption as "flags at
+    defaults" rather than treating every knob as unbounded.
+    """
+
+    def __init__(self, module: Module, scope: Optional[ast.AST],
+                 flag_defaults: Optional[Dict[str, int]] = None) -> None:
+        self.module = module
+        self.scope = scope
+        self.flag_defaults = flag_defaults or {}
+        self._mutated = self._collect_mutated()
+        self._stack: List[str] = []    # recursion guard
+
+    def _collect_mutated(self) -> set:
+        bad = set()
+        for root in filter(None, [self.scope, self.module.tree]):
+            for node in ast.walk(root):
+                if isinstance(node, ast.AugAssign) and \
+                        isinstance(node.target, ast.Name):
+                    bad.add(node.target.id)
+                elif isinstance(node, (ast.For, ast.While)):
+                    for inner in ast.walk(node):
+                        if isinstance(inner, (ast.Assign, ast.AugAssign)):
+                            tgts = inner.targets if isinstance(
+                                inner, ast.Assign) else [inner.target]
+                            for t in tgts:
+                                if isinstance(t, ast.Name):
+                                    bad.add(t.id)
+        return bad
+
+    def eval(self, node: ast.AST,
+             at: Optional[ast.AST] = None) -> Interval:
+        """Bounds of `node`; `at` anchors branch-compatibility (default:
+        the node itself)."""
+        at = at if at is not None else node
+        if isinstance(node, ast.Constant):
+            v = int_const(node)
+            return Interval(v, v) if v is not None else UNKNOWN
+        if isinstance(node, ast.Name):
+            return self._eval_name(node.id, at)
+        if isinstance(node, ast.BinOp):
+            return self._eval_binop(node, at)
+        if isinstance(node, ast.IfExp):
+            return _join(self.eval(node.body, at),
+                         self.eval(node.orelse, at))
+        if isinstance(node, ast.Call):
+            return self._eval_call(node, at)
+        if isinstance(node, ast.UnaryOp) and \
+                isinstance(node.op, ast.USub):
+            inner = self.eval(node.operand, at)
+            return Interval(-inner.hi, -inner.lo)
+        return UNKNOWN
+
+    def _eval_name(self, name: str, at: ast.AST) -> Interval:
+        if name in self._stack:
+            return UNKNOWN
+        if name in self._mutated:
+            return UNKNOWN
+        if name in self.flag_defaults:
+            v = self.flag_defaults[name]
+            return Interval(v, v)
+        sources: List[ast.AST] = []
+        if self.scope is not None:
+            sources.extend(assignments_of(self.scope, name))
+        if not sources:
+            # module-level constant (e.g. _WB_SLOTS = 8)
+            for stmt in self.module.tree.body:
+                if isinstance(stmt, ast.Assign):
+                    for tgt in stmt.targets:
+                        if isinstance(tgt, ast.Name) and tgt.id == name:
+                            sources.append(stmt.value)
+        if not sources:
+            return UNKNOWN
+        at_path = self.module.branch_path(at)
+        result: Optional[Interval] = None
+        self._stack.append(name)
+        try:
+            for value in sources:
+                if paths_conflict(at_path,
+                                  self.module.branch_path(value)):
+                    continue
+                iv = self.eval(value, value)
+                result = iv if result is None else _join(result, iv)
+        finally:
+            self._stack.pop()
+        return result if result is not None else UNKNOWN
+
+    def _eval_binop(self, node: ast.BinOp, at: ast.AST) -> Interval:
+        a = self.eval(node.left, at)
+        b = self.eval(node.right, at)
+        op = node.op
+        if isinstance(op, ast.Add):
+            return Interval(a.lo + b.lo, a.hi + b.hi)
+        if isinstance(op, ast.Sub):
+            return Interval(a.lo - b.hi, a.hi - b.lo)
+        if isinstance(op, ast.Mult):
+            if a.lo < 0 or b.lo < 0:
+                return UNKNOWN
+            return Interval(a.lo * b.lo, a.hi * b.hi)
+        if isinstance(op, ast.FloorDiv):
+            if b.lo <= 0:
+                return UNKNOWN
+            hi = a.hi if b.lo == 0 else a.hi / b.lo
+            lo = 0 if a.lo < 0 or b.hi == INF or b.hi == 0 \
+                else a.lo // b.hi
+            return Interval(lo, hi)
+        if isinstance(op, ast.Mod):
+            if b.hi == INF or b.hi <= 0:
+                return UNKNOWN
+            return Interval(0, b.hi - 1)
+        if isinstance(op, ast.LShift):
+            if b.exact is not None and a.lo >= 0 and a.hi != INF:
+                return Interval(int(a.lo) << b.exact,
+                                int(a.hi) << b.exact)
+            return UNKNOWN
+        if isinstance(op, ast.Pow):
+            if a.exact is not None and b.exact is not None and \
+                    b.exact >= 0:
+                v = a.exact ** b.exact
+                return Interval(v, v)
+            return UNKNOWN
+        return UNKNOWN
+
+    def _eval_call(self, node: ast.Call, at: ast.AST) -> Interval:
+        fn = tail_name(node.func)
+        if fn in ("min", "max"):
+            ivs = [self.eval(a, at) for a in self._spread_args(node)]
+            if not ivs:
+                return UNKNOWN
+            if fn == "min":
+                # Upper bound of min() is sound from ANY bounded arg.
+                hi = min(iv.hi for iv in ivs)
+                lo = min(iv.lo for iv in ivs)
+                return Interval(lo, hi)
+            hi = max(iv.hi for iv in ivs)
+            lo = max(iv.lo for iv in ivs)
+            return Interval(lo, hi)
+        if fn in ("get_int", "get_float"):
+            # flags accessor: assume registry/call-site default.
+            default = keyword_arg(node, "default")
+            cand = default if default is not None else (
+                node.args[1] if len(node.args) > 1 else None)
+            if cand is not None:
+                return self.eval(cand, at)
+            return UNKNOWN
+        if fn == "len":
+            return Interval(0, INF)
+        return UNKNOWN
+
+    def _spread_args(self, node: ast.Call) -> List[ast.AST]:
+        """min/max over a literal-tuple generator contributes the
+        tuple's elements (`max(bn for bn in (2048, 1024, ...) if ...)`
+        is bounded by the tuple, whatever the filter keeps)."""
+        out: List[ast.AST] = []
+        for arg in node.args:
+            if isinstance(arg, ast.GeneratorExp) and \
+                    len(arg.generators) == 1 and \
+                    isinstance(arg.generators[0].iter, ast.Tuple):
+                out.extend(arg.generators[0].iter.elts)
+            elif isinstance(arg, ast.Starred):
+                continue
+            else:
+                out.append(arg)
+        for kw in node.keywords:
+            if kw.arg == "default":
+                out.append(kw.value)
+        return out
+
+
+#: dtype attribute name -> byte width (Pallas scratch/blockspec math).
+DTYPE_BYTES = {
+    "float32": 4, "int32": 4, "uint32": 4,
+    "bfloat16": 2, "float16": 2, "int16": 2,
+    "int8": 1, "uint8": 1, "float8_e5m2": 1, "float8_e4m3fn": 1,
+    "bool_": 1,
+    "float64": 8, "int64": 8,
+}
+
+
+def dtype_bytes(node: ast.AST) -> Interval:
+    """Byte width of a dtype expression; unknown dtypes bound to
+    [1, 8] (lower bound keeps definite-overflow reasoning sound)."""
+    name = tail_name(node)
+    if name in DTYPE_BYTES:
+        w = DTYPE_BYTES[name]
+        return Interval(w, w)
+    return Interval(1, 8)

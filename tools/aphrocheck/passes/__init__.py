@@ -1,0 +1,21 @@
+"""The aphrocheck analysis passes.
+
+Each pass module exposes `run(ctx) -> List[Finding]` where ctx is a
+`tools.aphrocheck.Context`. Rule ID families:
+
+- FLAG001..FLAG006 — env-flag registry contract
+- VMEM001          — pallas_call VMEM footprint vs the per-core budget
+- DMA001..DMA003   — async-copy start/wait + ring-slot invariants
+- GRID001..GRID002 — grid arity vs index-map/scalar-prefetch arity
+- SYNC001..SYNC003 — execute_model hot-path host-sync/retrace hazards
+"""
+from tools.aphrocheck.passes import (dma_pass, flag_pass, grid_pass,
+                                     sync_pass, vmem_pass)
+
+ALL_PASSES = (
+    ("FLAG", flag_pass.run),
+    ("VMEM", vmem_pass.run),
+    ("DMA", dma_pass.run),
+    ("GRID", grid_pass.run),
+    ("SYNC", sync_pass.run),
+)

@@ -1,0 +1,146 @@
+"""FLAG pass: the env-flag registry contract.
+
+Rules:
+
+- FLAG001: raw `os.environ` / `os.getenv` READ (get, subscript load,
+  or `in`/`not in` containment) of an APHRODITE_* name anywhere
+  outside the registry module. All reads must go through the typed,
+  validated accessors in `aphrodite_tpu/common/flags.py`. Writes
+  (`os.environ["APHRODITE_X"] = ...`) are allowed — that is how bench
+  harnesses configure child processes and trace-time reads.
+- FLAG002: an env read (raw or via the registry) that executes at
+  IMPORT time (module or class body). Import-time reads killed the
+  process on a bad value twice before this checker existed
+  (`APHRODITE_ATTN_PF`, `_DEBUG_KV`); all reads must be per-call.
+- FLAG003: an unvalidated `int(...)`/`float(...)` coercion wrapped
+  around a raw env read — a typo'd value raises a bare ValueError
+  mid-batch with no flag name in the message.
+- FLAG004: a registered flag that no scanned module ever reads
+  (reported at the registration line — dead registry entries rot the
+  docs table).
+- FLAG005: a registry-accessor read of a name that is NOT registered
+  (typo'd reads would otherwise silently hit the accessor's
+  unregistered-name error only at runtime).
+- FLAG006: a registered flag with an empty description (the README
+  table is generated from these).
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.aphrocheck.core import (FLAGS_MODULE, Finding, Module,
+                                   dotted_name, iter_calls, str_const,
+                                   tail_name)
+from tools.aphrocheck.registry import accessor_reads, parse_registry
+
+
+def _raw_env_reads(module: Module):
+    """(name, node) for every raw os.environ/os.getenv READ of an
+    APHRODITE_* literal."""
+    out = []
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call):
+            callee = dotted_name(node.func) or ""
+            is_environ_get = callee.endswith("environ.get")
+            is_getenv = tail_name(node.func) == "getenv"
+            if (is_environ_get or is_getenv) and node.args:
+                name = str_const(node.args[0])
+                if name and name.startswith("APHRODITE_"):
+                    out.append((name, node))
+        elif isinstance(node, ast.Subscript):
+            base = dotted_name(node.value) or ""
+            if base.endswith("environ") and \
+                    isinstance(node.ctx, ast.Load):
+                name = str_const(node.slice)
+                if name and name.startswith("APHRODITE_"):
+                    out.append((name, node))
+        elif isinstance(node, ast.Compare):
+            # "APHRODITE_X" in os.environ  /  not in os.environ
+            if len(node.ops) == 1 and \
+                    isinstance(node.ops[0], (ast.In, ast.NotIn)):
+                target = dotted_name(node.comparators[0]) or ""
+                name = str_const(node.left)
+                if target.endswith("environ") and name and \
+                        name.startswith("APHRODITE_"):
+                    out.append((name, node))
+    return out
+
+
+def _coercion_parent(module: Module, node: ast.AST):
+    """Nearest enclosing int()/float() call the raw read feeds."""
+    cur = module.parents.get(node)
+    hops = 0
+    while cur is not None and hops < 4:
+        if isinstance(cur, ast.Call) and \
+                isinstance(cur.func, ast.Name) and \
+                cur.func.id in ("int", "float"):
+            return cur
+        if isinstance(cur, (ast.stmt,)):
+            return None
+        cur = module.parents.get(cur)
+        hops += 1
+    return None
+
+
+def run(ctx) -> List[Finding]:
+    findings: List[Finding] = []
+    registry = parse_registry(ctx.flags_module) \
+        if ctx.flags_module else {}
+    read_names = set()
+
+    for module in ctx.modules:
+        is_registry_module = module.rel.replace("\\", "/") == \
+            FLAGS_MODULE.replace("\\", "/")
+
+        # registry-accessor reads (all modules, incl. the registry's
+        # own tests-by-import users)
+        for name, call, accessor in accessor_reads(module):
+            read_names.add(name)
+            if not is_registry_module and name not in registry and \
+                    registry:
+                findings.append(module.finding(
+                    "FLAG005", call,
+                    f"{accessor}({name!r}) reads an unregistered "
+                    f"flag; register it in {FLAGS_MODULE}"))
+            if module.at_module_level(call):
+                findings.append(module.finding(
+                    "FLAG002", call,
+                    f"import-time read of {name} (module-level "
+                    f"{accessor} call); read per call instead — a bad "
+                    "env value must fail the call, not the import"))
+
+        if is_registry_module:
+            continue
+
+        for name, node in _raw_env_reads(module):
+            read_names.add(name)
+            findings.append(module.finding(
+                "FLAG001", node,
+                f"raw os.environ read of {name}; use "
+                f"aphrodite_tpu.common.flags accessors"))
+            if module.at_module_level(node):
+                findings.append(module.finding(
+                    "FLAG002", node,
+                    f"import-time read of {name} (module scope); a "
+                    "bad env value must fail the call, not the import"))
+            coercion = _coercion_parent(module, node)
+            if coercion is not None:
+                findings.append(module.finding(
+                    "FLAG003", coercion,
+                    f"unvalidated {coercion.func.id}() coercion of "
+                    f"{name}; a typo'd value raises a bare ValueError "
+                    "with no flag name — use flags.get_int/get_float"))
+
+    for name, reg in sorted(registry.items()):
+        if name not in read_names:
+            findings.append(Finding(
+                "FLAG004", ctx.flags_module.rel, reg.line,
+                f"{name} is registered but never read by any scanned "
+                "module; delete the registration or wire up the read"))
+        if not reg.description.strip():
+            findings.append(Finding(
+                "FLAG006", ctx.flags_module.rel, reg.line,
+                f"{name} is registered without a description; the "
+                "README flags table is generated from these"))
+    return findings

@@ -1,0 +1,131 @@
+"""GRID pass: grid arity vs index-map arity vs scalar-prefetch counts.
+
+A Pallas index map receives one argument per grid dimension PLUS one
+per scalar-prefetch operand; getting the count wrong is a trace-time
+error on the TPU path that CPU interpret-mode tests can miss (and the
+error message names neither the BlockSpec nor the lambda).
+
+- GRID001: a BlockSpec index map whose parameter count cannot equal
+  `len(grid) + num_scalar_prefetch` under ANY branch-consistent
+  reading of the site (index maps taking *args accept any extra, so
+  only a fixed-arity mismatch — or fixed params exceeding the
+  expectation — fires).
+- GRID002: the number of positional operands at the pallas_call
+  invocation differs from `num_scalar_prefetch + len(in_specs)`
+  (checked only when all three are statically countable: no *splat,
+  no post-hoc .append on the spec list).
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from tools.aphrocheck.core import (Finding, Module, keyword_arg,
+                                   paths_conflict, tail_name)
+from tools.aphrocheck.sites import (Candidate, find_sites,
+                                    list_elements, resolve)
+
+
+def _grid_lengths(module: Module, scope, grid_node
+                  ) -> List[Tuple[int, tuple]]:
+    out = []
+    for cand in resolve(module, scope, grid_node):
+        if isinstance(cand.node, (ast.Tuple, ast.List)):
+            out.append((len(cand.node.elts), cand.path))
+    return out
+
+
+def _index_map_arity(module: Module, scope, node
+                     ) -> List[Tuple[int, bool, tuple, ast.AST]]:
+    """(fixed_param_count, has_varargs, path, def_node) candidates."""
+    out = []
+    for cand in resolve(module, scope, node):
+        n = cand.node
+        if isinstance(n, ast.Lambda):
+            out.append((len(n.args.posonlyargs) + len(n.args.args),
+                        n.args.vararg is not None, cand.path, n))
+        elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.append((len(n.args.posonlyargs) + len(n.args.args),
+                        n.args.vararg is not None, cand.path, n))
+    return out
+
+
+def _blockspec_index_map(spec: ast.AST) -> Optional[ast.AST]:
+    if not isinstance(spec, ast.Call) or \
+            tail_name(spec.func) != "BlockSpec":
+        return None
+    if keyword_arg(spec, "memory_space") is not None:
+        return None
+    im = keyword_arg(spec, "index_map")
+    if im is not None:
+        return im
+    if len(spec.args) >= 2:
+        return spec.args[1]
+    return None
+
+
+def run(ctx) -> List[Finding]:
+    findings: List[Finding] = []
+    for module in ctx.modules:
+        for site in find_sites(module):
+            for variant in site.variants:
+                _check_variant(module, site, variant, findings)
+    return findings
+
+
+def _check_variant(module: Module, site, variant, findings) -> None:
+    glens = _grid_lengths(module, site.scope, variant.grid)
+    nsp = variant.num_scalar_prefetch
+    if not glens or nsp is None:
+        return
+
+    # expected-arity candidates, branch-tagged by the grid candidate
+    # (num_scalar_prefetch's path already folded into variant.path)
+    expected = [(g + nsp, variant.path + gp) for g, gp in glens]
+
+    spec_elems: List[ast.AST] = []
+    for specs in (variant.in_specs, variant.out_specs):
+        base, appended, resolved = list_elements(module, site.scope,
+                                                 specs)
+        if not resolved and isinstance(specs, ast.Call):
+            base = [specs]
+        spec_elems.extend(base + appended)
+
+    for spec in spec_elems:
+        im = _blockspec_index_map(spec)
+        if im is None:
+            continue
+        for fixed, varargs, im_path, im_node in _index_map_arity(
+                module, site.scope, im):
+            compatible = [(e, ep) for e, ep in expected
+                          if not paths_conflict(ep, im_path)]
+            if not compatible:
+                continue
+            ok = any((fixed == e) or (varargs and fixed <= e)
+                     for e, _ in compatible)
+            if not ok:
+                want = sorted({e for e, _ in compatible})
+                findings.append(module.finding(
+                    "GRID001", im_node,
+                    f"index map takes {fixed} parameter(s) but the "
+                    f"grid ({min(g for g, _ in glens)}-d) plus "
+                    f"{nsp} scalar-prefetch operand(s) supply "
+                    f"{'/'.join(map(str, want))}"))
+
+    # GRID002: positional-operand count at the invocation
+    if site.invocation is None or \
+            any(isinstance(a, ast.Starred)
+                for a in site.invocation.args):
+        return
+    base, appended, resolved = list_elements(module, site.scope,
+                                             variant.in_specs)
+    if not resolved or appended:
+        return
+    expected_args = nsp + len(base)
+    actual = len(site.invocation.args)
+    if actual != expected_args:
+        findings.append(module.finding(
+            "GRID002", site.invocation,
+            f"pallas_call invoked with {actual} positional "
+            f"operand(s) but num_scalar_prefetch={nsp} plus "
+            f"{len(base)} in_spec(s) require {expected_args}"))

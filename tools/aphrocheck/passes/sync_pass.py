@@ -1,0 +1,187 @@
+"""SYNC pass: host-sync and retrace hazards in the serving hot path.
+
+Hot path = functions named `execute_*` / `dispatch_*` / `finalize_*`
+(the model-runner/executor step surface). The engine's throughput
+contract is ONE host sync per round; these rules catch the patterns
+that silently add more:
+
+- SYNC001: `.item()` in a hot function — a per-element device->host
+  sync (and a scalar the tracer can't cache on).
+- SYNC002: `np.asarray` / `np.array` / `jax.device_get` INSIDE A LOOP
+  or comprehension in a hot function — a sync per iteration. Values
+  already pulled by an earlier `jax.device_get` in the same function
+  are exempt (re-wrapping host numpy is free); the canonical pattern
+  is one bulk device_get followed by per-item finalization.
+- SYNC003: a list/dict/set literal (or comprehension) passed to a
+  parameter declared in `static_argnames` of a jitted callable —
+  unhashable static args raise at call time, and a freshly-built
+  container is a retrace per call even when hashable-ized. Applies
+  module-wide (the hazard is not hot-path-specific).
+
+`float()`/`int()` on device values are host syncs too, but are
+statically indistinguishable from host-scalar coercions; they are
+covered indirectly (the values they coerce come from the patterns
+above) and intentionally not flagged.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Set
+
+from tools.aphrocheck.core import (Finding, Module, dotted_name,
+                                   iter_calls, str_const, tail_name)
+
+HOT_NAME = re.compile(r"^(execute_|dispatch_|finalize_)")
+
+_SYNC_CALLS = {"np.asarray", "np.array", "numpy.asarray",
+               "numpy.array"}
+
+
+def _hot_functions(module: Module) -> List[ast.FunctionDef]:
+    return [n for n in ast.walk(module.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and HOT_NAME.match(n.name)]
+
+
+def _in_loop(module: Module, node: ast.AST, stop: ast.AST) -> bool:
+    cur = module.parents.get(node)
+    while cur is not None and cur is not stop:
+        if isinstance(cur, (ast.For, ast.While, ast.ListComp,
+                            ast.SetComp, ast.DictComp,
+                            ast.GeneratorExp)):
+            return True
+        cur = module.parents.get(cur)
+    return False
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _device_got_names(fn: ast.AST) -> Set[str]:
+    """Names whose values were pulled host-side by jax.device_get in
+    this function, propagated through assignments, zip(), and loop /
+    comprehension targets (over-approximate on purpose)."""
+    exempt: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                src_names = _names_in(node.value)
+                is_pull = any(
+                    tail_name(c.func) == "device_get"
+                    for c in iter_calls(node.value))
+                if is_pull or (src_names & exempt):
+                    for tgt in node.targets:
+                        for t in ast.walk(tgt):
+                            if isinstance(t, ast.Name) and \
+                                    t.id not in exempt:
+                                exempt.add(t.id)
+                                changed = True
+            elif isinstance(node, (ast.For,)):
+                if _names_in(node.iter) & exempt:
+                    for t in ast.walk(node.target):
+                        if isinstance(t, ast.Name) and \
+                                t.id not in exempt:
+                            exempt.add(t.id)
+                            changed = True
+            elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                   ast.DictComp, ast.GeneratorExp)):
+                for gen in node.generators:
+                    if _names_in(gen.iter) & exempt:
+                        for t in ast.walk(gen.target):
+                            if isinstance(t, ast.Name) and \
+                                    t.id not in exempt:
+                                exempt.add(t.id)
+                                changed = True
+    return exempt
+
+
+def _static_jit_callables(module: Module):
+    """name -> set of static_argnames, for jitted callables bound in
+    this module (assignments and decorated defs)."""
+    out = {}
+
+    def static_names(call: ast.Call) -> Set[str]:
+        for kw in call.keywords:
+            if kw.arg == "static_argnames" and \
+                    isinstance(kw.value, (ast.Tuple, ast.List)):
+                return {s for s in (str_const(e)
+                                    for e in kw.value.elts) if s}
+        return set()
+
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call):
+            call = node.value
+            if tail_name(call.func) == "jit":
+                names = static_names(call)
+                if names:
+                    for tgt in node.targets:
+                        key = dotted_name(tgt)
+                        if key:
+                            out[key.split(".")[-1]] = names
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    inner = [c for c in iter_calls(dec)
+                             if tail_name(c.func) == "jit"]
+                    cands = [dec] + inner
+                    for c in cands:
+                        names = static_names(c)
+                        if names:
+                            out[node.name] = names
+    return out
+
+
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+               ast.SetComp, ast.GeneratorExp)
+
+
+def run(ctx) -> List[Finding]:
+    findings: List[Finding] = []
+    for module in ctx.modules:
+        for fn in _hot_functions(module):
+            exempt = _device_got_names(fn)
+            for call in iter_calls(fn):
+                callee = dotted_name(call.func) or ""
+                if isinstance(call.func, ast.Attribute) and \
+                        call.func.attr == "item" and not call.args:
+                    findings.append(module.finding(
+                        "SYNC001", call,
+                        f".item() in hot-path function {fn.name}: a "
+                        "per-element host sync; pull once with "
+                        "device_get and index host-side"))
+                    continue
+                is_sync = callee in _SYNC_CALLS or \
+                    tail_name(call.func) == "device_get"
+                if is_sync and _in_loop(module, call, fn):
+                    arg_names = set()
+                    for a in call.args:
+                        arg_names |= _names_in(a)
+                    if arg_names and arg_names <= exempt:
+                        continue    # host numpy already pulled in bulk
+                    findings.append(module.finding(
+                        "SYNC002", call,
+                        f"{callee or 'device_get'} inside a loop in "
+                        f"hot-path function {fn.name}: one host sync "
+                        "per iteration; hoist to a single bulk "
+                        "device_get"))
+
+        statics = _static_jit_callables(module)
+        for call in iter_calls(module.tree):
+            key = tail_name(call.func)
+            if key not in statics:
+                continue
+            for kw in call.keywords:
+                if kw.arg in statics[key] and \
+                        isinstance(kw.value, _UNHASHABLE):
+                    findings.append(module.finding(
+                        "SYNC003", call,
+                        f"unhashable {type(kw.value).__name__} passed "
+                        f"as static jit arg '{kw.arg}' of {key}; "
+                        "static args must be hashable (and stable, "
+                        "or every call retraces)"))
+    return findings

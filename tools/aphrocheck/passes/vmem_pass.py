@@ -1,0 +1,131 @@
+"""VMEM pass: static footprint bound per pallas_call site.
+
+VMEM001 fires when the PROVABLE LOWER BOUND of a site's VMEM
+footprint — scratch_shapes entries plus in/out BlockSpec blocks,
+dims resolved by branch-aware interval evaluation with flags at
+their registry defaults — exceeds the per-core budget (16 MiB
+default) and the enclosing function has no fit-guarded fallback.
+
+The lower-bound discipline makes the pass sound rather than noisy:
+a dim the evaluator cannot bound contributes 1, so a finding means
+the kernel CANNOT fit, not "might not fit under adversarial flags".
+The runtime mirror of this check is quant_matmul's `_deferred_fits`
+fallback; this pass covers all Pallas kernels at analysis time, and
+recognizes such guards (a call whose name mentions fits/fallback, or
+a budget comparison) as the site being intentionally self-limiting.
+
+Sub-tile padding, register pressure, and the compiler's own
+double-buffering are NOT modeled — the bound is conservative in the
+direction that avoids false positives.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from tools.aphrocheck.core import (Finding, Interval, IntervalEvaluator,
+                                   Module, dtype_bytes, iter_calls,
+                                   tail_name)
+from tools.aphrocheck.sites import (PallasSite, find_sites,
+                                    list_elements)
+
+DEFAULT_BUDGET = 16 * 1024 * 1024
+
+
+def _entry_bytes(module: Module, ev: IntervalEvaluator,
+                 node: ast.AST) -> Optional[Interval]:
+    """Byte interval of one scratch_shapes entry; None = not VMEM
+    (semaphores, SMEM) or unrecognized."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = tail_name(node.func)
+    if fn != "VMEM":
+        return None      # SemaphoreType.DMA / SMEM: not VMEM data
+    if not node.args or not isinstance(node.args[0], ast.Tuple):
+        return Interval(1, float("inf"))
+    lo, hi = 1.0, 1.0
+    for dim in node.args[0].elts:
+        iv = ev.eval(dim, node)
+        lo *= max(iv.lo, 1)
+        hi *= iv.hi
+    width = dtype_bytes(node.args[1]) if len(node.args) > 1 \
+        else Interval(1, 8)
+    return Interval(lo * width.lo, hi * width.hi)
+
+
+def _blockspec_bytes(module: Module, ev: IntervalEvaluator,
+                     node: ast.AST) -> Optional[Interval]:
+    if not isinstance(node, ast.Call) or \
+            tail_name(node.func) != "BlockSpec":
+        return None
+    if not node.args or not isinstance(node.args[0], ast.Tuple):
+        return None      # memory_space=ANY etc: stays in HBM
+    lo, hi = 1.0, 1.0
+    for dim in node.args[0].elts:
+        iv = ev.eval(dim, node)
+        lo *= max(iv.lo, 1)
+        hi *= iv.hi
+    # Input/output block dtypes are not visible statically: 1 byte
+    # keeps the lower bound sound.
+    return Interval(lo, hi * 8)
+
+
+def _has_fit_guard(scope: Optional[ast.AST]) -> bool:
+    if scope is None:
+        return False
+    for call in iter_calls(scope):
+        name = (tail_name(call.func) or "").lower()
+        if "fits" in name or "fallback" in name:
+            return True
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Name) and \
+                ("budget" in node.id.lower() or
+                 "vmem" in node.id.lower()):
+            return True
+    return False
+
+
+def _site_lower_bound(site: PallasSite) -> float:
+    module = site.module
+    ev = IntervalEvaluator(module, site.scope)
+    lo_total = 0.0
+    for variant in site.variants:
+        variant_lo = 0.0
+        base, appended, _ = list_elements(module, site.scope,
+                                          variant.scratch_shapes)
+        for entry in base:
+            iv = _entry_bytes(module, ev, entry)
+            if iv is not None:
+                variant_lo += iv.lo
+        # conditional appends may not execute: excluded from the bound
+        for specs in (variant.in_specs, variant.out_specs):
+            elems, _, resolved = list_elements(module, site.scope,
+                                               specs)
+            if not resolved and specs is not None and \
+                    isinstance(specs, ast.Call):
+                elems = [specs]     # single out_specs BlockSpec
+            for entry in elems:
+                iv = _blockspec_bytes(module, ev, entry)
+                if iv is not None:
+                    variant_lo += iv.lo
+        lo_total = max(lo_total, variant_lo)
+    return lo_total
+
+
+def run(ctx) -> List[Finding]:
+    findings: List[Finding] = []
+    budget = getattr(ctx, "vmem_budget", DEFAULT_BUDGET)
+    for module in ctx.modules:
+        for site in find_sites(module):
+            lo = _site_lower_bound(site)
+            if lo <= budget:
+                continue
+            if _has_fit_guard(site.scope):
+                continue
+            findings.append(module.finding(
+                "VMEM001", site.call,
+                f"pallas_call VMEM footprint is at least "
+                f"{int(lo):,} bytes (> {budget:,}-byte per-core "
+                "budget) with no fit-guarded fallback in the "
+                "enclosing function"))
+    return findings

@@ -1,0 +1,86 @@
+"""Static view of the flag registry (no imports of the checked code).
+
+Parses `aphrodite_tpu/common/flags.py` for `_register(Flag(...))`
+calls (each must carry a literal name — the registry module's own
+contract) and collects every registry-accessor read site
+(`flags.get_bool("APHRODITE_X")`, `is_set(...)`, ...) across the
+scanned modules. Both the FLAG pass and `--flags-md` build on this.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from tools.aphrocheck.core import (Module, int_const, iter_calls,
+                                   keyword_arg, str_const, tail_name)
+
+#: Accessor names whose first literal argument is a flag read.
+ACCESSORS = ("get_bool", "get_int", "get_float", "get_str", "is_set")
+
+
+@dataclasses.dataclass
+class RegisteredFlag:
+    name: str
+    type: str
+    default_repr: str
+    description: str
+    line: int
+
+
+def parse_registry(flags_module: Module) -> Dict[str, RegisteredFlag]:
+    """Extract registrations from the flags module's AST."""
+    out: Dict[str, RegisteredFlag] = {}
+    for call in iter_calls(flags_module.tree):
+        if tail_name(call.func) != "_register" or not call.args:
+            continue
+        flag = call.args[0]
+        if not isinstance(flag, ast.Call) or \
+                tail_name(flag.func) != "Flag":
+            continue
+        args: List[Optional[str]] = []
+        for pos in range(4):
+            node = flag.args[pos] if pos < len(flag.args) else None
+            args.append(node)
+        name = str_const(args[0]) if args[0] is not None else None
+        if name is None:
+            continue
+        ftype = (str_const(args[1]) or "?") if args[1] is not None \
+            else "?"
+        default = args[2]
+        if default is None:
+            default_repr = "None"
+        elif isinstance(default, ast.Constant):
+            default_repr = repr(default.value)
+        else:
+            default_repr = ast.dump(default)
+        desc = ""
+        if args[3] is not None:
+            desc = _joined_str(args[3])
+        kw_desc = keyword_arg(flag, "description")
+        if kw_desc is not None:
+            desc = _joined_str(kw_desc)
+        out[name] = RegisteredFlag(name, ftype, default_repr, desc,
+                                   flag.lineno)
+    return out
+
+
+def _joined_str(node: ast.AST) -> str:
+    """Python concatenates adjacent string literals at parse time into
+    one Constant, so this is just the literal (or empty)."""
+    s = str_const(node)
+    return s if s is not None else ""
+
+
+def accessor_reads(module: Module
+                   ) -> List[Tuple[str, ast.Call, str]]:
+    """(flag_name, call_node, accessor) for every registry read with a
+    literal name in the module."""
+    out = []
+    for call in iter_calls(module.tree):
+        fn = tail_name(call.func)
+        if fn in ACCESSORS and call.args:
+            name = str_const(call.args[0])
+            if name is not None and name.startswith("APHRODITE_"):
+                out.append((name, call, fn))
+    return out

@@ -1,0 +1,203 @@
+"""Pallas call-site resolution shared by the VMEM/DMA/GRID passes.
+
+A "site" is one `pl.pallas_call(...)` expression plus the resolved
+grid specification. Specs arrive two ways in this codebase:
+
+- direct kwargs: `pl.pallas_call(kernel, grid=..., in_specs=[...],
+  scratch_shapes=[...])` (the quant_matmul kernels), or
+- a `grid_spec=` variable assigned from
+  `pltpu.PrefetchScalarGridSpec(...)` (paged_attention, kv_write).
+
+Name resolution is branch-aware: when `num_prefetch`, `grid`, or an
+index-map function is assigned differently in the two arms of an
+`if` (paged_attention's ragged vs classic arms), each candidate
+carries its branch path and passes only pair candidates whose paths
+can coexist.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from tools.aphrocheck.core import (Module, int_const, iter_calls,
+                                   keyword_arg, tail_name)
+
+BranchPath = Tuple[Tuple[int, str], ...]
+
+
+@dataclasses.dataclass
+class Candidate:
+    node: ast.AST
+    path: BranchPath
+
+
+def resolve(module: Module, scope: Optional[ast.AST],
+            node: Optional[ast.AST]) -> List[Candidate]:
+    """Candidates for an expression: the node itself, or — for a Name
+    — every value assigned to it in the enclosing scope (falling back
+    to module scope), each tagged with its branch path. Local
+    function definitions resolve by name too (index maps)."""
+    if node is None:
+        return []
+    if not isinstance(node, ast.Name):
+        return [Candidate(node, module.branch_path(node))]
+    out: List[Candidate] = []
+    for root in filter(None, [scope, module.tree]):
+        for n in ast.walk(root):
+            if isinstance(n, ast.Assign):
+                for tgt in n.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id == node.id:
+                        out.append(Candidate(
+                            n.value, module.branch_path(n)))
+            elif isinstance(n, (ast.FunctionDef,
+                                ast.AsyncFunctionDef)) and \
+                    n.name == node.id:
+                out.append(Candidate(n, module.branch_path(n)))
+        if out:
+            break
+    return out
+
+
+@dataclasses.dataclass
+class SpecVariant:
+    """One branch-consistent reading of a site's grid specification."""
+    path: BranchPath
+    num_scalar_prefetch: Optional[int]
+    grid: Optional[ast.AST]
+    in_specs: Optional[ast.AST]
+    out_specs: Optional[ast.AST]
+    scratch_shapes: Optional[ast.AST]
+
+
+@dataclasses.dataclass
+class PallasSite:
+    module: Module
+    call: ast.Call                 # the pl.pallas_call(...) call
+    invocation: Optional[ast.Call]  # outer (...)(...) call, if any
+    scope: Optional[ast.AST]       # enclosing function
+    kernel_arg: Optional[ast.AST]
+    variants: List[SpecVariant]
+
+
+def _variant_from_grid_spec(module: Module, scope, cand: Candidate
+                            ) -> Optional[SpecVariant]:
+    node = cand.node
+    if not isinstance(node, ast.Call) or \
+            tail_name(node.func) not in ("PrefetchScalarGridSpec",
+                                         "GridSpec"):
+        return None
+    nsp_node = keyword_arg(node, "num_scalar_prefetch")
+    nsp: Optional[int] = 0 if nsp_node is None else None
+    nsp_path = cand.path
+    if nsp_node is not None:
+        for c in resolve(module, scope, nsp_node):
+            v = int_const(c.node)
+            if v is not None:
+                nsp = v
+                nsp_path = nsp_path + c.path
+                break
+    return SpecVariant(
+        path=nsp_path,
+        num_scalar_prefetch=nsp,
+        grid=keyword_arg(node, "grid"),
+        in_specs=keyword_arg(node, "in_specs"),
+        out_specs=keyword_arg(node, "out_specs"),
+        scratch_shapes=keyword_arg(node, "scratch_shapes"),
+    )
+
+
+def find_sites(module: Module) -> List[PallasSite]:
+    sites: List[PallasSite] = []
+    for call in iter_calls(module.tree):
+        if tail_name(call.func) != "pallas_call":
+            continue
+        scope = module.top_level_function(call)
+        parent = module.parents.get(call)
+        invocation = parent if isinstance(parent, ast.Call) and \
+            parent.func is call else None
+
+        variants: List[SpecVariant] = []
+        gs = keyword_arg(call, "grid_spec")
+        if gs is not None:
+            for cand in resolve(module, scope, gs):
+                v = _variant_from_grid_spec(module, scope, cand)
+                if v is not None:
+                    variants.append(v)
+        else:
+            variants.append(SpecVariant(
+                path=module.branch_path(call),
+                num_scalar_prefetch=0,
+                grid=keyword_arg(call, "grid"),
+                in_specs=keyword_arg(call, "in_specs"),
+                out_specs=keyword_arg(call, "out_specs"),
+                scratch_shapes=keyword_arg(call, "scratch_shapes"),
+            ))
+        sites.append(PallasSite(
+            module=module, call=call, invocation=invocation,
+            scope=scope,
+            kernel_arg=call.args[0] if call.args else None,
+            variants=variants))
+    return sites
+
+
+def list_elements(module: Module, scope, node: Optional[ast.AST]
+                  ) -> Tuple[List[ast.AST], List[ast.AST], bool]:
+    """(base_elements, conditionally_appended, resolved) of a list
+    expression. Appends/extends on the list's name (the quant_matmul
+    `scratch.append(...)` pattern) land in the second bucket — they
+    may or may not execute, so sound lower bounds exclude them."""
+    name = node.id if isinstance(node, ast.Name) else None
+    cands = resolve(module, scope, node)
+    base: List[ast.AST] = []
+    resolved = False
+    for cand in cands:
+        if isinstance(cand.node, (ast.List, ast.Tuple)):
+            base = list(cand.node.elts)
+            resolved = True
+            break
+    appended: List[ast.AST] = []
+    if name is not None and scope is not None:
+        for call in iter_calls(scope):
+            fn = call.func
+            if isinstance(fn, ast.Attribute) and \
+                    isinstance(fn.value, ast.Name) and \
+                    fn.value.id == name:
+                if fn.attr == "append" and call.args:
+                    appended.append(call.args[0])
+                elif fn.attr == "extend" and call.args and \
+                        isinstance(call.args[0],
+                                   (ast.List, ast.Tuple)):
+                    appended.extend(call.args[0].elts)
+    return base, appended, resolved
+
+
+def resolve_kernel_functions(module: Module, scope,
+                             kernel_arg: Optional[ast.AST]
+                             ) -> List[ast.FunctionDef]:
+    """FunctionDefs a pallas_call kernel argument may refer to,
+    looking through Name assignment, functools.partial, and IfExp."""
+    out: List[ast.FunctionDef] = []
+    seen = set()
+
+    def visit(node: Optional[ast.AST], depth: int = 0) -> None:
+        if node is None or depth > 4 or id(node) in seen:
+            return
+        seen.add(id(node))
+        if isinstance(node, ast.FunctionDef):
+            out.append(node)
+            return
+        if isinstance(node, ast.IfExp):
+            visit(node.body, depth + 1)
+            visit(node.orelse, depth + 1)
+            return
+        if isinstance(node, ast.Call):
+            if tail_name(node.func) == "partial" and node.args:
+                visit(node.args[0], depth + 1)
+            return
+        if isinstance(node, ast.Name):
+            for cand in resolve(module, scope, node):
+                visit(cand.node, depth + 1)
+
+    visit(kernel_arg)
+    return out
