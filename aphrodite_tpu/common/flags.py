@@ -232,6 +232,20 @@ _register(Flag(
     minimum=0, strict=True))
 
 _register(Flag(
+    "APHRODITE_QMM_STREAM", "bool", True,
+    "Streamed skinny-m quant-matmul path at m <= 64: the (n, k) tile "
+    "grid flattens into one work list and weight tiles stream through "
+    "an explicit cross-cell DMA ring; 0 pins the classic "
+    "compiler-managed grid for A/B runs."))
+
+_register(Flag(
+    "APHRODITE_QMM_STREAM_PF", "int", 2,
+    "Ring depth (VMEM tile slots) of the streamed quant-matmul weight "
+    "DMA ring; cell i starts cell i+depth-1's tile loads. Malformed "
+    "or < 2 values warn and fall back to the default.",
+    minimum=2))
+
+_register(Flag(
     "APHRODITE_QMM_DEFERRED_VMEM_MB", "int", 8,
     "VMEM budget (MiB) for the deferred-rescale accumulator planes; "
     "shapes that exceed it silently fall back to the classic kernel.",

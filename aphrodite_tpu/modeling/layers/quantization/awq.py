@@ -124,6 +124,9 @@ class AWQLinearMethod(LinearMethod):
                 # (AWQ is always 4-bit, so no bits gate needed). The a8
                 # kernel auto-selects classic vs deferred-rescale per
                 # shape; APHRODITE_QMM_DEFERRED pins it for A/B runs.
+                # Decode-shaped calls (m <= 64) default to the
+                # streamed work-list grid with its explicit weight DMA
+                # ring; APHRODITE_QMM_STREAM=0 pins the classic grid.
                 mm = awq_matmul_a8 if flags.get_bool(
                     "APHRODITE_W4A8") else awq_matmul
                 y = mm(x.reshape(-1, in_features), qw,

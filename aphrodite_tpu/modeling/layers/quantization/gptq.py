@@ -142,7 +142,12 @@ class GPTQLinearMethod(LinearMethod):
             # auto-selects between the classic and the deferred-rescale
             # (int32 group accumulator) variants per shape;
             # APHRODITE_QMM_DEFERRED=1/0 pins it for A/B runs (see the
-            # quant_matmul module docstring).
+            # quant_matmul module docstring). At m <= 64 (decode and
+            # bs=1 bursts) both kernels default to the STREAMED
+            # work-list grid — the activation block stays resident in
+            # VMEM and weight tiles flow through an explicit
+            # cross-cell DMA ring — with APHRODITE_QMM_STREAM=0
+            # pinning the classic compiler-managed grid.
             mm = gptq_matmul_a8 if (
                 flags.get_bool("APHRODITE_W4A8") and
                 cfg.weight_bits == 4) else gptq_matmul

@@ -44,6 +44,27 @@ k-tiles matter more), with an automatic fallback to the classic path
 when the extra int32 planes don't fit the VMEM budget
 (`APHRODITE_QMM_DEFERRED_VMEM_MB`, default 8). The profile harness's
 `--only ab` mode measures both variants at the bench geometries.
+
+Streamed skinny-m grid (LATENCY_r05 "what remains"): at m <= 64 the
+classic (m, n, k) grid is WEIGHT-STREAMING bound — every grid cell
+re-pays a fixed compiler-managed-BlockSpec cost to fetch its
+qweight/zeros/scales blocks, and at tiny m that fixed cost dwarfs the
+dot (the whole 3.5 GiB int4 matrix moves at ~430 GB/s effective
+against an ~820 GB/s HBM floor). The `_stream_kernel` path therefore
+flattens the (n, k) tile grid into ONE work-list dimension, keeps the
+padded activation block resident in VMEM for the entire call, and
+streams the weight tiles through an explicit double-buffered
+(`APHRODITE_QMM_STREAM_PF`-deep) cross-cell `make_async_copy` ring
+with per-slot DMA semaphores — cell w starts cell w+depth-1's
+HBM->VMEM tile copies before waiting on its own, so the next tile's
+DMA overlaps the current tile's dequant+dot across ALL cells (the
+PR-2 ragged-attention prefetch-ring design applied to the weight
+stream). Ring slots replace the per-cell double-buffered BlockSpec
+blocks in the VMEM budget, so deeper k-tiles fit (up to 4096 vs the
+classic 2048). Default at m <= 64; `APHRODITE_QMM_STREAM=0` pins the
+classic grid for A/B runs. Composes with deferred rescale: the int32
+group accumulators ride as kernel scratch and the scale rows still
+apply once at k-flush.
 """
 from __future__ import annotations
 
@@ -56,6 +77,9 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from aphrodite_tpu.common import flags
+from aphrodite_tpu.common.logger import init_logger
+
+logger = init_logger(__name__)
 
 # jax 0.4.x names the TPU compiler-params dataclass TPUCompilerParams;
 # 0.5+ renames it CompilerParams. Resolve once so every kernel in this
@@ -180,6 +204,288 @@ def _deferred_fits(block_m: int, block_n: int, gpt: int) -> bool:
     return (gpt * 4 + 4) * block_m * block_n <= budget_mb << 20
 
 
+# ------------------------------------------ streamed skinny-m path --
+# Selection + tile policy for the work-list/DMA-ring grid (see the
+# module docstring). _STREAM_K_CAP is deeper than the classic 2048:
+# ring slots replace the compiler's per-cell double-buffered weight
+# blocks, so the k-tile VMEM budget roughly doubles.
+_STREAM_M_MAX = 64
+_STREAM_K_CAP = 4096
+_STREAM_DEF_K_CAP = 1024     # deferred: int32 planes bound the k depth
+
+# Whole-kernel scoped-VMEM budget for the _clamp_k_vmem pre-check
+# (mirrors _deferred_fits, but covers the full tile set: LATENCY_r05's
+# block_k=4096 sweep point failed to COMPILE instead of clamping).
+_QMM_VMEM_BYTES = 16 << 20
+
+
+def _resolve_stream(stream, m: int) -> bool:
+    """A/B selector for the streamed skinny-m grid: an explicit
+    `stream` (profile harness / tests) wins; otherwise the path is the
+    default at m <= 64 (decode and bs=1 bursts) unless pinned off by
+    APHRODITE_QMM_STREAM=0."""
+    if stream is not None:
+        return bool(stream)
+    if m > _STREAM_M_MAX:
+        return False
+    return flags.get_bool("APHRODITE_QMM_STREAM")
+
+
+def _stream_pf() -> int:
+    """Weight-DMA ring depth (VMEM tile slots), read from
+    APHRODITE_QMM_STREAM_PF at CALL time. The flag is registered
+    non-strict with minimum 2, so a malformed or too-small value warns
+    and falls back to the default double buffer instead of killing the
+    call (let alone the import)."""
+    return max(2, flags.get_int("APHRODITE_QMM_STREAM_PF"))
+
+
+def _cell_bytes(block_k: int, *, layout: str, block_m: int,
+                block_n: int, gs: int, pack: int, x_bytes: int,
+                s_bytes: int, K: int, stream_slots: int,
+                deferred: bool, a16: bool) -> int:
+    """Approximate per-cell VMEM footprint of one quant-matmul kernel
+    at a candidate block_k — the _clamp_k_vmem cost model. Classic
+    grid: compiler-managed input blocks count twice (double
+    buffering); streamed grid: the explicit ring replaces the weight
+    blocks and x is resident whole."""
+    gpt = block_k // gs
+    if layout == "awq":
+        qw = block_k * (block_n // 8) * 4
+        temp = block_k * block_n * 4          # w_pm int32 plane tile
+    else:
+        qw = (block_k // pack) * block_n * 4
+        # a16 materializes the dequantized weight tile; a8 only a
+        # per-group unpack transient.
+        temp = block_k * block_n * x_bytes if a16 \
+            else gs * block_n * 4
+    zs = gpt * block_n * (4 + s_bytes)
+    acc = block_m * block_n * 4
+    planes = gpt * block_m * block_n * 4 if deferred else 0
+    if stream_slots:
+        return (stream_slots * (qw + zs) + 2 * block_m * K * x_bytes +
+                acc + planes + temp)
+    return 2 * (block_m * block_k * x_bytes + qw + zs) + acc + \
+        planes + temp
+
+
+def _clamp_k_vmem(block_k: int, gs: int, cell_bytes, tag: str) -> int:
+    """Step block_k down (halving — stays a multiple of gs and a
+    divisor of K, since _tile_k built it by doubling from gs) until
+    the tile set fits the scoped-VMEM budget. The runtime mirror of
+    aphrocheck's VMEM001: an oversized APHRODITE_QMM_BLOCK_K now
+    clamps with a debug log instead of failing the Mosaic compile."""
+    clamped = block_k
+    while clamped > gs and cell_bytes(clamped) > _QMM_VMEM_BYTES:
+        clamped //= 2
+    if clamped != block_k:
+        logger.debug(
+            "quant_matmul %s: block_k=%d tile set exceeds the "
+            "%d MiB VMEM budget; clamped to %d", tag, block_k,
+            _QMM_VMEM_BYTES >> 20, clamped)
+    return clamped
+
+
+def _stream_kernel(*refs, layout: str, bits: int, k_tiles: int,
+                   n_tiles: int, group_size: int, n_slots: int,
+                   a8: bool, deferred: bool):
+    """One work item w = n * k_tiles + k of the streamed skinny-m
+    grid: wait on this item's weight-tile DMAs (started n_slots-1
+    cells ago by the ring), start the item n_slots-1 ahead, then
+    dequant+dot against the RESIDENT activation block. k is the inner
+    run: the f32 accumulator persists in scratch across a column
+    block's k items (reset at k == 0, output written at the last k —
+    the out index map revisits the same block for the whole run).
+
+    The ring protocol is the ragged-attention cross-cell prefetch
+    applied to weights: cell 0 seeds the first n_slots items' copies;
+    every later cell starts item w + n_slots - 1 (landing in the slot
+    cell w - 1 just vacated, the deepest safe prefetch for the slot
+    count); every started copy is waited by its consuming cell, so
+    nothing stays in flight past the kernel."""
+    refs = list(refs)
+    x_ref = refs.pop(0)         # [k_tiles, block_m, block_k] resident
+    xs_ref = refs.pop(0) if a8 else None          # [block_m, 1]
+    qw_hbm, z_hbm, s_hbm, o_ref = refs[:4]
+    qw_ring, z_ring, s_ring, sems, acc_ref = refs[4:9]
+    g32_ref = refs[9] if deferred else None
+
+    w = pl.program_id(0)
+    total = n_tiles * k_tiles
+    k = jax.lax.rem(w, k_tiles)
+
+    gs = group_size
+    pack = 32 // bits
+    rpg = gs // pack                  # packed rows per group (gptq)
+    gpt = z_ring.shape[1]             # quant groups per k-tile
+    block_n = o_ref.shape[1]
+    qw_rows, qw_cols = qw_ring.shape[1], qw_ring.shape[2]
+
+    def item_dmas(n2, k2, slot2):
+        # One work item's three tile copies, issued back-to-back so
+        # the DMA engine overlaps them (K+V-style, PR 2).
+        return [
+            pltpu.make_async_copy(
+                qw_hbm.at[pl.ds(k2 * qw_rows, qw_rows),
+                          pl.ds(n2 * qw_cols, qw_cols)],
+                qw_ring.at[slot2], sems.at[slot2, 0]),
+            pltpu.make_async_copy(
+                z_hbm.at[pl.ds(k2 * gpt, gpt), :,
+                         pl.ds(n2 * block_n, block_n)],
+                z_ring.at[slot2], sems.at[slot2, 1]),
+            pltpu.make_async_copy(
+                s_hbm.at[pl.ds(k2 * gpt, gpt), :,
+                         pl.ds(n2 * block_n, block_n)],
+                s_ring.at[slot2], sems.at[slot2, 2]),
+        ]
+
+    def start_item(n2, k2, slot2):
+        for dma in item_dmas(n2, k2, slot2):
+            dma.start()
+
+    @pl.when(w == 0)
+    def _seed():
+        # Cells 1..n_slots-1 have no predecessor far enough back to
+        # start their loads; cell 0 seeds them (static unroll).
+        for s0 in range(min(n_slots, total)):
+            start_item(s0 // k_tiles, s0 % k_tiles, s0 % n_slots)
+
+    @pl.when((w >= 1) & (w + (n_slots - 1) < total))
+    def _prefetch():
+        nxt = w + (n_slots - 1)
+        start_item(nxt // k_tiles, jax.lax.rem(nxt, k_tiles),
+                   jax.lax.rem(nxt, n_slots))
+
+    slot = jax.lax.rem(w, n_slots)
+    for dma in item_dmas(w // k_tiles, k, slot):
+        dma.wait()
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    qw_t = qw_ring[slot]              # [qw_rows, qw_cols] int32
+    if layout == "awq":
+        planes = [
+            jax.lax.bitwise_and(
+                jax.lax.shift_right_logical(qw_t, 4 * p), 0xF)
+            for p in range(8)
+        ]
+        w_pm = jax.lax.concatenate(planes, 1)     # [block_k, block_n]
+
+    def w_codes(g):
+        """Group g's unpacked integer codes [gs, block_n] (plane-major
+        rows for gptq, plane-major lanes for awq — the same layouts
+        the classic kernels produce)."""
+        if layout == "awq":
+            return w_pm[g * gs:(g + 1) * gs]
+        return _unpack_planes(qw_t[g * rpg:(g + 1) * rpg], bits)
+
+    x_tile = x_ref[k]                 # [block_m, block_k]
+    if a8 and deferred:
+        for g in range(gpt):
+            w8 = (w_codes(g) - z_ring[slot, g]).astype(jnp.int8)
+            g32_ref[g] = jax.lax.dot_general(
+                x_tile[:, g * gs:(g + 1) * gs], w8,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+        acc_ref[...] += jnp.sum(
+            g32_ref[...].astype(jnp.float32) *
+            s_ring[slot].astype(jnp.float32), axis=0)
+    elif a8:
+        for g in range(gpt):
+            w8 = (w_codes(g) - z_ring[slot, g]).astype(jnp.int8)
+            d = jax.lax.dot_general(
+                x_tile[:, g * gs:(g + 1) * gs], w8,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            acc_ref[...] += d.astype(jnp.float32) * \
+                s_ring[slot, g].astype(jnp.float32)
+    else:
+        chunks = []
+        for g in range(gpt):
+            z = z_ring[slot, g]                   # [1, block_n] int32
+            s = s_ring[slot, g].astype(jnp.float32)
+            chunks.append(
+                ((w_codes(g) - z).astype(jnp.float32) *
+                 s).astype(x_tile.dtype))
+        wt = chunks[0] if gpt == 1 else jax.lax.concatenate(chunks, 0)
+        acc_ref[...] += jnp.dot(x_tile, wt,
+                                preferred_element_type=jnp.float32)
+
+    @pl.when(k == k_tiles - 1)
+    def _flush():
+        if a8:
+            o_ref[...] = (acc_ref[...] *
+                          xs_ref[...].astype(jnp.float32)
+                          ).astype(o_ref.dtype)
+        else:
+            o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+
+def _stream_call(x, xs, qweight, z3, s3, *, layout: str, bits: int,
+                 gs: int, block_m: int, block_n: int, block_k: int,
+                 padded_m: int, N: int, n_slots: int, deferred: bool,
+                 out_dtype, interpret: bool):
+    """Launch _stream_kernel: x [padded_m, K] (already permuted and
+    padded) goes resident as [k_tiles, block_m, block_k]; qweight and
+    the [G, 1, N] zero/scale rows stay in HBM (memory_space=ANY) and
+    stream through the ring. Returns [padded_m, N] (plane-major
+    columns for awq — callers un-permute as usual)."""
+    if padded_m != block_m:
+        raise ValueError(
+            f"streamed quant-matmul needs a single m tile: padded m "
+            f"{padded_m} != block_m {block_m} (use the classic grid)")
+    K = x.shape[1]
+    k_tiles = K // block_k
+    n_tiles = N // block_n
+    gpt = block_k // gs
+    a8 = xs is not None
+    if layout == "awq":
+        qw_rows, qw_cols = block_k, block_n // 8
+    else:
+        qw_rows, qw_cols = block_k // (32 // bits), block_n
+
+    x_t = x.reshape(block_m, k_tiles, block_k).swapaxes(0, 1)
+    in_specs = [
+        pl.BlockSpec((k_tiles, block_m, block_k),
+                     lambda w: (0, 0, 0)),
+    ]
+    inputs = [x_t]
+    if a8:
+        in_specs.append(pl.BlockSpec((block_m, 1), lambda w: (0, 0)))
+        inputs.append(xs)
+    in_specs.extend([pl.BlockSpec(memory_space=pl.ANY)] * 3)
+    inputs.extend([qweight, z3, s3])
+
+    scratch = [
+        pltpu.VMEM((n_slots, qw_rows, qw_cols), jnp.int32),
+        pltpu.VMEM((n_slots, gpt, 1, block_n), jnp.int32),
+        pltpu.VMEM((n_slots, gpt, 1, block_n), s3.dtype),
+        pltpu.SemaphoreType.DMA((n_slots, 3)),
+        pltpu.VMEM((block_m, block_n), jnp.float32),
+    ]
+    if deferred:
+        scratch.append(
+            pltpu.VMEM((gpt, block_m, block_n), jnp.int32))
+
+    return pl.pallas_call(
+        functools.partial(
+            _stream_kernel, layout=layout, bits=bits,
+            k_tiles=k_tiles, n_tiles=n_tiles, group_size=gs,
+            n_slots=n_slots, a8=a8, deferred=deferred),
+        grid=(n_tiles * k_tiles,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((block_m, block_n),
+                               lambda w: (0, w // k_tiles)),
+        out_shape=jax.ShapeDtypeStruct((padded_m, N), out_dtype),
+        scratch_shapes=scratch,
+        compiler_params=_CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(*inputs)
+
+
 def _kernel(x_ref, qw_ref, z_ref, s_ref, o_ref, acc_ref, *,
             bits: int, k_tiles: int, group_size: int):
     """One (m, n, k) grid step: dequant a [block_k, block_n] weight tile
@@ -227,13 +533,17 @@ def gptq_supported(in_features: int, out_features: int, bits: int,
 
 
 def _gptq_prologue(x, qzeros, scales, N: int, bits: int, gs: int,
-                   tile_dtype, k_cap: int = 0, acc_planes: int = 1):
+                   tile_dtype, k_cap: int = 0, acc_planes: int = 1,
+                   stream_slots: int = 0, deferred: bool = False):
     """Shared GPTQ wrapper prologue (one copy of the layout logic for
     the W4A16 and W4A8 kernels): plane-permute and pad x, unpack the
     zero points (+1, AutoGPTQ convention), lift scales to the [G, 1, N]
     block shape, and size the tiles. Returns
     (x, z_all, scales3, tiles) with tiles = (block_m, block_n, block_k,
-    padded_m, grid, groups_per_tile, k_tiles)."""
+    padded_m, grid, groups_per_tile, k_tiles). stream_slots > 0 sizes
+    for the streamed work-list grid (ring slots instead of per-cell
+    weight blocks); deferred adds the int32 accumulator planes to the
+    VMEM pre-check."""
     m, K = x.shape
     pack = 32 // bits
     # Tile sizes: per-grid-step overhead (~5us) dominates when tiles
@@ -242,6 +552,15 @@ def _gptq_prologue(x, qzeros, scales, N: int, bits: int, gs: int,
     block_k = _tile_k(K, gs, cap=k_cap)
     block_m, block_n, padded_m = _tile_mn(m, N, tile_dtype,
                                           acc_planes=acc_planes)
+    block_k = _clamp_k_vmem(
+        block_k, gs,
+        functools.partial(
+            _cell_bytes, layout="gptq", block_m=block_m,
+            block_n=block_n, gs=gs, pack=pack,
+            x_bytes=x.dtype.itemsize, s_bytes=scales.dtype.itemsize,
+            K=K, stream_slots=stream_slots, deferred=deferred,
+            a16=x.dtype != jnp.int8),
+        tag="gptq")
     # Plane-order unpack (see _unpack_planes): permute x's columns to
     # match — per GROUP, since the kernels unpack each group chunk
     # separately. The permutation is exactly a blockwise [R, pack]
@@ -269,23 +588,40 @@ def _gptq_prologue(x, qzeros, scales, N: int, bits: int, gs: int,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("bits", "group_size", "interpret"))
+                   static_argnames=("bits", "group_size", "interpret",
+                                    "stream"))
 def gptq_matmul(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
                 scales: jax.Array, *, bits: int, group_size: int,
-                interpret: bool = False) -> jax.Array:
+                interpret: bool = False, stream=None) -> jax.Array:
     """y[m, N] = dequant(qweight, qzeros, scales) matmul for 2-D x[m, K].
 
     block_k == group_size; m is padded to the dtype sublane multiple and
     tiled at <=512 rows; N tiled at 512 lanes (or N if smaller).
-    """
+
+    `stream` pins the skinny-m work-list/DMA-ring grid (None =
+    default at m <= 64 unless APHRODITE_QMM_STREAM=0 — see
+    _resolve_stream)."""
     m, K = x.shape
     N = qweight.shape[1]
     gs = group_size if group_size != -1 else K
     pack = 32 // bits
+    use_stream = _resolve_stream(stream, m)
+    n_slots = _stream_pf() if use_stream else 0
     x, z_all, scales3, tiles = _gptq_prologue(
-        x, qzeros, scales, N, bits, gs, x.dtype)
+        x, qzeros, scales, N, bits, gs, x.dtype,
+        k_cap=_STREAM_K_CAP if use_stream else 0,
+        stream_slots=n_slots)
     (block_m, block_n, block_k, padded_m, grid,
      groups_per_tile, k_tiles) = tiles
+
+    if use_stream:
+        out = _stream_call(
+            x, None, qweight, z_all, scales3, layout="gptq",
+            bits=bits, gs=gs, block_m=block_m, block_n=block_n,
+            block_k=block_k, padded_m=padded_m, N=N,
+            n_slots=n_slots, deferred=False, out_dtype=x.dtype,
+            interpret=interpret)
+        return out[:m] if padded_m != m else out
 
     out = pl.pallas_call(
         functools.partial(_kernel, bits=bits, k_tiles=k_tiles,
@@ -399,26 +735,40 @@ def _awq_unpermute(y, padded_m, N, n_tiles, block_n, order):
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("group_size", "interpret"))
+                   static_argnames=("group_size", "interpret",
+                                    "stream"))
 def awq_matmul(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
                scales: jax.Array, *, group_size: int,
-               interpret: bool = False) -> jax.Array:
+               interpret: bool = False, stream=None) -> jax.Array:
     """y[m, N] = x[m, K] @ dequant(qweight, qzeros, scales) for the AWQ
     int4 layout (qweight [K, N/8] int32, 8 interleaved nibbles along N;
     qzeros [G, N/8] same packing; scales [G, N]; w = (q - z) * s).
-    """
+
+    `stream` pins the skinny-m work-list/DMA-ring grid (same contract
+    as gptq_matmul)."""
     m, K = x.shape
     N = qweight.shape[1] * 8
     gs = group_size
     G = K // gs
 
-    block_k = _tile_k(K, gs)
+    use_stream = _resolve_stream(stream, m)
+    n_slots = _stream_pf() if use_stream else 0
+    block_k = _tile_k(K, gs,
+                      cap=_STREAM_K_CAP if use_stream else 0)
     # NOTE: pre-refactor AWQ defaulted block_n to 2048 at every m; the
     # shared sizing caps it at 1024 for block_m >= 512. The 0.93x
     # vs-baseline bench row (BENCH notes) was measured WITH the shared
     # sizing, so this is the tuned configuration of record;
     # APHRODITE_QMM_BLOCK_N=2048 restores the old tiling for A/B runs.
     block_m, block_n, padded_m = _tile_mn(m, N, x.dtype, min_bn=1024)
+    block_k = _clamp_k_vmem(
+        block_k, gs,
+        functools.partial(
+            _cell_bytes, layout="awq", block_m=block_m,
+            block_n=block_n, gs=gs, pack=8,
+            x_bytes=x.dtype.itemsize, s_bytes=scales.dtype.itemsize,
+            K=K, stream_slots=n_slots, deferred=False, a16=True),
+        tag="awq")
     if padded_m != m:
         x = jnp.pad(x, ((0, padded_m - m), (0, 0)))
 
@@ -428,6 +778,16 @@ def awq_matmul(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
     grid = (padded_m // block_m, n_tiles, k_tiles)
     z_pm, s_pm, order = _awq_zs_plane_major(qzeros, scales, N,
                                             n_tiles, block_n, G)
+
+    if use_stream:
+        out_pm = _stream_call(
+            x, None, qweight, z_pm, s_pm, layout="awq", bits=4,
+            gs=gs, block_m=block_m, block_n=block_n, block_k=block_k,
+            padded_m=padded_m, N=N, n_slots=n_slots, deferred=False,
+            out_dtype=x.dtype, interpret=interpret)
+        y = _awq_unpermute(out_pm, padded_m, N, n_tiles, block_n,
+                           order)
+        return y[:m] if padded_m != m else y
 
     out_pm = pl.pallas_call(
         functools.partial(_awq_kernel, k_tiles=k_tiles, group_size=gs),
@@ -527,22 +887,28 @@ def _awq_a8_deferred_kernel(x_ref, xs_ref, qw_ref, z_ref, s_ref, o_ref,
 
 @functools.partial(jax.jit,
                    static_argnames=("group_size", "interpret",
-                                    "deferred"))
+                                    "deferred", "stream"))
 def awq_matmul_a8(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
                   scales: jax.Array, *, group_size: int,
                   interpret: bool = False,
-                  deferred=None) -> jax.Array:
+                  deferred=None, stream=None) -> jax.Array:
     """W4A8 AWQ: per-row int8 activation quantization feeding integer
     dots (see awq_matmul for the layout story; only the dequant->dot
     arithmetic differs). `deferred` selects the rescale-at-flush
-    kernel — same contract as gptq_matmul_a8."""
+    kernel and `stream` the skinny-m work-list grid — same contracts
+    as gptq_matmul_a8."""
     m, K = x.shape
     N = qweight.shape[1] * 8
     gs = group_size
     G = K // gs
 
+    use_stream = _resolve_stream(stream, m)
+    n_slots = _stream_pf() if use_stream else 0
     use_def = _resolve_deferred(deferred, m)
-    k_cap = _DEFERRED_K_CAP if use_def else 0
+    if use_stream:
+        k_cap = _STREAM_DEF_K_CAP if use_def else _STREAM_K_CAP
+    else:
+        k_cap = _DEFERRED_K_CAP if use_def else 0
     block_k = _tile_k(K, gs, cap=k_cap)
     groups_per_tile = block_k // gs
     block_m, block_n, padded_m = _tile_mn(
@@ -551,10 +917,20 @@ def awq_matmul_a8(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
     if use_def and not _deferred_fits(block_m, block_n,
                                       groups_per_tile):
         use_def = False
-        block_k = _tile_k(K, gs)
+        block_k = _tile_k(K, gs,
+                          cap=_STREAM_K_CAP if use_stream else 0)
         groups_per_tile = block_k // gs
         block_m, block_n, padded_m = _tile_mn(m, N, jnp.bfloat16,
                                               min_bn=1024)
+    block_k = _clamp_k_vmem(
+        block_k, gs,
+        functools.partial(
+            _cell_bytes, layout="awq", block_m=block_m,
+            block_n=block_n, gs=gs, pack=8, x_bytes=1,
+            s_bytes=scales.dtype.itemsize, K=K,
+            stream_slots=n_slots, deferred=use_def, a16=False),
+        tag="awq_a8")
+    groups_per_tile = block_k // gs
 
     x8, xs = _quantize_activations_int8(x)
     if padded_m != m:
@@ -566,6 +942,16 @@ def awq_matmul_a8(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
     grid = (padded_m // block_m, n_tiles, k_tiles)
     z_pm, s_pm, order = _awq_zs_plane_major(qzeros, scales, N,
                                             n_tiles, block_n, G)
+
+    if use_stream:
+        out_pm = _stream_call(
+            x8, xs, qweight, z_pm, s_pm, layout="awq", bits=4,
+            gs=gs, block_m=block_m, block_n=block_n, block_k=block_k,
+            padded_m=padded_m, N=N, n_slots=n_slots,
+            deferred=use_def, out_dtype=x.dtype, interpret=interpret)
+        y = _awq_unpermute(out_pm, padded_m, N, n_tiles, block_n,
+                           order)
+        return y[:m] if padded_m != m else y
 
     kernel = functools.partial(
         _awq_a8_deferred_kernel if use_def else _awq_a8_kernel,
@@ -846,11 +1232,11 @@ def _gptq_a8_deferred_kernel(x_ref, xs_ref, qw_ref, z_ref, s_ref, o_ref,
 
 @functools.partial(jax.jit,
                    static_argnames=("bits", "group_size", "interpret",
-                                    "deferred"))
+                                    "deferred", "stream"))
 def gptq_matmul_a8(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
                    scales: jax.Array, *, bits: int, group_size: int,
                    interpret: bool = False,
-                   deferred=None) -> jax.Array:
+                   deferred=None, stream=None) -> jax.Array:
     """W4A8 variant of gptq_matmul: activations quantize to int8 with a
     per-row scale (absmax) in the XLA prologue, weights stay int4 at
     rest, and the kernel runs integer dots per quantization group. The
@@ -861,17 +1247,23 @@ def gptq_matmul_a8(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
     `deferred` selects the int32-group-accumulator rescale-at-flush
     kernel (None = APHRODITE_QMM_DEFERRED env, else autotune by shape
     — see `_resolve_deferred`); both variants compute the same
-    integer dots and differ only in f32 summation order."""
+    integer dots and differ only in f32 summation order. `stream`
+    pins the skinny-m work-list/DMA-ring grid (None = default at
+    m <= 64 unless APHRODITE_QMM_STREAM=0); the two knobs compose —
+    a streamed deferred call keeps its int32 planes in ring scratch."""
     m, K = x.shape
     N = qweight.shape[1]
     gs = group_size if group_size != -1 else K
     pack = 32 // bits
 
+    use_stream = _resolve_stream(stream, m)
+    n_slots = _stream_pf() if use_stream else 0
     use_def = _resolve_deferred(deferred, m)
     if use_def:
         # Pre-size the deferred tiles so the VMEM-fit fallback is
         # decided before the (single) prologue call.
-        bk = _tile_k(K, gs, cap=_DEFERRED_K_CAP)
+        bk = _tile_k(K, gs, cap=_STREAM_DEF_K_CAP if use_stream
+                     else _DEFERRED_K_CAP)
         gpt = bk // gs
         bm, bn, _ = _tile_mn(m, N, jnp.bfloat16, acc_planes=gpt)
         if not _deferred_fits(bm, bn, gpt):
@@ -889,17 +1281,32 @@ def gptq_matmul_a8(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
     # 16 MB scoped VMEM limit) the deep tile is legal; batch shapes
     # keep 1024 (round-4 A/B winner there). Deferred path: 512-deep
     # tiles keep the int32 plane count at groups_per_tile <= 4.
-    if use_def:
+    # Streamed path: ring slots replace the per-cell weight blocks in
+    # the VMEM budget, so the cap deepens to 4096 (1024 deferred) and
+    # _clamp_k_vmem steps it down to fit.
+    if use_stream:
+        k_cap = _STREAM_DEF_K_CAP if use_def else _STREAM_K_CAP
+    elif use_def:
         k_cap = _DEFERRED_K_CAP
     else:
         k_cap = 2048 if m <= 64 else 0
     x8, z_all, scales3, tiles = _gptq_prologue(
         x8, qzeros, scales, N, bits, gs, jnp.bfloat16, k_cap=k_cap,
-        acc_planes=(bk // gs) if use_def else 1)
+        acc_planes=(bk // gs) if use_def else 1,
+        stream_slots=n_slots, deferred=use_def)
     (block_m, block_n, block_k, padded_m, grid,
      groups_per_tile, k_tiles) = tiles
     if padded_m != m:
         xs = jnp.pad(xs, ((0, padded_m - m), (0, 0)))
+
+    if use_stream:
+        out = _stream_call(
+            x8, xs, qweight, z_all, scales3, layout="gptq",
+            bits=bits, gs=gs, block_m=block_m, block_n=block_n,
+            block_k=block_k, padded_m=padded_m, N=N,
+            n_slots=n_slots, deferred=use_def, out_dtype=x.dtype,
+            interpret=interpret)
+        return out[:m] if padded_m != m else out
 
     kernel = functools.partial(
         _gptq_a8_deferred_kernel if use_def else _gptq_a8_kernel,

@@ -4,14 +4,19 @@
 
 Usage:
     python benchmarks/latency.py --model <path-or-id> [--prompt-len 2000]
-        [--output-len 1024]
-Prints one JSON line: decode tok/s + TTFT.
+        [--output-len 1024] [--runs 3]
+Prints one JSON line in bench.py's round-5 convention:
+{"metric", "value", "samples", "n_runs", ...} — value is the MEDIAN of
+--runs timed runs (GC paused per run), every sample rides along so the
+run-to-run spread stays visible to driver captures.
 """
 from __future__ import annotations
 
 import argparse
+import gc
 import json
 import os
+import statistics
 import sys
 import time
 
@@ -34,6 +39,9 @@ def main() -> None:
     parser.add_argument("--multi-step", type=int, default=32)
     parser.add_argument("--block-size", type=int, default=16)
     parser.add_argument("--warmup", type=int, default=1)
+    parser.add_argument("--runs", type=int, default=3,
+                        help="timed runs; value = median (bench.py "
+                             "round-5 JSON convention)")
     args = parser.parse_args()
     if args.model == "synthetic-7b":
         from serving import synthetic_7b_dir
@@ -85,14 +93,37 @@ def main() -> None:
         # buckets compiling inside the measurement (round-4: 14 tok/s
         # reported where steady state was 55+).
         run(args.output_len)
-    wall, ttft, n = run(args.output_len)
-    decode_tps = (n - 1) / (wall - ttft) if n > 1 else 0.0
+    # Median of --runs timed runs (the bench.py round-5 discipline:
+    # single runs spread several percent; GC pauses show up as visible
+    # hiccups inside a 10 s measurement, so collection pauses for the
+    # duration of each run and every sample rides in the JSON).
+    samples, details = [], []
+    for r in range(max(1, args.runs)):
+        gc.collect()
+        gc.disable()
+        try:
+            wall, ttft, n = run(args.output_len)
+        finally:
+            gc.enable()
+        tps = (n - 1) / (wall - ttft) if n > 1 else 0.0
+        samples.append(round(tps, 1))
+        details.append({"ttft_s": round(ttft, 3),
+                        "e2e_s": round(wall, 2)})
+        print(f"[latency] run {r + 1}/{args.runs}: {tps:.1f} tok/s "
+              f"(ttft {ttft:.3f}s, e2e {wall:.2f}s)", file=sys.stderr,
+              flush=True)
+    value = statistics.median(samples)
+    mid = details[samples.index(value)] if value in samples \
+        else details[-1]
     print(json.dumps({
         "metric": "bs1_decode_tok_s",
-        "value": round(decode_tps, 1),
+        "value": round(value, 1),
         "unit": "tok/s",
-        "detail": {"ttft_s": round(ttft, 3), "e2e_s": round(wall, 2),
-                   "prompt_len": args.prompt_len, "output_len": n},
+        "samples": samples,
+        "n_runs": len(samples),
+        "detail": {"ttft_s": mid["ttft_s"], "e2e_s": mid["e2e_s"],
+                   "prompt_len": args.prompt_len,
+                   "output_len": args.output_len},
     }))
 
 

@@ -162,6 +162,56 @@ def main() -> None:
         row(f"gptq_matmul {name} [{B},{K}]x[{K},{N}]", s * 1e3, LAYERS,
             f"{flops / s / 1e12:.1f} TF/s")
 
+    # --- streamed-vs-classic skinny-m A/B (W4A8, the bench decode
+    # path): per-layer us and effective weight-streaming GB/s over the
+    # four per-layer GEMMs at m in {1, 16, 64}. The streamed grid
+    # flattens (n, k) into a work list and drives an explicit weight
+    # DMA ring (quant_matmul._stream_kernel); `stream` pins the
+    # variant so both compile at identical shapes. Effective GB/s
+    # counts the int4 qweight + packed zeros + scales actually read
+    # from HBM per layer — the LATENCY_r05 floor argument's ~430
+    # (classic) vs ~620 (parity) GB/s metric. ---
+    if want("qmm"):
+        from aphrodite_tpu.ops.pallas.quant_matmul import gptq_matmul_a8
+        layer_weight_bytes = sum(
+            K * N // 2 +                    # int4 qweight
+            (K // GROUP) * N // 2 +         # packed qzeros
+            (K // GROUP) * N * 2            # bf16 scales
+            for _, K, N in shapes)
+        stream_rows = []
+        for M in (1, 16, 64):
+            us = {"classic": 0.0, "streamed": 0.0}
+            for name, K, N in shapes:
+                x = jax.random.normal(key, (M, K), dtype=jnp.bfloat16)
+                qw = jax.random.randint(key, (K // 8, N), 0, 2**31 - 1,
+                                        dtype=jnp.int32)
+                qz = jax.random.randint(key, (K // GROUP, N // 8), 0,
+                                        2**31 - 1, dtype=jnp.int32)
+                sc = jnp.ones((K // GROUP, N), dtype=jnp.bfloat16) * 0.01
+                for label, use_stream in (("classic", False),
+                                          ("streamed", True)):
+                    def sstep(c, i, qw=qw, qz=qz, sc=sc, st=use_stream):
+                        xx = c
+                        o = gptq_matmul_a8(xx, qw, qz, sc, bits=4,
+                                           group_size=GROUP, stream=st)
+                        return xx + o[:, :1] * jnp.bfloat16(1e-30)
+                    s, rtt = device_bench(sstep, x)
+                    rtts.append(rtt)
+                    us[label] += s * 1e6
+                    row(f"QMM A/B {label} {name} m={M}", s * 1e3,
+                        LAYERS, "")
+            stream_rows.append((M, us["classic"], us["streamed"]))
+        print(f"\n=== streamed-vs-classic W4A8 skinny-m A/B "
+              f"(us/layer over the 4 GEMMs; effective weight GB/s) ===")
+        print(f"{'m':>4s} {'classic':>12s} {'streamed':>12s} "
+              f"{'speedup':>8s}")
+        for M, c_us, s_us in stream_rows:
+            c_gbs = layer_weight_bytes / (c_us * 1e-6) / 1e9
+            s_gbs = layer_weight_bytes / (s_us * 1e-6) / 1e9
+            print(f"{M:4d} {c_us:7.1f}us {c_gbs:4.0f}GB/s "
+                  f"{s_us:7.1f}us {s_gbs:4.0f}GB/s "
+                  f"{c_us / s_us:7.2f}x")
+
     # --- W4A8 quantized matmuls (int8 MXU path), same shapes ---
     if want("a8"):
         from aphrodite_tpu.ops.pallas.quant_matmul import gptq_matmul_a8
@@ -846,7 +896,8 @@ def main() -> None:
     # FULL-layer cross-check (which already contains the components)
     # are reference rows, not addends.
     excluded = ("bf16 dense", "kv_write prefill-window", "FULL decoder",
-                "PREFILL", "BURST", "PROMPT", "W4A8", "ATTN A/B")
+                "PREFILL", "BURST", "PROMPT", "W4A8", "ATTN A/B",
+                "QMM A/B")
     for name, ms_call, n, ms_step, note in rows:
         print(f"{name:54s} {ms_call * 1e3:9.1f} {n:4d} {ms_step:8.3f}  "
               f"{note}")

@@ -1,0 +1,32 @@
+"""Seeds DMA002 in the STREAMED-quant-matmul ring idiom: the copies
+are built by a helper that returns a LIST of make_async_copy objects,
+the ring slot arrives as a function PARAMETER (resolved through the
+call sites), and the semaphore array is 2-D (slot, channel). The
+start side runs depth-4, the wait side depth-2 — the n-th wait frees
+the wrong slot. Proves the DMA pass keeps tracing this shape (it must
+resolve bases through the local helper and moduli through parameter
+passing, exactly what _stream_kernel in quant_matmul.py relies on)."""
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def helper_ring_kernel(x_hbm, o_ref, buf, sems):
+    i = pl.program_id(0)
+
+    def item_dmas(slot2):
+        return [
+            pltpu.make_async_copy(x_hbm, buf.at[slot2],
+                                  sems.at[slot2, 0]),
+            pltpu.make_async_copy(x_hbm, buf.at[slot2],
+                                  sems.at[slot2, 1]),
+        ]
+
+    def start_item(slot2):
+        for dma in item_dmas(slot2):
+            dma.start()
+
+    start_item(jax.lax.rem(i + 3, 4))
+    for dma in item_dmas(jax.lax.rem(i, 2)):
+        dma.wait()
+    o_ref[...] = buf[0]

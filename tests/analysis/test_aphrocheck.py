@@ -95,6 +95,7 @@ def test_scan_covers_benches():
     (vmem_pass.run, "fixture_vmem.py", "VMEM001"),
     (dma_pass.run, "fixture_dma_wait.py", "DMA001"),
     (dma_pass.run, "fixture_dma_mod.py", "DMA002"),
+    (dma_pass.run, "fixture_dma_ring_helper.py", "DMA002"),
     (dma_pass.run, "fixture_dma_sem.py", "DMA003"),
     (grid_pass.run, "fixture_grid_arity.py", "GRID001"),
     (grid_pass.run, "fixture_grid_args.py", "GRID002"),
@@ -133,6 +134,11 @@ def test_clean_constructs_stay_quiet():
                               [_fixture("fixture_dma_sem.py")])
     assert _count(findings, "DMA001", "fixture_dma_sem") == 0
     assert _count(findings, "DMA002", "fixture_dma_sem") == 0
+    # the helper-list ring fixture (the _stream_kernel idiom) pairs
+    # its starts and waits correctly — only the moduli are seeded bad
+    h = _pass_findings(dma_pass.run,
+                       [_fixture("fixture_dma_ring_helper.py")])
+    assert _count(h, "DMA001", "fixture_dma_ring_helper") == 0
     # and the GRID fixtures' correct out_spec maps stay quiet
     g = _pass_findings(grid_pass.run, [_fixture("fixture_grid_arity.py")])
     assert _count(g, "GRID001", "fixture_grid_arity") == 1  # in_spec only

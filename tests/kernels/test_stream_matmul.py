@@ -1,0 +1,266 @@
+"""Streamed skinny-m quant-matmul grid (ISSUE 4 tentpole) vs the
+classic compiler-managed grid and the XLA dequantize oracle.
+
+The streamed path flattens the (n, k) tile grid into one work list
+and drives an explicit cross-cell weight DMA ring
+(`quant_matmul._stream_kernel`); these tests pin:
+
+- parity at m in {1, 8, 64} for gptq AND awq, including the K=384
+  tail (three single-group k-tiles at gs 128), group sizes 64/128,
+  deferred rescale on/off, and int8 activations (the W4A8 kernels);
+- selection: default ON at m <= 64, OFF above, APHRODITE_QMM_STREAM=0
+  pins the classic grid;
+- the APHRODITE_QMM_STREAM_PF per-call read warns-and-defaults on a
+  malformed value (never kills the call, let alone the import);
+- the deep-k VMEM-fit guard: an oversized APHRODITE_QMM_BLOCK_K
+  clamps with a correct result instead of failing to compile.
+
+All kernels run in interpret mode on CPU (tier-1)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from aphrodite_tpu.modeling.layers.quantization.awq import (
+    AWQConfig, AWQLinearMethod)
+from aphrodite_tpu.modeling.layers.quantization.gptq import (
+    GPTQConfig, GPTQLinearMethod)
+from aphrodite_tpu.ops.pallas.quant_matmul import (
+    _cell_bytes, _clamp_k_vmem, _quantize_activations_int8,
+    _resolve_stream, _stream_pf, awq_matmul, awq_matmul_a8,
+    gptq_matmul, gptq_matmul_a8)
+
+rs = np.random.RandomState(11)
+
+
+def make_gptq(bits, group_size, K, N, m, dtype=np.float32):
+    pack = 32 // bits
+    G = K // group_size
+    params = {
+        "qweight": jnp.asarray(rs.randint(
+            -2**31, 2**31, (K // pack, N), dtype=np.int32)),
+        "qzeros": jnp.asarray(rs.randint(
+            -2**31, 2**31, (G, N // pack), dtype=np.int32)),
+        "scales": jnp.asarray(
+            rs.rand(G, N).astype(dtype) * 0.1 + 0.01),
+        "g_idx": jnp.asarray(
+            (np.arange(K) // group_size).astype(np.int32)),
+    }
+    return params, jnp.asarray(rs.randn(m, K).astype(dtype))
+
+
+def make_awq(group_size, K, N, m, dtype=np.float32):
+    G = K // group_size
+    params = {
+        "qweight": jnp.asarray(rs.randint(
+            -2**31, 2**31, (K, N // 8), dtype=np.int32)),
+        "qzeros": jnp.asarray(rs.randint(
+            -2**31, 2**31, (G, N // 8), dtype=np.int32)),
+        "scales": jnp.asarray(
+            rs.rand(G, N).astype(dtype) * 0.1 + 0.01),
+    }
+    return params, jnp.asarray(rs.randn(m, K).astype(dtype))
+
+
+def _gptq_dequant(params, group_size):
+    method = GPTQLinearMethod(GPTQConfig(4, 128))
+    method.config.group_size = group_size
+    return method.dequantize(params, jnp.float32)
+
+
+def _a8_oracle(x, w_dequant):
+    x8, xs = _quantize_activations_int8(x)
+    return np.asarray((x8.astype(jnp.float32) * xs) @ w_dequant)
+
+
+def _rel(ref, got):
+    return np.abs(ref - got).max() / (np.abs(ref).max() + 1e-9)
+
+
+# -------------------------------------------------- parity: W4A16 --
+
+@pytest.mark.parametrize("m", [1, 8, 64])
+@pytest.mark.parametrize("gs,K", [(128, 512), (128, 384), (64, 384)])
+def test_gptq_stream_matches_classic(m, gs, K):
+    """Streamed vs classic grid vs the dequantize oracle (W4A16):
+    identical integer dequant, f32 accumulation differing only in
+    tile-boundary summation order."""
+    params, x = make_gptq(4, gs, K, 256, m)
+    ref = np.asarray(x @ _gptq_dequant(params, gs))
+    got = {}
+    for stream in (False, True):
+        got[stream] = np.asarray(gptq_matmul(
+            x, params["qweight"], params["qzeros"], params["scales"],
+            bits=4, group_size=gs, interpret=True, stream=stream))
+        assert _rel(ref, got[stream]) < 2e-5, (stream,
+                                               _rel(ref, got[stream]))
+    assert _rel(got[False], got[True]) < 1e-4
+
+
+@pytest.mark.parametrize("m", [1, 8, 64])
+@pytest.mark.parametrize("gs,K", [(128, 512), (128, 384), (64, 384)])
+def test_awq_stream_matches_classic(m, gs, K):
+    """Same contract for the AWQ lane-plane layout (min block_n 1024,
+    plane-major output un-permute)."""
+    params, x = make_awq(gs, K, 1024, m)
+    method = AWQLinearMethod(AWQConfig(4, gs))
+    ref = np.asarray(x @ method.dequantize(params, jnp.float32))
+    got = {}
+    for stream in (False, True):
+        got[stream] = np.asarray(awq_matmul(
+            x, params["qweight"], params["qzeros"], params["scales"],
+            group_size=gs, interpret=True, stream=stream))
+        assert _rel(ref, got[stream]) < 2e-5, (stream,
+                                               _rel(ref, got[stream]))
+    assert _rel(got[False], got[True]) < 1e-4
+
+
+# ----------------------------------- parity: W4A8, deferred on/off --
+
+@pytest.mark.parametrize("m", [1, 8, 64])
+@pytest.mark.parametrize("deferred", [False, True])
+@pytest.mark.parametrize("gs,K", [(128, 384), (64, 384), (128, 512)])
+def test_gptq_a8_stream_parity(m, deferred, gs, K):
+    """Streamed W4A8 (int8 activations): both accumulation variants
+    ride the ring — int32 group dots are exact, so streamed vs
+    classic agree to f32 summation order, and both sit inside the
+    W4A8 tolerance vs the dequantize oracle."""
+    params, x = make_gptq(4, gs, K, 256, m)
+    oracle = _a8_oracle(x, _gptq_dequant(params, gs))
+    got = {}
+    for stream in (False, True):
+        got[stream] = np.asarray(gptq_matmul_a8(
+            x, params["qweight"], params["qzeros"], params["scales"],
+            bits=4, group_size=gs, interpret=True,
+            deferred=deferred, stream=stream))
+        assert _rel(oracle, got[stream]) < 2e-2, (stream, deferred)
+    assert _rel(got[False], got[True]) < 1e-4
+
+
+@pytest.mark.parametrize("m", [1, 8, 64])
+@pytest.mark.parametrize("deferred", [False, True])
+@pytest.mark.parametrize("gs,K", [(128, 384), (64, 384), (128, 512)])
+def test_awq_a8_stream_parity(m, deferred, gs, K):
+    params, x = make_awq(gs, K, 1024, m)
+    method = AWQLinearMethod(AWQConfig(4, gs))
+    oracle = _a8_oracle(x, method.dequantize(params, jnp.float32))
+    got = {}
+    for stream in (False, True):
+        got[stream] = np.asarray(awq_matmul_a8(
+            x, params["qweight"], params["qzeros"], params["scales"],
+            group_size=gs, interpret=True,
+            deferred=deferred, stream=stream))
+        assert _rel(oracle, got[stream]) < 2e-2, (stream, deferred)
+    assert _rel(got[False], got[True]) < 1e-4
+
+
+# --------------------------------------------- selection + flags --
+
+def test_stream_resolution(monkeypatch):
+    """Explicit arg wins; then the env pin; default is ON at m <= 64
+    (decode / bs=1 bursts) and OFF above."""
+    monkeypatch.delenv("APHRODITE_QMM_STREAM", raising=False)
+    assert _resolve_stream(True, 8192) and not _resolve_stream(False, 1)
+    assert _resolve_stream(None, 1)
+    assert _resolve_stream(None, 64)
+    assert not _resolve_stream(None, 65)
+    monkeypatch.setenv("APHRODITE_QMM_STREAM", "0")
+    assert not _resolve_stream(None, 1)       # classic-grid A/B pin
+    assert _resolve_stream(True, 1)           # explicit still wins
+    monkeypatch.setenv("APHRODITE_QMM_STREAM", "1")
+    assert _resolve_stream(None, 64)
+
+
+def test_stream_env_pin_selects_classic(monkeypatch):
+    """APHRODITE_QMM_STREAM=0 reproduces the classic-grid result for
+    a default (stream=None) skinny-m call (unique shape: the env is
+    read at trace time, so the shape must not share a jit cache entry
+    with an unpinned default call)."""
+    params, x = make_gptq(4, 128, 256, 384, 6)
+    classic = np.asarray(gptq_matmul(
+        x, params["qweight"], params["qzeros"], params["scales"],
+        bits=4, group_size=128, interpret=True, stream=False))
+    monkeypatch.setenv("APHRODITE_QMM_STREAM", "0")
+    pinned = np.asarray(gptq_matmul(
+        x, params["qweight"], params["qzeros"], params["scales"],
+        bits=4, group_size=128, interpret=True))
+    np.testing.assert_allclose(classic, pinned, rtol=0, atol=0)
+
+
+def test_stream_pf_bad_value_warns_and_defaults(monkeypatch):
+    """The ring depth is read per CALL through the registry's
+    non-strict path: a malformed (or too-small) value warns and falls
+    back to the default double buffer — it must never kill the call,
+    and a fortiori never the import (the PR-2 ATTN_PF lesson)."""
+    monkeypatch.setenv("APHRODITE_QMM_STREAM_PF", "banana")
+    with pytest.warns(RuntimeWarning, match="APHRODITE_QMM_STREAM_PF"):
+        assert _stream_pf() == 2
+    monkeypatch.setenv("APHRODITE_QMM_STREAM_PF", "1")
+    with pytest.warns(RuntimeWarning, match="APHRODITE_QMM_STREAM_PF"):
+        assert _stream_pf() == 2
+    # end-to-end: the streamed call still computes, with a warning
+    monkeypatch.setenv("APHRODITE_QMM_STREAM_PF", "not-a-depth")
+    params, x = make_gptq(4, 128, 256, 256, 3)
+    ref = np.asarray(x @ _gptq_dequant(params, 128))
+    with pytest.warns(RuntimeWarning, match="APHRODITE_QMM_STREAM_PF"):
+        got = np.asarray(gptq_matmul(
+            x, params["qweight"], params["qzeros"], params["scales"],
+            bits=4, group_size=128, interpret=True, stream=True))
+    assert _rel(ref, got) < 2e-5
+
+
+@pytest.mark.parametrize("depth", ["2", "3", "4"])
+def test_stream_pf_depth_sweep(monkeypatch, depth):
+    """Deeper rings change only the prefetch distance, never the
+    result (every cell waits its own item's copies). Shapes are
+    depth-unique so each depth gets its own trace (per-call env
+    reads happen at trace time under jit)."""
+    K = {"2": 512, "3": 384, "4": 256}[depth]
+    monkeypatch.setenv("APHRODITE_QMM_STREAM_PF", depth)
+    params, x = make_gptq(4, 128, K, 512, 8)
+    ref = np.asarray(x @ _gptq_dequant(params, 128))
+    got = np.asarray(gptq_matmul(
+        x, params["qweight"], params["qzeros"],
+        params["scales"], bits=4, group_size=128,
+        interpret=True, stream=True))
+    assert _rel(ref, got) < 2e-5, depth
+
+
+# ------------------------------------------- deep-k VMEM-fit guard --
+
+def test_clamp_k_vmem_steps_down():
+    """The footprint pre-check (mirroring _deferred_fits) halves
+    block_k until the tile set fits — staying a multiple of gs — and
+    leaves fitting tile sets alone."""
+    fp = lambda bk: _cell_bytes(
+        bk, layout="gptq", block_m=512, block_n=2048, gs=128, pack=8,
+        x_bytes=1, s_bytes=2, K=4096, stream_slots=0, deferred=False,
+        a16=False)
+    assert _clamp_k_vmem(4096, 128, fp, tag="test") < 4096
+    clamped = _clamp_k_vmem(4096, 128, fp, tag="test")
+    assert clamped % 128 == 0 and fp(clamped) <= 16 << 20
+    assert _clamp_k_vmem(1024, 128, fp, tag="test") == 1024
+
+
+def test_oversized_block_k_env_clamps(monkeypatch):
+    """LATENCY_r05's sweep note: APHRODITE_QMM_BLOCK_K=4096 used to
+    fail the Mosaic compile at the prefill geometry; the prologue's
+    footprint pre-check now steps the cap down instead. Checked at
+    the tile-sizing layer (the full 512x4096x2048 matmul is too slow
+    for interpret mode)."""
+    from aphrodite_tpu.ops.pallas.quant_matmul import _gptq_prologue
+    monkeypatch.setenv("APHRODITE_QMM_BLOCK_K", "4096")
+    x8 = jnp.zeros((512, 4096), jnp.int8)        # one prefill round
+    qzeros = jnp.zeros((32, 2048 // 8), jnp.int32)
+    scales = jnp.ones((32, 2048), jnp.bfloat16)
+    _, _, _, tiles = _gptq_prologue(x8, qzeros, scales, 2048, 4, 128,
+                                    jnp.bfloat16)
+    block_k = tiles[2]
+    assert block_k == 2048, block_k    # stepped down from the env 4096
+    # and a small end-to-end call under the same env stays correct
+    params, x = make_gptq(4, 128, 512, 256, 16)
+    oracle = _a8_oracle(x, _gptq_dequant(params, 128))
+    got = np.asarray(gptq_matmul_a8(
+        x, params["qweight"], params["qzeros"], params["scales"],
+        bits=4, group_size=128, interpret=True, stream=False))
+    assert _rel(oracle, got) < 2e-2

@@ -247,6 +247,34 @@ def main() -> int:
     if rel > 3e-2:
         failures.append(("gptq", rel))
 
+    # -- streamed skinny-m grid, compiled on the real chip: the
+    # decode-shaped (m<=64) work-list/DMA-ring path vs the classic
+    # grid at identical inputs, W4A16 and W4A8 (deferred on/off) --
+    from aphrodite_tpu.ops.pallas.quant_matmul import gptq_matmul_a8
+    xs16 = jnp.asarray(rs.randn(16, K), jnp.bfloat16)
+    refs16 = np.asarray(xs16 @ method.dequantize(params, jnp.bfloat16),
+                        np.float32)
+    gots16 = np.asarray(gptq_matmul(xs16, qw, qz, sc, bits=bits,
+                                    group_size=gs, stream=True),
+                        np.float32)
+    rel = np.abs(refs16 - gots16).max() / (np.abs(refs16).max() + 1e-9)
+    print(f"gptq_matmul streamed m=16: rel err {rel:.2e}")
+    if rel > 3e-2:
+        failures.append(("gptq_stream", rel))
+    a8c = np.asarray(gptq_matmul_a8(xs16, qw, qz, sc, bits=bits,
+                                    group_size=gs, stream=False),
+                     np.float32)
+    for tag, kwargs in (("stream", dict(stream=True)),
+                        ("stream+deferred",
+                         dict(stream=True, deferred=True))):
+        a8s = np.asarray(gptq_matmul_a8(xs16, qw, qz, sc, bits=bits,
+                                        group_size=gs, **kwargs),
+                         np.float32)
+        rel = np.abs(a8c - a8s).max() / (np.abs(a8c).max() + 1e-9)
+        print(f"gptq_matmul_a8 {tag} m=16 vs classic: rel err {rel:.2e}")
+        if rel > 1e-3:
+            failures.append((f"gptq_a8_{tag}", rel))
+
     # -- fused AWQ dequant matmul --
     from aphrodite_tpu.modeling.layers.quantization.awq import (
         AWQConfig, AWQLinearMethod)
