@@ -1,0 +1,24 @@
+"""Version-bridging JAX imports.
+
+This is the ONE module allowed to touch deprecated or moved JAX API
+paths (aphrocheck SHARD003 exempts it, exactly like the flag registry
+is the one module allowed raw os.environ reads). Every accessor
+probes the CURRENT spelling first and falls back to the legacy path
+only when the running JAX predates the move, so nothing here emits a
+deprecation warning on either side of the fence.
+"""
+from __future__ import annotations
+
+import jax
+
+
+def get_shard_map():
+    """`jax.shard_map` (jax >= 0.6 spelling), falling back to
+    `jax.experimental.shard_map.shard_map` on jax 0.4.x/0.5.x where
+    the symbol has not moved yet (VERDICT r5 item #9: the experimental
+    path is deprecated and removed upstream)."""
+    sm = getattr(jax, "shard_map", None)
+    if callable(sm):    # a module here would mean the old layout
+        return sm
+    from jax.experimental import shard_map as _legacy
+    return _legacy.shard_map

@@ -603,7 +603,14 @@ class ModelRunner:
     # ---- public API ----
 
     def _apply_block_copies(self, kv_caches, blocks_to_copy):
-        """CoW copies scheduled this round, applied before the step."""
+        """CoW copies scheduled this round, applied before the step.
+
+        The index arrays are padded to a power-of-two bucket: every
+        distinct copy count was its own compiled _copy_fn program
+        (~20 s per remote compile for one fork burst that will never
+        repeat that exact size). Pad lanes carry the OOB page index,
+        which copy_blocks' fill/drop gather+scatter modes turn into
+        no-ops."""
         if not blocks_to_copy:
             return kv_caches
         src, dst = [], []
@@ -611,9 +618,14 @@ class ModelRunner:
             for d in ds:
                 src.append(s)
                 dst.append(d)
-        return self._copy_fn(kv_caches,
-                             jnp.asarray(src, dtype=jnp.int32),
-                             jnp.asarray(dst, dtype=jnp.int32))
+        oob = self.num_slots // self.page_size
+        padded = _pow2_bucket(len(src), lo=8)
+        src_arr = np.full((padded,), oob, dtype=np.int32)
+        dst_arr = np.full((padded,), oob, dtype=np.int32)
+        src_arr[:len(src)] = src
+        dst_arr[:len(dst)] = dst
+        return self._copy_fn(kv_caches, jnp.asarray(src_arr),
+                             jnp.asarray(dst_arr))
 
     def execute_model(
         self,

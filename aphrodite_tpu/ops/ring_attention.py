@@ -127,7 +127,8 @@ def make_ring_fn(mesh: Mesh, scale: float, axis_name: str = "sp"):
     """shard_map-wrapped ring over `axis_name` (sequence dim): the ONE
     dispatch construction shared by the serving layer (inside jit, where
     GSPMD inserts any resharding) and the standalone wrapper below."""
-    from jax.experimental.shard_map import shard_map
+    from aphrodite_tpu.common.compat import get_shard_map
+    shard_map = get_shard_map()
 
     spec = P(None, axis_name, None, None)
     return shard_map(

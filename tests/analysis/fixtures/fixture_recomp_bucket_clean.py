@@ -1,0 +1,36 @@
+"""Clean-construct precision fixture for RECOMP002: the bucketed
+batch-builder idiom the real model runner uses — the grown list is
+padded into a bucket-sized numpy array BEFORE the asarray that feeds
+the jitted callee, so the device shape is stable per bucket. The
+RECOMP pass must stay quiet."""
+import bisect
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_BUCKETS = [8, 16, 32, 64]
+
+
+def _bucket(value, buckets):
+    idx = bisect.bisect_left(buckets, value)
+    if idx == len(buckets):
+        return buckets[-1] if value <= buckets[-1] else value
+    return buckets[idx]
+
+
+def _body(ids):
+    return ids + 1
+
+
+_step = jax.jit(_body)
+
+
+def run_round(groups):
+    tokens = []
+    for g in groups:
+        tokens.extend(g)
+    padded = _bucket(len(tokens), _BUCKETS)
+    ids = np.zeros((padded,), dtype=np.int32)
+    ids[:len(tokens)] = tokens
+    return _step(jnp.asarray(ids))

@@ -1,0 +1,12 @@
+"""Seeds RECOMP003: an f-string interpolation inside a jitted
+function — it formats a tracer repr exactly once, at trace time, and
+never re-runs on later calls (the classic silent-debug-print trap)."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x):
+    tag = f"step input {x}"     # <- trace-time formatting
+    del tag
+    return jnp.tanh(x)

@@ -1,0 +1,12 @@
+"""Seeds RECOMP001: a Python `if` branching on a traced value inside
+a jitted function — raises TracerBoolConversionError at trace time
+(or, coerced, silently concretizes per call)."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x):
+    if jnp.sum(x) > 0:          # <- tracer in a Python branch
+        return x * 2.0
+    return -x

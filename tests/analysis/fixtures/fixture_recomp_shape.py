@@ -1,0 +1,19 @@
+"""Seeds RECOMP002: a per-call-grown Python list flowing through
+jnp.asarray straight into a jitted callable — every distinct list
+length is a silent full recompile (~20 s each on this platform)."""
+import jax
+import jax.numpy as jnp
+
+
+def _body(indices):
+    return indices * 2
+
+
+_apply = jax.jit(_body)
+
+
+def run_round(pairs):
+    src = []
+    for s, d in pairs:
+        src.append(s * 16 + d)
+    return _apply(jnp.asarray(src))

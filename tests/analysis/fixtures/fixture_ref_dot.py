@@ -1,0 +1,27 @@
+"""Seeds REF003: a kernel matmul without `preferred_element_type` —
+accumulation silently inherits the bf16 operand dtype instead of
+f32, the numeric-corruption class every real kernel in
+ops/pallas/ guards against explicitly."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(x_ref, w_ref, o_ref, acc_ref):
+    acc_ref[...] += jnp.dot(x_ref[...], w_ref[...])
+    o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+
+def launch(x, w):
+    return pl.pallas_call(
+        _kernel,
+        grid=(4,),
+        in_specs=[
+            pl.BlockSpec((8, 128), lambda i: (i, 0)),
+            pl.BlockSpec((128, 128), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((8, 128), jnp.float32)],
+    )(x, w)

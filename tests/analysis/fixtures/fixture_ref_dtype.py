@@ -1,0 +1,23 @@
+"""Seeds REF004: an f32 value stored into an int32 accumulator plane
+— the store truncates silently (the deferred-rescale idiom requires
+the int32 planes to receive int32 dot results only)."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(x_ref, o_ref, g32_ref):
+    g32_ref[...] = x_ref[...].astype(jnp.float32)
+    o_ref[...] = g32_ref[...].astype(o_ref.dtype)
+
+
+def launch(x):
+    return pl.pallas_call(
+        _kernel,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((8, 128), jnp.int32)],
+    )(x)

@@ -1,0 +1,26 @@
+"""Seeds REF002: the ring slot cycles modulo 3 but the scratch ring
+has 4 slots — slot arithmetic and the scratch array disagree (the
+in-bounds-but-skewed variant REF001 cannot catch: 0 <= rem(i, 3) < 4
+never goes out of bounds, it just silently reuses the wrong slot)."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _ring_kernel(x_ref, o_ref, buf):
+    i = pl.program_id(0)
+    slot = jax.lax.rem(i, 3)
+    buf[slot] = x_ref[...]
+    o_ref[...] = buf[slot]
+
+
+def launch(x):
+    return pl.pallas_call(
+        _ring_kernel,
+        grid=(8,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((64, 128), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((4, 8, 128), jnp.float32)],
+    )(x)

@@ -1,0 +1,23 @@
+"""Seeds REF001: the kernel indexes slot 2 of a 2-slot VMEM scratch
+buffer — provably out of bounds against the scratch shape the
+positional binding resolves (the bug class that otherwise surfaces as
+an opaque Mosaic compile error naming neither ref nor line)."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(x_ref, o_ref, buf):
+    o_ref[...] = buf[2] + x_ref[...]
+
+
+def launch(x):
+    return pl.pallas_call(
+        _kernel,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((2, 8, 128), jnp.float32)],
+    )(x)

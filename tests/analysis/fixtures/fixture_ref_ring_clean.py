@@ -1,0 +1,40 @@
+"""Clean-construct precision fixture for the REF pass: the
+param-slot ring idiom the streamed quant-matmul kernel uses — the
+ring depth arrives as a functools.partial keyword, the slot cycles
+modulo that parameter, and the scratch ring is sized by the same
+site-level value. Every REF rule must stay quiet: the modulus and
+the leading dim resolve to the same 4 through the call graph, the
+dots declare their accumulation dtype, and stores match dtypes."""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _ring_kernel(x_ref, w_ref, o_ref, buf, acc_ref, *, n_slots):
+    i = pl.program_id(0)
+    slot = jax.lax.rem(i, n_slots)
+    buf[slot] = x_ref[...]
+    acc_ref[...] += jnp.dot(buf[slot], w_ref[...],
+                            preferred_element_type=jnp.float32)
+    o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+
+def launch(x, w):
+    n_slots = 4
+    return pl.pallas_call(
+        functools.partial(_ring_kernel, n_slots=n_slots),
+        grid=(8,),
+        in_specs=[
+            pl.BlockSpec((8, 128), lambda i: (i, 0)),
+            pl.BlockSpec((128, 128), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((64, 128), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((n_slots, 8, 128), jnp.float32),
+            pltpu.VMEM((8, 128), jnp.float32),
+        ],
+    )(x, w)

@@ -1,0 +1,20 @@
+"""Seeds SHARD001: a PartitionSpec naming an axis ("model") that the
+declared mesh does not provide — GSPMD rejects it at dispatch with an
+error naming neither the spec nor the layer. The P("tp") spec next to
+it uses a declared axis and must stay quiet."""
+import jax
+import numpy as np
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+
+def build_mesh():
+    devices = np.asarray(jax.devices()).reshape(2, 2)
+    return Mesh(devices, ("dp", "tp"))
+
+
+def weight_specs():
+    return {
+        "w_in": P("model", None),      # <- undeclared axis
+        "w_out": P(None, "tp"),        # declared: quiet
+    }

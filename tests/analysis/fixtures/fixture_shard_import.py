@@ -1,0 +1,8 @@
+"""Seeds SHARD003: the deprecated `jax.experimental.shard_map` import
+path (removed upstream; the supported spelling is `jax.shard_map`,
+bridged for jax<0.6 by aphrodite_tpu.common.compat.get_shard_map)."""
+from jax.experimental.shard_map import shard_map
+
+
+def wrap(fn, mesh, spec):
+    return shard_map(fn, mesh=mesh, in_specs=(spec,), out_specs=spec)

@@ -1,0 +1,22 @@
+"""Seeds SHARD002: a 3-axis PartitionSpec placed on a rank-2 operand
+— device_put raises at runtime, typically on the first multi-GB
+cache placement. The rank-3 placement next to it matches and must
+stay quiet. All axes are declared, so SHARD001 stays quiet too."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+
+def build_mesh():
+    devices = np.asarray(jax.devices()).reshape(2, 2)
+    return Mesh(devices, ("dp", "tp"))
+
+
+def place(mesh):
+    z = jnp.zeros((4, 8))
+    bad = jax.device_put(z, NamedSharding(mesh, P("dp", None, "tp")))
+    z3 = jnp.zeros((4, 8, 128))
+    ok = jax.device_put(z3, NamedSharding(mesh, P("dp", None, "tp")))
+    return bad, ok

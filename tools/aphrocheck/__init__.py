@@ -7,12 +7,24 @@ analysis. Run as `python -m tools.aphrocheck` (tier-1 runs it via
 
 Rule families (see each pass module's docstring for the contract):
 
-  FLAG001-006  env-flag registry (aphrodite_tpu/common/flags.py)
-  VMEM001      pallas_call VMEM footprint vs the per-core budget
-  DMA001-003   async-copy start/wait pairing, ring-slot arithmetic,
-               semaphore-array coverage
-  GRID001-002  grid arity vs index-map / scalar-prefetch arity
-  SYNC001-003  execute_model hot-path host-sync / retrace hazards
+  FLAG001-006    env-flag registry (aphrodite_tpu/common/flags.py)
+  VMEM001        pallas_call VMEM footprint vs the per-core budget
+  DMA001-003     async-copy start/wait pairing, ring-slot arithmetic,
+                 semaphore-array coverage
+  GRID001-002    grid arity vs index-map / scalar-prefetch arity
+  SYNC001-003    execute_model hot-path host-sync / retrace hazards
+  REF001-004     in-kernel ref bounds, ring-slot/scratch consistency,
+                 dot accumulation dtype, lossy ref writes
+  SHARD001-003   PartitionSpec axes vs the declared mesh, spec rank
+                 vs operand rank, deprecated shard_map imports
+  RECOMP001-003  jit recompile hazards: traced-value branching,
+                 unbucketed shapes into jitted callees, trace-time
+                 formatting
+
+Name resolution is interprocedural: a same-package call graph
+(core.CallGraph) lets helper parameters resolve through their call
+sites and functools.partial bindings, so helper-wrapped pallas_call
+launchers analyze the same as inline ones.
 
 Intentional exceptions live in `tools/aphrocheck/allowlist.json`;
 entries pin (rule, path, line-content) and go STALE — reported, and
@@ -25,13 +37,15 @@ import os
 from typing import List, Optional, Sequence, Tuple
 
 from tools.aphrocheck.core import (FLAGS_MODULE, REPO_ROOT, Allowlist,
-                                   Finding, Module, collect_files,
-                                   load_modules, parse_file)
+                                   CallGraph, Finding, Module,
+                                   collect_files, load_modules,
+                                   parse_file)
 
 DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(
     os.path.abspath(__file__)), "allowlist.json")
 
-_RULE_ORDER = ("PARSE", "FLAG", "VMEM", "DMA", "GRID", "SYNC")
+_RULE_ORDER = ("PARSE", "FLAG", "VMEM", "DMA", "GRID", "SYNC", "REF",
+               "SHARD", "RECOMP")
 
 
 @dataclasses.dataclass
@@ -39,6 +53,15 @@ class Context:
     modules: List[Module]
     flags_module: Optional[Module]
     vmem_budget: int = 16 * 1024 * 1024
+    call_graph: Optional[CallGraph] = None
+    #: False for subset scans (--changed, explicit paths): rules that
+    #: sweep the whole flag registry (FLAG004) need the full
+    #: read-site picture and are skipped.
+    full_scan: bool = True
+
+    def __post_init__(self) -> None:
+        if self.call_graph is None:
+            self.call_graph = CallGraph(self.modules)
 
 
 @dataclasses.dataclass
@@ -55,7 +78,8 @@ class Report:
 def build_context(root: str = REPO_ROOT,
                   rels: Optional[Sequence[str]] = None,
                   flags_rel: str = FLAGS_MODULE,
-                  vmem_budget: int = 16 * 1024 * 1024
+                  vmem_budget: int = 16 * 1024 * 1024,
+                  full_scan: bool = True
                   ) -> Tuple[Context, List[Finding]]:
     if rels is None:
         rels = collect_files(root)
@@ -70,8 +94,8 @@ def build_context(root: str = REPO_ROOT,
             flags_module, err = parse_file(flags_path, flags_rel)
             if err is not None:
                 parse_findings.append(err)
-    return Context(list(modules), flags_module, vmem_budget), \
-        parse_findings
+    return Context(list(modules), flags_module, vmem_budget,
+                   full_scan=full_scan), parse_findings
 
 
 def run(root: str = REPO_ROOT,
@@ -80,10 +104,13 @@ def run(root: str = REPO_ROOT,
         vmem_budget: int = 16 * 1024 * 1024,
         rule_prefixes: Optional[Sequence[str]] = None) -> Report:
     """Run every pass; returns surviving findings, suppressed ones,
-    and stale allowlist entries."""
+    and stale allowlist entries. Subset scans (explicit `rels`) skip
+    the registry-sweep rules (FLAG004), whose contract needs the full
+    read-site picture."""
     from tools.aphrocheck.passes import ALL_PASSES
 
-    ctx, findings = build_context(root, rels, vmem_budget=vmem_budget)
+    ctx, findings = build_context(root, rels, vmem_budget=vmem_budget,
+                                  full_scan=rels is None)
     for family, pass_fn in ALL_PASSES:
         if rule_prefixes and family not in rule_prefixes:
             continue

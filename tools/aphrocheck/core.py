@@ -21,7 +21,15 @@ Key pieces:
   of paged_attention).
 - Interval: [lo, hi] integer bounds with a small abstract evaluator
   (literals, names via branch-aware constant propagation, arithmetic,
-  min/max, literal-tuple generators) used by the VMEM pass.
+  min/max, literal-tuple generators) used by the VMEM/DMA/REF passes.
+- CallGraph: lightweight same-package call graph — every module-level
+  def plus every direct call and `functools.partial` binding of it —
+  so a helper parameter (`n_slots`, `page_size`, a kernel's ring
+  depth) resolves to the expressions its callers pass. The evaluator
+  consults it when a name is a parameter of the scope under analysis,
+  which is what lets the passes see through the helper-wrapped
+  pallas_call idiom (one `_stream_call`-style launcher shared by
+  several wrappers) instead of stopping at the function boundary.
 """
 from __future__ import annotations
 
@@ -41,6 +49,11 @@ SCAN_ROOTS = ("aphrodite_tpu", "bench.py", "benchmarks")
 #: The registry module — exempt from FLAG001/002/003 (it IS the one
 #: place raw os.environ reads are allowed).
 FLAGS_MODULE = os.path.join("aphrodite_tpu", "common", "flags.py")
+
+#: The version-bridge module — exempt from SHARD003 (it IS the one
+#: place deprecated/moved JAX import paths are allowed, behind a
+#: current-API-first getattr probe).
+COMPAT_MODULE = os.path.join("aphrodite_tpu", "common", "compat.py")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -68,9 +81,54 @@ class Module:
         self.lines = text.splitlines()
         self.tree = tree
         self.parents: Dict[ast.AST, ast.AST] = {}
+        self.nodes: List[ast.AST] = [tree]
         for node in ast.walk(tree):
             for child in ast.iter_child_nodes(node):
                 self.parents[child] = node
+                self.nodes.append(child)
+        #: every ast.Call in the module — the whole-tree walk most
+        #: passes need, done once
+        self.calls: List[ast.Call] = [n for n in self.nodes
+                                      if isinstance(n, ast.Call)]
+        # per-scope memoized walks (the evaluator consults these on
+        # every name lookup; rebuilding them per lookup dominated the
+        # 2 s runtime budget)
+        self._assign_idx: Dict[int, Dict[str, List[ast.AST]]] = {}
+        self._mutated_idx: Dict[int, set] = {}
+        self._def_idx: Dict[int, Dict[str, List[ast.AST]]] = {}
+
+    def def_index(self, scope: Optional[ast.AST]
+                  ) -> Dict[str, List[ast.AST]]:
+        """name -> FunctionDefs within `scope` (module when None)."""
+        key = id(scope) if scope is not None else 0
+        idx = self._def_idx.get(key)
+        if idx is None:
+            idx = {}
+            root = scope if scope is not None else self.tree
+            for node in ast.walk(root):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    idx.setdefault(node.name, []).append(node)
+            self._def_idx[key] = idx
+        return idx
+
+    def assign_index(self, scope: Optional[ast.AST]
+                     ) -> Dict[str, List[ast.AST]]:
+        """name -> value nodes of plain Assigns within `scope`
+        (module tree when None), built once per scope."""
+        key = id(scope) if scope is not None else 0
+        idx = self._assign_idx.get(key)
+        if idx is None:
+            idx = {}
+            root = scope if scope is not None else self.tree
+            for node in ast.walk(root):
+                if isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            idx.setdefault(tgt.id, []).append(
+                                node.value)
+            self._assign_idx[key] = idx
+        return idx
 
     def line_text(self, line: int) -> str:
         if 1 <= line <= len(self.lines):
@@ -280,10 +338,14 @@ def iter_calls(root: ast.AST) -> Iterable[ast.Call]:
             yield node
 
 
-def assignments_of(scope: ast.AST, name: str) -> List[ast.AST]:
+def assignments_of(scope: ast.AST, name: str,
+                   module: Optional[Module] = None) -> List[ast.AST]:
     """Value nodes assigned to `name` anywhere in `scope` (plain
     Assign targets only; tuple-unpack yields the whole call value,
-    marked by wrapping position)."""
+    marked by wrapping position). With a `module`, the per-scope
+    index is memoized."""
+    if module is not None:
+        return list(module.assign_index(scope).get(name, ()))
     out: List[ast.AST] = []
     for node in ast.walk(scope):
         if isinstance(node, ast.Assign):
@@ -291,6 +353,76 @@ def assignments_of(scope: ast.AST, name: str) -> List[ast.AST]:
                 if isinstance(tgt, ast.Name) and tgt.id == name:
                     out.append(node.value)
     return out
+
+
+# -- same-package call graph ------------------------------------------
+
+@dataclasses.dataclass
+class ParamBinding:
+    """One caller-site expression bound to a callee parameter."""
+    module: Module
+    scope: Optional[ast.AST]     # caller's enclosing function
+    node: ast.AST                # the argument expression
+
+
+class CallGraph:
+    """Defs and call-site argument bindings across the scanned modules.
+
+    Resolution is BY NAME (tail name of the callee), which is exact
+    for this package's flat module-level helpers and over-approximate
+    for same-named methods — over-approximation joins intervals, so
+    bounds stay sound in the join-to-UNKNOWN direction. Both direct
+    calls and `functools.partial(fn, ...)` keyword/positional
+    bindings are recorded; `self`/`cls` receivers are skipped when a
+    method is invoked through an attribute."""
+
+    def __init__(self, modules: Sequence[Module]) -> None:
+        self.defs: Dict[str, List[Tuple[Module, ast.AST]]] = {}
+        self._bindings: Dict[str, Dict[str, List[ParamBinding]]] = {}
+        for module in modules:
+            for node in ast.walk(module.tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    self.defs.setdefault(node.name, []).append(
+                        (module, node))
+        for module in modules:
+            for call in iter_calls(module.tree):
+                name = tail_name(call.func)
+                if name == "partial" and call.args:
+                    target = tail_name(call.args[0])
+                    if target in self.defs:
+                        self._record(target, module, call,
+                                     arg_offset=1)
+                elif name in self.defs:
+                    self._record(name, module, call, arg_offset=0)
+
+    def _record(self, target: str, module: Module, call: ast.Call,
+                arg_offset: int) -> None:
+        _, fn = self.defs[target][0]
+        params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+        if params and params[0] in ("self", "cls") and \
+                isinstance(call.func, ast.Attribute):
+            params = params[1:]
+        scope = module.top_level_function(call)
+        per = self._bindings.setdefault(target, {})
+        for i, arg in enumerate(call.args[arg_offset:]):
+            if isinstance(arg, ast.Starred):
+                break
+            if i < len(params):
+                per.setdefault(params[i], []).append(
+                    ParamBinding(module, scope, arg))
+        for kw in call.keywords:
+            if kw.arg is not None:
+                per.setdefault(kw.arg, []).append(
+                    ParamBinding(module, scope, kw.value))
+
+    def param_values(self, fn_name: str, param: str
+                     ) -> List[ParamBinding]:
+        return self._bindings.get(fn_name, {}).get(param, [])
+
+    def functions_named(self, name: str
+                        ) -> List[Tuple[Module, ast.AST]]:
+        return self.defs.get(name, [])
 
 
 # -- integer interval evaluation (VMEM pass) --------------------------
@@ -326,17 +458,33 @@ class IntervalEvaluator:
     Flag reads (`flags.get_int(...)`) resolve to their registry/call-
     site default — the analysis states its assumption as "flags at
     defaults" rather than treating every knob as unbounded.
+
+    With a `call_graph`, a name that is a PARAMETER of the scope
+    function joins the intervals of every caller-site binding
+    (including functools.partial keywords), each evaluated in its own
+    caller's scope — depth-capped, and UNKNOWN when no binding is
+    found (dynamic dispatch must not produce narrow bounds).
     """
 
+    _MAX_CALLER_DEPTH = 3
+
     def __init__(self, module: Module, scope: Optional[ast.AST],
-                 flag_defaults: Optional[Dict[str, int]] = None) -> None:
+                 flag_defaults: Optional[Dict[str, int]] = None,
+                 call_graph: Optional[CallGraph] = None,
+                 _depth: int = 0) -> None:
         self.module = module
         self.scope = scope
         self.flag_defaults = flag_defaults or {}
+        self.call_graph = call_graph
+        self._depth = _depth
         self._mutated = self._collect_mutated()
         self._stack: List[str] = []    # recursion guard
 
     def _collect_mutated(self) -> set:
+        key = id(self.scope) if self.scope is not None else 0
+        cached = self.module._mutated_idx.get(key)
+        if cached is not None:
+            return cached
         bad = set()
         for root in filter(None, [self.scope, self.module.tree]):
             for node in ast.walk(root):
@@ -351,6 +499,7 @@ class IntervalEvaluator:
                             for t in tgts:
                                 if isinstance(t, ast.Name):
                                     bad.add(t.id)
+        self.module._mutated_idx[key] = bad
         return bad
 
     def eval(self, node: ast.AST,
@@ -386,7 +535,8 @@ class IntervalEvaluator:
             return Interval(v, v)
         sources: List[ast.AST] = []
         if self.scope is not None:
-            sources.extend(assignments_of(self.scope, name))
+            sources.extend(assignments_of(self.scope, name,
+                                          self.module))
         if not sources:
             # module-level constant (e.g. _WB_SLOTS = 8)
             for stmt in self.module.tree.body:
@@ -395,7 +545,7 @@ class IntervalEvaluator:
                         if isinstance(tgt, ast.Name) and tgt.id == name:
                             sources.append(stmt.value)
         if not sources:
-            return UNKNOWN
+            return self._eval_param(name)
         at_path = self.module.branch_path(at)
         result: Optional[Interval] = None
         self._stack.append(name)
@@ -409,6 +559,43 @@ class IntervalEvaluator:
         finally:
             self._stack.pop()
         return result if result is not None else UNKNOWN
+
+    def _eval_param(self, name: str) -> Interval:
+        """Caller-site bounds for a parameter of the scope function."""
+        if self.call_graph is None or \
+                self._depth >= self._MAX_CALLER_DEPTH or \
+                not isinstance(self.scope, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)):
+            return UNKNOWN
+        params = {a.arg for a in (self.scope.args.posonlyargs +
+                                  self.scope.args.args +
+                                  self.scope.args.kwonlyargs)}
+        if name not in params:
+            return UNKNOWN
+        bindings = self.call_graph.param_values(self.scope.name, name)
+        if not bindings:
+            # fall back to the parameter's default value, if literal
+            return self._param_default(name)
+        result: Optional[Interval] = None
+        for b in bindings:
+            ev = IntervalEvaluator(b.module, b.scope,
+                                   self.flag_defaults, self.call_graph,
+                                   _depth=self._depth + 1)
+            iv = ev.eval(b.node)
+            result = iv if result is None else _join(result, iv)
+        return result if result is not None else UNKNOWN
+
+    def _param_default(self, name: str) -> Interval:
+        a = self.scope.args
+        pos = a.posonlyargs + a.args
+        n_def = len(a.defaults)
+        for i, arg in enumerate(pos):
+            if arg.arg == name and i >= len(pos) - n_def:
+                return self.eval(a.defaults[i - (len(pos) - n_def)])
+        for arg, d in zip(a.kwonlyargs, a.kw_defaults):
+            if arg.arg == name and d is not None:
+                return self.eval(d)
+        return UNKNOWN
 
     def _eval_binop(self, node: ast.BinOp, at: ast.AST) -> Interval:
         a = self.eval(node.left, at)
@@ -470,6 +657,16 @@ class IntervalEvaluator:
             return UNKNOWN
         if fn == "len":
             return Interval(0, INF)
+        if fn == "rem" and len(node.args) == 2:
+            # jax.lax.rem(x, m): same bounds as the Mod binop.
+            m = self.eval(node.args[1], at)
+            if m.hi != INF and m.hi > 0:
+                return Interval(0, m.hi - 1)
+            return UNKNOWN
+        if fn == "program_id":
+            return Interval(0, INF)
+        if fn == "num_programs":
+            return Interval(1, INF)
         return UNKNOWN
 
     def _spread_args(self, node: ast.Call) -> List[ast.AST]:
@@ -510,3 +707,31 @@ def dtype_bytes(node: ast.AST) -> Interval:
         w = DTYPE_BYTES[name]
         return Interval(w, w)
     return Interval(1, 8)
+
+
+#: src -> dsts the src dtype embeds into without loss (REF004). The
+#: pseudo-dtypes 'int'/'float' stand for Python literals, which JAX
+#: weak-types into whatever the ref holds.
+_LOSSLESS_WIDENING = {
+    "int8": {"int16", "int32", "int64", "float32", "float64",
+             "bfloat16", "float16"},
+    "uint8": {"int16", "int32", "int64", "float32", "float64"},
+    "int16": {"int32", "int64", "float32", "float64"},
+    "int32": {"int64", "float64"},
+    "bfloat16": {"float32", "float64"},
+    "float16": {"float32", "float64"},
+    "float32": {"float64"},
+    "bool_": {"int8", "int16", "int32", "int64", "float32",
+              "bfloat16", "float16"},
+}
+
+
+def dtype_lossless(src: str, dst: str) -> bool:
+    """Whether every value of dtype `src` lands exactly in `dst`."""
+    if src == dst:
+        return True
+    if src == "int":
+        return dst in DTYPE_BYTES    # literal ints weak-type freely
+    if src == "float":
+        return dst in ("float16", "bfloat16", "float32", "float64")
+    return dst in _LOSSLESS_WIDENING.get(src, ())

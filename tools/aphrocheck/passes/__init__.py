@@ -1,15 +1,21 @@
 """The aphrocheck analysis passes.
 
 Each pass module exposes `run(ctx) -> List[Finding]` where ctx is a
-`tools.aphrocheck.Context`. Rule ID families:
+`tools.aphrocheck.Context`, plus a `RULES` table of
+(rule_id, contract, example) rows the `--rules-md` emitter renders.
+Rule ID families:
 
-- FLAG001..FLAG006 — env-flag registry contract
-- VMEM001          — pallas_call VMEM footprint vs the per-core budget
-- DMA001..DMA003   — async-copy start/wait + ring-slot invariants
-- GRID001..GRID002 — grid arity vs index-map/scalar-prefetch arity
-- SYNC001..SYNC003 — execute_model hot-path host-sync/retrace hazards
+- FLAG001..FLAG006     — env-flag registry contract
+- VMEM001              — pallas_call VMEM footprint vs the per-core budget
+- DMA001..DMA003       — async-copy start/wait + ring-slot invariants
+- GRID001..GRID002     — grid arity vs index-map/scalar-prefetch arity
+- SYNC001..SYNC003     — execute_model hot-path host-sync/retrace hazards
+- REF001..REF004       — in-kernel ref bounds/dtype abstract interpretation
+- SHARD001..SHARD003   — PartitionSpec/mesh consistency, deprecated imports
+- RECOMP001..RECOMP003 — jit recompile/trace-time hazards
 """
 from tools.aphrocheck.passes import (dma_pass, flag_pass, grid_pass,
+                                     recomp_pass, ref_pass, shard_pass,
                                      sync_pass, vmem_pass)
 
 ALL_PASSES = (
@@ -18,4 +24,7 @@ ALL_PASSES = (
     ("DMA", dma_pass.run),
     ("GRID", grid_pass.run),
     ("SYNC", sync_pass.run),
+    ("REF", ref_pass.run),
+    ("SHARD", shard_pass.run),
+    ("RECOMP", recomp_pass.run),
 )

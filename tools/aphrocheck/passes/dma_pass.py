@@ -270,7 +270,8 @@ def _check_moduli(module: Module, kernel: _Kernel,
                 return
 
 
-def _check_sem_lengths(module: Module, findings: List[Finding]) -> None:
+def _check_sem_lengths(module: Module, findings: List[Finding],
+                       call_graph=None) -> None:
     kernels = {k.fn.name if hasattr(k.fn, 'name') else '': k
                for k in (_Kernel(module, fn)
                          for fn in _top_level_kernel_fns(module))}
@@ -279,7 +280,8 @@ def _check_sem_lengths(module: Module, findings: List[Finding]) -> None:
         for variant in site.variants:
             base, appended, _ = list_elements(module, site.scope,
                                               variant.scratch_shapes)
-            ev = IntervalEvaluator(module, site.scope)
+            ev = IntervalEvaluator(module, site.scope,
+                                   call_graph=call_graph)
             for entry in base + appended:
                 if isinstance(entry, ast.Call) and \
                         (dotted_name(entry.func) or "").endswith(
@@ -298,7 +300,7 @@ def _check_sem_lengths(module: Module, findings: List[Finding]) -> None:
             kernel = kernels.get(fn.name)
             if kernel is None:
                 kernel = _Kernel(module, fn)
-            kev = IntervalEvaluator(module, fn)
+            kev = IntervalEvaluator(module, fn, call_graph=call_graph)
             for mods in kernel.sem_moduli().values():
                 for _, _, mod_node in mods:
                     exact = kev.eval(mod_node, mod_node).exact
@@ -320,5 +322,20 @@ def run(ctx) -> List[Finding]:
             kernel = _Kernel(module, fn)
             _check_start_wait(module, kernel, findings)
             _check_moduli(module, kernel, findings)
-        _check_sem_lengths(module, findings)
+        _check_sem_lengths(module, findings,
+                           getattr(ctx, "call_graph", None))
     return findings
+
+
+#: (rule, one-line contract, example) — rendered by `--rules-md`.
+RULES = (
+    ("DMA001", "async copy started but never waited in the kernel "
+     "(or waited but never started)",
+     "`make_async_copy(...).start()` with no reachable `.wait()`"),
+    ("DMA002", "one semaphore array indexed with two different ring "
+     "moduli on the same path",
+     "start at `sem.at[i % 4]`, wait at `sem.at[i % 2]`"),
+    ("DMA003", "ring modulus exceeds the `SemaphoreType.DMA` leading "
+     "dimension at the pallas_call site",
+     "`rem(i, 4)` slots against `SemaphoreType.DMA((2,))`"),
+)

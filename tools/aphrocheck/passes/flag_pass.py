@@ -17,7 +17,8 @@ Rules:
   mid-batch with no flag name in the message.
 - FLAG004: a registered flag that no scanned module ever reads
   (reported at the registration line — dead registry entries rot the
-  docs table).
+  docs table). Skipped on subset scans (--changed, explicit paths):
+  "never read" is only meaningful against the full read-site picture.
 - FLAG005: a registry-accessor read of a name that is NOT registered
   (typo'd reads would otherwise silently hit the accessor's
   unregistered-name error only at runtime).
@@ -39,7 +40,7 @@ def _raw_env_reads(module: Module):
     """(name, node) for every raw os.environ/os.getenv READ of an
     APHRODITE_* literal."""
     out = []
-    for node in ast.walk(module.tree):
+    for node in module.nodes:
         if isinstance(node, ast.Call):
             callee = dotted_name(node.func) or ""
             is_environ_get = callee.endswith("environ.get")
@@ -132,8 +133,9 @@ def run(ctx) -> List[Finding]:
                     f"{name}; a typo'd value raises a bare ValueError "
                     "with no flag name — use flags.get_int/get_float"))
 
+    full_scan = getattr(ctx, "full_scan", True)
     for name, reg in sorted(registry.items()):
-        if name not in read_names:
+        if full_scan and name not in read_names:
             findings.append(Finding(
                 "FLAG004", ctx.flags_module.rel, reg.line,
                 f"{name} is registered but never read by any scanned "
@@ -144,3 +146,25 @@ def run(ctx) -> List[Finding]:
                 f"{name} is registered without a description; the "
                 "README flags table is generated from these"))
     return findings
+
+
+#: (rule, one-line contract, example) — rendered by `--rules-md`.
+RULES = (
+    ("FLAG001", "raw `os.environ`/`os.getenv` read of an "
+     "`APHRODITE_*` name outside the flag registry",
+     '`os.environ.get("APHRODITE_X")`'),
+    ("FLAG002", "env-flag read that executes at import time "
+     "(module/class body) instead of per call",
+     '`_PF = flags.get_int("APHRODITE_ATTN_PF")` at module scope'),
+    ("FLAG003", "unvalidated `int()`/`float()` coercion wrapped "
+     "around a raw env read",
+     '`int(os.environ.get("APHRODITE_X", "4"))`'),
+    ("FLAG004", "registered flag no scanned module reads "
+     "(full scans only)",
+     "a `_register(Flag(...))` with zero `flags.get_*` sites"),
+    ("FLAG005", "registry-accessor read of an unregistered flag name",
+     '`flags.get_bool("APHRODITE_TYPO")`'),
+    ("FLAG006", "registered flag with an empty description "
+     "(the README table is generated from these)",
+     '`Flag("APHRODITE_X", "bool", False, "")`'),
+)

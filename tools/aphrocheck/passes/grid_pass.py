@@ -129,3 +129,14 @@ def _check_variant(module: Module, site, variant, findings) -> None:
             f"pallas_call invoked with {actual} positional "
             f"operand(s) but num_scalar_prefetch={nsp} plus "
             f"{len(base)} in_spec(s) require {expected_args}"))
+
+
+#: (rule, one-line contract, example) — rendered by `--rules-md`.
+RULES = (
+    ("GRID001", "BlockSpec index-map arity can never equal "
+     "`len(grid) + num_scalar_prefetch`",
+     "a 2-arg lambda under a 3-d grid"),
+    ("GRID002", "positional operand count at the pallas_call "
+     "invocation differs from `num_scalar_prefetch + len(in_specs)`",
+     "4 operands for 2 in_specs + 1 prefetch"),
+)

@@ -1,0 +1,194 @@
+"""RECOMP pass: silent-recompile and trace-time hazards under jit.
+
+Shape stability IS the perf contract on this platform (each remote
+compile costs ~20 s): the bucketed runner exists so a fluctuating
+serving mix replays cached programs. These rules catch the patterns
+that silently break it:
+
+- RECOMP001: a Python `if`/`while` (or ternary) branching on an
+  expression that CONTAINS a jnp/jax.lax call, inside a directly
+  jitted function — under jit such values are tracers, and branching
+  on one raises TracerBoolConversionError at trace time (or, with
+  `int()` coercions, silently concretizes per call).
+- RECOMP002: an argument of the form `jnp.asarray(x)` /
+  `jnp.array(x)` at a call site of a KNOWN jitted callable, where `x`
+  is a local list grown with `.append`/`.extend` in the same
+  function. The array's length then varies per call and every
+  distinct length is a full recompile (the class behind the bucketed
+  decode runner; the fix is padding to a bucket before the asarray).
+  Jitted callables are collected module-wide from `jax.jit(...)`
+  assignments (including `self._fn = jax.jit(...)`) and jit-decorated
+  defs across ALL scanned modules.
+- RECOMP003: an f-string interpolation or an assert on a
+  jnp/jax-derived test inside a directly jitted function — both
+  execute at TRACE time only: the f-string formats a tracer repr (or
+  never re-runs), the assert checks a tracer's truthiness.
+
+"Directly jitted" = a def with a jit decorator, or a def referenced
+by name inside a `jax.jit(...)` call in the same module. Functions
+merely CALLED from jit (layer code) are out of scope — their authors
+see the jit boundary locally.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from tools.aphrocheck.core import (Finding, Module, dotted_name,
+                                   iter_calls, tail_name)
+
+_TRACED_PREFIXES = ("jnp.", "jax.lax.", "jax.numpy.", "lax.")
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    return tail_name(call.func) == "jit"
+
+
+def _jitted_functions(module: Module) -> List[ast.FunctionDef]:
+    """Defs that are themselves jit roots in this module."""
+    out: List[ast.FunctionDef] = []
+    by_name: Dict[str, ast.FunctionDef] = {}
+    for node in module.nodes:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, node)
+            for dec in node.decorator_list:
+                is_jit = tail_name(dec) == "jit"          # @jax.jit
+                if isinstance(dec, ast.Call):
+                    if _is_jit_call(dec):                 # @jax.jit(...)
+                        is_jit = True
+                    elif tail_name(dec.func) == "partial" and \
+                            dec.args and \
+                            tail_name(dec.args[0]) == "jit":
+                        is_jit = True    # @functools.partial(jax.jit, ...)
+                if is_jit:
+                    out.append(node)
+                    break
+    for call in module.calls:
+        if _is_jit_call(call) and call.args:
+            target = tail_name(call.args[0])
+            fn = by_name.get(target) if target else None
+            if fn is not None and fn not in out:
+                out.append(fn)
+    return out
+
+
+def _has_traced_call(expr: ast.AST) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func) or ""
+            if name.startswith(_TRACED_PREFIXES):
+                return True
+    return False
+
+
+def _growing_lists(fn: ast.AST) -> Set[str]:
+    """Local names grown via .append/.extend in this function."""
+    out: Set[str] = set()
+    for call in iter_calls(fn):
+        f = call.func
+        if isinstance(f, ast.Attribute) and \
+                f.attr in ("append", "extend") and \
+                isinstance(f.value, ast.Name):
+            out.add(f.value.id)
+    return out
+
+
+def _check_jit_body(module: Module, fn: ast.FunctionDef,
+                    findings: List[Finding]) -> None:
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.If, ast.While)) and \
+                _has_traced_call(node.test):
+            rule_node = node.test
+            findings.append(module.finding(
+                "RECOMP001", rule_node,
+                f"Python {'while' if isinstance(node, ast.While) else 'if'} "
+                f"on a traced value in jitted {fn.name}: branching on "
+                "a tracer raises at trace time — use jnp.where / "
+                "lax.cond"))
+        elif isinstance(node, ast.IfExp) and \
+                _has_traced_call(node.test):
+            findings.append(module.finding(
+                "RECOMP001", node,
+                f"ternary on a traced value in jitted {fn.name}: use "
+                "jnp.where / lax.cond"))
+        elif isinstance(node, ast.JoinedStr):
+            if any(isinstance(v, ast.FormattedValue)
+                   for v in node.values):
+                findings.append(module.finding(
+                    "RECOMP003", node,
+                    f"f-string interpolation in jitted {fn.name} "
+                    "formats at TRACE time (a tracer repr, once) — "
+                    "use jax.debug.print or move the message outside "
+                    "jit"))
+        elif isinstance(node, ast.Assert) and \
+                _has_traced_call(node.test):
+            findings.append(module.finding(
+                "RECOMP003", node,
+                f"assert on a traced value in jitted {fn.name} "
+                "executes at trace time only; use "
+                "checkify or a host-side precondition"))
+
+
+def _check_callee_args(module: Module, jitted_names: Set[str],
+                       findings: List[Finding]) -> None:
+    for call in module.calls:
+        callee = tail_name(call.func)
+        if callee not in jitted_names:
+            continue
+        scope = module.enclosing_function(call)
+        if scope is None:
+            continue
+        growing = _growing_lists(scope)
+        if not growing:
+            continue
+        for arg in list(call.args) + [kw.value for kw in
+                                      call.keywords]:
+            if not (isinstance(arg, ast.Call) and
+                    tail_name(arg.func) in ("asarray", "array") and
+                    arg.args):
+                continue
+            inner = arg.args[0]
+            if isinstance(inner, ast.Name) and inner.id in growing:
+                findings.append(module.finding(
+                    "RECOMP002", arg,
+                    f"jnp.{tail_name(arg.func)}({inner.id}) feeds "
+                    f"jitted {callee} with a list grown per call: "
+                    "every distinct length is a silent full "
+                    "recompile — pad to a bucket first (the "
+                    "_DECODE_BATCH_BUCKETS pattern)"))
+
+
+def run(ctx) -> List[Finding]:
+    findings: List[Finding] = []
+    jit_fns = {id(m): _jitted_functions(m) for m in ctx.modules}
+    jitted_names: Set[str] = set()
+    for module in ctx.modules:
+        for node in module.nodes:
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    _is_jit_call(node.value):
+                for tgt in node.targets:
+                    key = dotted_name(tgt)
+                    if key:
+                        jitted_names.add(key.split(".")[-1])
+        for fn in jit_fns[id(module)]:
+            jitted_names.add(fn.name)
+    for module in ctx.modules:
+        for fn in jit_fns[id(module)]:
+            _check_jit_body(module, fn, findings)
+        _check_callee_args(module, jitted_names, findings)
+    return findings
+
+
+#: (rule, one-line contract, example) — rendered by `--rules-md`.
+RULES = (
+    ("RECOMP001", "Python `if`/`while` on a traced (jnp/jax.lax) "
+     "value inside a jitted function",
+     "`if jnp.any(x > 0):` under jit"),
+    ("RECOMP002", "unbucketed list -> `jnp.asarray` flowing into a "
+     "jitted callee: every distinct length recompiles",
+     "`self._copy_fn(kv, jnp.asarray(src))` with `src.append(...)`"),
+    ("RECOMP003", "f-string or traced assert inside a jitted "
+     "function: executes at trace time only",
+     '`f"step {x}"` under jit'),
+)

@@ -1,0 +1,424 @@
+"""REF pass: abstract interpretation of in-kernel `ref[...]` access.
+
+The three hand-rolled Pallas kernels' worst bug classes surface as
+opaque Mosaic compile errors (an out-of-bounds static slice names
+neither the ref nor the line) or as silent numeric corruption (a dot
+that accumulates in bf16, a ring slot that skews against its scratch
+array). This pass binds every kernel parameter to the BlockSpec block
+or scratch entry it receives (sites.bind_kernel_refs — positional,
+per the pallas_call contract) and interval-evaluates the subscripts:
+
+- REF001: a static subscript that PROVABLY exceeds the bound dim —
+  a plain index whose lower bound >= the dim, a slice whose literal
+  stop exceeds it, or a `pl.ds(start, size)` whose provable minimum
+  end runs past it. Dims and indices resolve branch-aware and
+  interprocedurally (helper params via the call graph); anything
+  unresolvable stays silent.
+- REF002: a ring-slot subscript (x % M / jax.lax.rem(x, M)) on a
+  scratch/semaphore ref whose leading dim is exactly known, with
+  M != that dim — start and wait sides of a DMA ring then disagree
+  about which slot they share (the PR-2/PR-4 ring invariant,
+  generalized from the semaphore-only DMA002 to every scratch ref).
+  One finding per (kernel, ref).
+- REF003: `jnp.dot` / `jax.lax.dot_general` in a kernel body without
+  `preferred_element_type` (accumulation silently inherits the
+  operand dtype: bf16 accumulation of a bf16 dot), or with int8/int4
+  operands and a preferred type other than int32 (overflow). Operand
+  int-ness is detected through `.astype(jnp.int8)` in the operand
+  expression or one assignment hop.
+- REF004: a ref store (`ref[...] = x`, `ref[...] += x`) whose RHS
+  dtype is statically known and does NOT losslessly embed in the
+  ref's scratch dtype (f32 into an int32 accumulator plane, int32
+  into bf16). `.astype(other_ref.dtype)` and unknown dtypes stay
+  silent.
+
+REF003 needs no shape binding and runs over every function a
+pallas_call kernel argument resolves to (including `*refs`-style
+kernels); REF001/002/004 run only where the positional binding is
+unambiguous.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.aphrocheck.core import (INF, Finding, IntervalEvaluator,
+                                   Module, dotted_name, dtype_lossless,
+                                   iter_calls, tail_name)
+from tools.aphrocheck.sites import (RefInfo, bind_kernel_refs,
+                                    find_sites, resolve_kernel_functions)
+
+#: Calls whose result dtype follows their first array argument.
+_DTYPE_PRESERVING = ("sum", "max", "min", "maximum", "minimum",
+                     "broadcast_to", "reshape", "transpose", "abs",
+                     "where", "zeros_like", "ones_like", "full_like",
+                     "concatenate")
+
+
+def _subscript_base(node: ast.Subscript) -> Optional[str]:
+    """Ref name of `ref[...]` or `ref.at[...]`."""
+    base = node.value
+    if isinstance(base, ast.Attribute) and base.attr == "at":
+        base = base.value
+    if isinstance(base, ast.Name):
+        return base.id
+    return None
+
+
+def _index_elements(node: ast.Subscript) -> List[ast.AST]:
+    idx = node.slice
+    if isinstance(idx, ast.Tuple):
+        return list(idx.elts)
+    return [idx]
+
+
+def _modulus_of(expr: ast.AST, fn: ast.AST,
+                depth: int = 0) -> Optional[ast.AST]:
+    """The modulus node of a ring-slot expression (x % M, rem(x, M)),
+    chasing one assignment hop per level inside the kernel."""
+    if depth > 3 or expr is None:
+        return None
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Mod):
+        return expr.right
+    if isinstance(expr, ast.Call) and tail_name(expr.func) == "rem" \
+            and len(expr.args) == 2:
+        return expr.args[1]
+    if isinstance(expr, ast.Name):
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Assign):
+                for tgt in n.targets:
+                    if isinstance(tgt, ast.Name) and \
+                            tgt.id == expr.id:
+                        mod = _modulus_of(n.value, fn, depth + 1)
+                        if mod is not None:
+                            return mod
+    return None
+
+
+def _looks_int8(expr: ast.AST, fn: ast.AST, refs: Dict[str, RefInfo],
+                depth: int = 0) -> bool:
+    """Whether a dot operand is int8/int4 data: an astype to an int8
+    family dtype in the expression (or one assignment hop away), or a
+    subscript of a ref whose scratch dtype is int8."""
+    if depth > 2 or expr is None:
+        return False
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "astype" and node.args:
+            if tail_name(node.args[0]) in ("int8", "int4", "uint8",
+                                           "uint4"):
+                return True
+        elif isinstance(node, ast.Subscript):
+            name = _subscript_base(node)
+            info = refs.get(name) if name else None
+            if info is not None and info.dtype in ("int8", "uint8"):
+                return True
+    if isinstance(expr, ast.Name):
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Assign):
+                for tgt in n.targets:
+                    if isinstance(tgt, ast.Name) and \
+                            tgt.id == expr.id and \
+                            _looks_int8(n.value, fn, refs, depth + 1):
+                        return True
+    return False
+
+
+def _expr_dtype(expr: ast.AST, fn: ast.AST, refs: Dict[str, RefInfo],
+                depth: int = 0) -> Optional[str]:
+    """Static dtype of a kernel expression; None = unknown (silent)."""
+    if depth > 4 or expr is None:
+        return None
+    if isinstance(expr, ast.Constant):
+        if isinstance(expr.value, bool):
+            return "bool_"
+        if isinstance(expr.value, int):
+            return "int"
+        if isinstance(expr.value, float):
+            return "float"
+        return None
+    if isinstance(expr, ast.Subscript):
+        name = _subscript_base(expr)
+        info = refs.get(name) if name else None
+        return info.dtype if info is not None else None
+    if isinstance(expr, ast.Name):
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Assign):
+                for tgt in n.targets:
+                    if isinstance(tgt, ast.Name) and \
+                            tgt.id == expr.id:
+                        return _expr_dtype(n.value, fn, refs,
+                                           depth + 1)
+        return None
+    if isinstance(expr, ast.BinOp):
+        a = _expr_dtype(expr.left, fn, refs, depth + 1)
+        b = _expr_dtype(expr.right, fn, refs, depth + 1)
+        if a == b:
+            return a
+        # a Python literal adopts the other side's dtype (weak typing)
+        if a in ("int", "float") and b not in ("int", "float"):
+            return b
+        if b in ("int", "float") and a not in ("int", "float"):
+            return a
+        return None
+    if isinstance(expr, ast.Call):
+        name = tail_name(expr.func)
+        if isinstance(expr.func, ast.Attribute) and \
+                expr.func.attr == "astype" and expr.args:
+            t = tail_name(expr.args[0])
+            if t is not None and t not in ("dtype",):
+                # .astype(other_ref.dtype) stays unknown; a concrete
+                # jnp dtype resolves.
+                from tools.aphrocheck.core import DTYPE_BYTES
+                return t if t in DTYPE_BYTES else None
+            # .astype(x.dtype): known-matching only when x IS the ref
+            # being written — handled by the caller; unknown here.
+            return None
+        if name in ("dot", "dot_general"):
+            pet = next((kw.value for kw in expr.keywords
+                        if kw.arg == "preferred_element_type"), None)
+            return tail_name(pet) if pet is not None else None
+        if name in _DTYPE_PRESERVING and (expr.args or expr.keywords):
+            if name == "where" and len(expr.args) >= 3:
+                a = _expr_dtype(expr.args[1], fn, refs, depth + 1)
+                b = _expr_dtype(expr.args[2], fn, refs, depth + 1)
+                return a if a == b else None
+            if expr.args:
+                return _expr_dtype(expr.args[0], fn, refs, depth + 1)
+        return None
+    return None
+
+
+def _astype_target_ref(expr: ast.AST) -> Optional[str]:
+    """'o' for expressions ending in `.astype(o.dtype)` (writes cast
+    to the destination ref's dtype are correct by construction)."""
+    if isinstance(expr, ast.Call) and \
+            isinstance(expr.func, ast.Attribute) and \
+            expr.func.attr == "astype" and expr.args:
+        arg = expr.args[0]
+        if isinstance(arg, ast.Attribute) and arg.attr == "dtype" and \
+                isinstance(arg.value, ast.Name):
+            return arg.value.id
+    return None
+
+
+class _KernelChecker:
+    def __init__(self, module: Module, fn: ast.FunctionDef,
+                 refs: Dict[str, RefInfo],
+                 site_ev: IntervalEvaluator,
+                 kernel_ev: IntervalEvaluator) -> None:
+        self.module = module
+        self.fn = fn
+        self.refs = refs
+        self.site_ev = site_ev
+        self.kernel_ev = kernel_ev
+        self._dims: Dict[str, List[Optional[int]]] = {}
+
+    def dims_of(self, info: RefInfo) -> List[Optional[int]]:
+        if info.name not in self._dims:
+            out: List[Optional[int]] = []
+            for dim in (info.dims or []):
+                out.append(self.site_ev.eval(dim).exact)
+            self._dims[info.name] = out
+        return self._dims[info.name]
+
+    # -- REF001 ------------------------------------------------------
+
+    def check_bounds(self, sub: ast.Subscript, info: RefInfo,
+                     findings: List[Finding]) -> None:
+        """A finding requires a FINITELY-bounded index evaluation: the
+        generic UNKNOWN interval carries lo=1 (the shape-dim
+        convention), which must never prove an unresolvable index out
+        of a dim-1 block."""
+        dims = self.dims_of(info)
+        for pos, elem in enumerate(_index_elements(sub)):
+            if isinstance(elem, ast.Constant) and \
+                    elem.value is Ellipsis:
+                return
+            if pos >= len(dims) or dims[pos] is None:
+                continue
+            dim = dims[pos]
+            if isinstance(elem, ast.Slice):
+                stop = elem.upper
+                if stop is not None:
+                    iv = self.kernel_ev.eval(stop)
+                    if iv.hi != INF and iv.lo > dim:
+                        findings.append(self.module.finding(
+                            "REF001", sub,
+                            f"slice stop is at least {int(iv.lo)} but "
+                            f"dim {pos} of ref '{info.name}' "
+                            f"({info.kind}) is {dim}"))
+                        return
+                continue
+            if isinstance(elem, ast.Call) and \
+                    tail_name(elem.func) == "ds" and \
+                    len(elem.args) == 2:
+                start = self.kernel_ev.eval(elem.args[0])
+                size = self.kernel_ev.eval(elem.args[1])
+                if start.hi != INF and size.hi != INF and \
+                        start.lo + size.lo > dim:
+                    findings.append(self.module.finding(
+                        "REF001", sub,
+                        f"pl.ds window ends at least at "
+                        f"{int(start.lo + size.lo)} but dim {pos} of "
+                        f"ref '{info.name}' ({info.kind}) is {dim}"))
+                    return
+                continue
+            iv = self.kernel_ev.eval(elem)
+            if iv.hi != INF and iv.lo >= dim:
+                findings.append(self.module.finding(
+                    "REF001", sub,
+                    f"index is at least {int(iv.lo)} but dim {pos} of "
+                    f"ref '{info.name}' ({info.kind}) is {dim}"))
+                return
+
+    # -- REF002 ------------------------------------------------------
+
+    def check_ring(self, sub: ast.Subscript, info: RefInfo,
+                   flagged: Set[str],
+                   findings: List[Finding]) -> None:
+        if info.kind not in ("scratch", "sem") or info.name in flagged:
+            return
+        dims = self.dims_of(info)
+        if not dims or dims[0] is None:
+            return
+        lead = dims[0]
+        elems = _index_elements(sub)
+        if not elems:
+            return
+        mod_node = _modulus_of(elems[0], self.fn)
+        if mod_node is None:
+            return
+        mod = self.kernel_ev.eval(mod_node).exact
+        if mod is not None and mod != lead:
+            flagged.add(info.name)
+            findings.append(self.module.finding(
+                "REF002", sub,
+                f"ring-slot modulus {mod} does not match the leading "
+                f"dim {lead} of {info.kind} ref '{info.name}' in "
+                f"{self.fn.name}; the n-th slot and the scratch array "
+                "disagree"))
+
+    # -- REF004 ------------------------------------------------------
+
+    def check_store(self, target: ast.Subscript, rhs: ast.AST,
+                    findings: List[Finding]) -> None:
+        name = _subscript_base(target)
+        info = self.refs.get(name) if name else None
+        if info is None or info.dtype is None or info.kind == "sem":
+            return
+        if _astype_target_ref(rhs) == name:
+            return
+        src = _expr_dtype(rhs, self.fn, self.refs)
+        if src is None:
+            return
+        if not dtype_lossless(src, info.dtype):
+            findings.append(self.module.finding(
+                "REF004", target,
+                f"storing a {src} value into {info.kind} ref "
+                f"'{info.name}' ({info.dtype}) loses precision; cast "
+                "explicitly or widen the scratch dtype"))
+
+
+def _check_dots(module: Module, fn: ast.FunctionDef,
+                refs: Dict[str, RefInfo],
+                findings: List[Finding]) -> None:
+    for call in iter_calls(fn):
+        name = tail_name(call.func)
+        if name not in ("dot", "dot_general"):
+            continue
+        dot = dotted_name(call.func) or name
+        if not (dot.startswith("jnp.") or dot.startswith("jax.") or
+                dot.startswith("lax.") or dot in ("dot",
+                                                  "dot_general")):
+            continue
+        pet = next((kw.value for kw in call.keywords
+                    if kw.arg == "preferred_element_type"), None)
+        operands = call.args[:2]
+        int8_ops = any(_looks_int8(op, fn, refs) for op in operands)
+        if pet is None:
+            findings.append(module.finding(
+                "REF003", call,
+                f"{dot} in kernel {fn.name} without "
+                "preferred_element_type: accumulation silently "
+                "inherits the operand dtype"
+                + (" (int8 operands overflow int8)" if int8_ops
+                   else " (bf16 accumulation of a bf16 dot)")))
+        elif int8_ops and tail_name(pet) != "int32":
+            findings.append(module.finding(
+                "REF003", call,
+                f"{dot} in kernel {fn.name} has int8/int4 operands "
+                f"but preferred_element_type="
+                f"{tail_name(pet) or '?'}; integer dots must "
+                "accumulate in int32"))
+
+
+def run(ctx) -> List[Finding]:
+    findings: List[Finding] = []
+    call_graph = getattr(ctx, "call_graph", None)
+    for module in ctx.modules:
+        dot_checked: Set[int] = set()
+        bound_checked: Set[Tuple[int, int]] = set()
+        for site in find_sites(module):
+            kernel_fns = resolve_kernel_functions(module, site.scope,
+                                                  site.kernel_arg)
+            for fn in kernel_fns:
+                if id(fn) not in dot_checked:
+                    dot_checked.add(id(fn))
+                    # REF003 needs no shape binding: every kernel body
+                    # (including *refs-style ones) is covered.
+                    _check_dots(module, fn, {}, findings)
+                for variant in site.variants:
+                    key = (id(fn), id(variant))
+                    if key in bound_checked:
+                        continue
+                    bound_checked.add(key)
+                    refs = bind_kernel_refs(module, site, variant, fn)
+                    if refs is None:
+                        continue
+                    site_ev = IntervalEvaluator(module, site.scope,
+                                                call_graph=call_graph)
+                    kernel_ev = IntervalEvaluator(
+                        module, fn, call_graph=call_graph)
+                    checker = _KernelChecker(module, fn, refs,
+                                             site_ev, kernel_ev)
+                    ring_flagged: Set[str] = set()
+                    for node in ast.walk(fn):
+                        if isinstance(node, ast.Subscript):
+                            # loads AND stores both pass through here
+                            name = _subscript_base(node)
+                            info = refs.get(name) if name else None
+                            if info is not None:
+                                checker.check_bounds(node, info,
+                                                     findings)
+                                checker.check_ring(node, info,
+                                                   ring_flagged,
+                                                   findings)
+                        elif isinstance(node, ast.Assign):
+                            for tgt in node.targets:
+                                if isinstance(tgt, ast.Subscript):
+                                    checker.check_store(tgt, node.value,
+                                                        findings)
+                        elif isinstance(node, ast.AugAssign) and \
+                                isinstance(node.target, ast.Subscript):
+                            checker.check_store(node.target, node.value,
+                                                findings)
+    return findings
+
+
+#: (rule, one-line contract, example) — rendered by `--rules-md`.
+RULES = (
+    ("REF001", "in-kernel ref subscript provably out of bounds "
+     "against the BlockSpec block / scratch shape it binds to",
+     "`buf[4]` on `pltpu.VMEM((2, ...))` scratch"),
+    ("REF002", "ring-slot subscript whose modulus differs from the "
+     "scratch leading dim",
+     "`buf[rem(i, 3)]` on a 4-slot ring"),
+    ("REF003", "kernel dot without `preferred_element_type` (or int8 "
+     "operands without int32 accumulation)",
+     "`jnp.dot(x, w)` accumulating in bf16"),
+    ("REF004", "ref store whose RHS dtype cannot losslessly land in "
+     "the ref dtype",
+     "storing an f32 value into int32 scratch"),
+)

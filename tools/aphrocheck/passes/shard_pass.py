@@ -1,0 +1,225 @@
+"""SHARD pass: PartitionSpec/mesh consistency and deprecated imports.
+
+The mesh is declared ONCE (executor.build_mesh's literal axis-name
+tuple); every PartitionSpec axis written anywhere else must name one
+of those axes, or GSPMD rejects the spec at dispatch time with an
+error that names neither the spec nor the layer that owns it.
+
+- SHARD001: a literal string axis in a `PartitionSpec(...)` / `P(...)`
+  call that no `Mesh(...)`/`make_mesh(...)` axis-name declaration in
+  the scanned tree provides. Variable axes (`in_axis`, a function's
+  `axis_name` parameter) are not literals and stay silent; the pass
+  is also silent when the scan contains no mesh declaration at all
+  (subset scans of non-mesh files).
+- SHARD002: `jax.device_put(x, NamedSharding(mesh, P(...)))` where the
+  spec has MORE axes than x's statically-known rank (resolved through
+  assignments to literal-shape constructors — jnp.zeros/ones/full —
+  and literal reshape chains). A spec shorter than the rank is legal
+  (trailing dims replicate); a longer one raises at runtime on the
+  first device_put of a multi-GB cache.
+- SHARD003: any import of `jax.experimental.shard_map` — deprecated
+  since jax 0.4.35, removed upstream; the supported spelling is
+  `jax.shard_map` (VERDICT r5 item #9). The version-bridge module
+  (aphrodite_tpu/common/compat.py) is exempt: it probes the current
+  API first and is the ONE place the legacy path may live.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set, Tuple
+
+from tools.aphrocheck.core import (COMPAT_MODULE, Finding, Module,
+                                   dotted_name, iter_calls, str_const,
+                                   tail_name)
+
+_SPEC_NAMES = ("PartitionSpec", "P")
+_MESH_NAMES = ("Mesh", "make_mesh")
+_ARRAY_CTORS = ("zeros", "ones", "full", "empty")
+
+
+def _declared_axes(modules: List[Module]) -> Tuple[Set[str], bool]:
+    """(axis names, any declaration found) across the scanned tree."""
+    axes: Set[str] = set()
+    found = False
+    for module in modules:
+        for call in module.calls:
+            if tail_name(call.func) not in _MESH_NAMES:
+                continue
+            cand = None
+            for kw in call.keywords:
+                if kw.arg == "axis_names":
+                    cand = kw.value
+            if cand is None and len(call.args) >= 2:
+                cand = call.args[1]
+            if isinstance(cand, (ast.Tuple, ast.List)):
+                names = [str_const(e) for e in cand.elts]
+                if all(n is not None for n in names):
+                    axes.update(names)
+                    found = True
+    return axes, found
+
+
+def _spec_aliases(module: Module) -> Set[str]:
+    """Local names PartitionSpec is bound to in this module."""
+    out = {"PartitionSpec"}
+    for node in module.nodes:
+        if isinstance(node, ast.ImportFrom) and \
+                node.module == "jax.sharding":
+            for alias in node.names:
+                if alias.name == "PartitionSpec":
+                    out.add(alias.asname or alias.name)
+    return out
+
+
+def _spec_calls(module: Module) -> List[ast.Call]:
+    aliases = _spec_aliases(module)
+    out = []
+    for call in module.calls:
+        name = tail_name(call.func)
+        if name in aliases or (name in _SPEC_NAMES and
+                               (dotted_name(call.func) or "").endswith(
+                                   "sharding." + name)):
+            out.append(call)
+    return out
+
+
+def _spec_axis_literals(call: ast.Call) -> List[Tuple[str, ast.AST]]:
+    out = []
+    for arg in call.args:
+        if isinstance(arg, (ast.Tuple, ast.List)):
+            for e in arg.elts:
+                s = str_const(e)
+                if s is not None:
+                    out.append((s, e))
+        else:
+            s = str_const(arg)
+            if s is not None:
+                out.append((s, arg))
+    return out
+
+
+def _static_rank(module: Module, scope, node: ast.AST,
+                 depth: int = 0) -> Optional[int]:
+    """Rank of an array expression when statically certain."""
+    if depth > 4 or node is None:
+        return None
+    if isinstance(node, ast.Call):
+        fn = tail_name(node.func)
+        if fn in _ARRAY_CTORS and node.args:
+            shape = node.args[0]
+            if isinstance(shape, (ast.Tuple, ast.List)):
+                return len(shape.elts)
+            if isinstance(shape, ast.Constant):
+                return 1
+            return None
+        if fn == "reshape":
+            # x.reshape(a, b, c) or x.reshape((a, b, c))
+            args = node.args
+            if len(args) == 1 and isinstance(args[0],
+                                             (ast.Tuple, ast.List)):
+                return len(args[0].elts)
+            if args and not any(isinstance(a, ast.Starred)
+                                for a in args):
+                return len(args)
+        return None
+    if isinstance(node, ast.Name):
+        from tools.aphrocheck.core import assignments_of
+        sources = assignments_of(scope, node.id) if scope is not None \
+            else []
+        if not sources:
+            return None
+        ranks = [_static_rank(module, scope, s, depth + 1)
+                 for s in sources]
+        # certain only when EVERY assignment resolves to ONE rank
+        if all(r is not None for r in ranks) and len(set(ranks)) == 1:
+            return ranks[0]
+        return None
+    return None
+
+
+def _check_rank(module: Module, findings: List[Finding]) -> None:
+    aliases = _spec_aliases(module)
+    for call in module.calls:
+        if tail_name(call.func) != "device_put" or \
+                len(call.args) < 2:
+            continue
+        sharding = call.args[1]
+        if not isinstance(sharding, ast.Call) or \
+                tail_name(sharding.func) != "NamedSharding" or \
+                len(sharding.args) < 2:
+            continue
+        spec = sharding.args[1]
+        if not isinstance(spec, ast.Call) or \
+                tail_name(spec.func) not in aliases:
+            continue
+        if any(isinstance(a, ast.Starred) for a in spec.args):
+            continue
+        spec_len = len(spec.args)
+        scope = module.enclosing_function(call)
+        rank = _static_rank(module, scope, call.args[0])
+        if rank is not None and spec_len > rank:
+            findings.append(module.finding(
+                "SHARD002", call,
+                f"PartitionSpec has {spec_len} axes but the operand's "
+                f"statically-known rank is {rank}; device_put raises "
+                "on rank-mismatched specs"))
+
+
+def _check_imports(module: Module, findings: List[Finding]) -> None:
+    if module.rel.replace("\\", "/") == \
+            COMPAT_MODULE.replace("\\", "/"):
+        return
+    for node in module.nodes:
+        if isinstance(node, ast.ImportFrom):
+            if (node.module or "").startswith(
+                    "jax.experimental.shard_map") or \
+                    ((node.module or "") == "jax.experimental" and
+                     any(a.name == "shard_map" for a in node.names)):
+                findings.append(module.finding(
+                    "SHARD003", node,
+                    "deprecated jax.experimental.shard_map import; "
+                    "use jax.shard_map (via "
+                    "aphrodite_tpu.common.compat.get_shard_map for "
+                    "jax<0.6 compatibility)"))
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.startswith("jax.experimental.shard_map"):
+                    findings.append(module.finding(
+                        "SHARD003", node,
+                        "deprecated jax.experimental.shard_map "
+                        "import; use jax.shard_map (via "
+                        "aphrodite_tpu.common.compat.get_shard_map "
+                        "for jax<0.6 compatibility)"))
+
+
+def run(ctx) -> List[Finding]:
+    findings: List[Finding] = []
+    axes, have_mesh = _declared_axes(ctx.modules)
+    for module in ctx.modules:
+        if have_mesh:
+            for call in _spec_calls(module):
+                for axis, node in _spec_axis_literals(call):
+                    if axis not in axes:
+                        findings.append(module.finding(
+                            "SHARD001", node,
+                            f"PartitionSpec axis {axis!r} is not an "
+                            f"axis of any declared mesh "
+                            f"({', '.join(sorted(axes))}); GSPMD "
+                            "rejects the spec at dispatch"))
+        _check_rank(module, findings)
+        _check_imports(module, findings)
+    return findings
+
+
+#: (rule, one-line contract, example) — rendered by `--rules-md`.
+RULES = (
+    ("SHARD001", "literal PartitionSpec axis that no declared mesh "
+     "provides",
+     '`P("model")` against `Mesh(..., ("dp", "pp", "sp", "tp"))`'),
+    ("SHARD002", "NamedSharding spec with more axes than the "
+     "operand\'s statically-known rank",
+     '`device_put(jnp.zeros((4, 8)), ... P("dp", None, "tp"))`'),
+    ("SHARD003", "deprecated `jax.experimental.shard_map` import "
+     "outside the compat module",
+     "`from jax.experimental.shard_map import shard_map`"),
+)

@@ -39,7 +39,7 @@ _SYNC_CALLS = {"np.asarray", "np.array", "numpy.asarray",
 
 
 def _hot_functions(module: Module) -> List[ast.FunctionDef]:
-    return [n for n in ast.walk(module.tree)
+    return [n for n in module.nodes
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
             and HOT_NAME.match(n.name)]
 
@@ -112,7 +112,7 @@ def _static_jit_callables(module: Module):
                                     for e in kw.value.elts) if s}
         return set()
 
-    for node in ast.walk(module.tree):
+    for node in module.nodes:
         if isinstance(node, ast.Assign) and \
                 isinstance(node.value, ast.Call):
             call = node.value
@@ -171,7 +171,7 @@ def run(ctx) -> List[Finding]:
                         "device_get"))
 
         statics = _static_jit_callables(module)
-        for call in iter_calls(module.tree):
+        for call in module.calls:
             key = tail_name(call.func)
             if key not in statics:
                 continue
@@ -185,3 +185,17 @@ def run(ctx) -> List[Finding]:
                         "static args must be hashable (and stable, "
                         "or every call retraces)"))
     return findings
+
+
+#: (rule, one-line contract, example) — rendered by `--rules-md`.
+RULES = (
+    ("SYNC001", "`.item()` in a hot-path (`execute_*`/`dispatch_*`/"
+     "`finalize_*`) function: a per-element host sync",
+     "`logits.argmax().item()` in `execute_model`"),
+    ("SYNC002", "`np.asarray`/`device_get` inside a loop in a "
+     "hot-path function: one host sync per iteration",
+     "`[np.asarray(x) for x in rows]`"),
+    ("SYNC003", "unhashable list/dict/set literal passed as a "
+     "`static_argnames` jit argument",
+     "`fn(x, sizes=[1, 2, 3])` with `sizes` static"),
+)

@@ -85,9 +85,9 @@ def _has_fit_guard(scope: Optional[ast.AST]) -> bool:
     return False
 
 
-def _site_lower_bound(site: PallasSite) -> float:
+def _site_lower_bound(site: PallasSite, call_graph=None) -> float:
     module = site.module
-    ev = IntervalEvaluator(module, site.scope)
+    ev = IntervalEvaluator(module, site.scope, call_graph=call_graph)
     lo_total = 0.0
     for variant in site.variants:
         variant_lo = 0.0
@@ -117,7 +117,8 @@ def run(ctx) -> List[Finding]:
     budget = getattr(ctx, "vmem_budget", DEFAULT_BUDGET)
     for module in ctx.modules:
         for site in find_sites(module):
-            lo = _site_lower_bound(site)
+            lo = _site_lower_bound(site,
+                                   getattr(ctx, "call_graph", None))
             if lo <= budget:
                 continue
             if _has_fit_guard(site.scope):
@@ -129,3 +130,14 @@ def run(ctx) -> List[Finding]:
                 "budget) with no fit-guarded fallback in the "
                 "enclosing function"))
     return findings
+
+
+#: (rule, one-line contract, example) — rendered by `--rules-md`.
+RULES = (
+    ("VMEM001", "pallas_call whose provable lower-bound VMEM "
+     "footprint (scratch + BlockSpec blocks, flags at defaults) "
+     "exceeds the 16 MiB per-core budget with no fit-guarded "
+     "fallback",
+     "`pltpu.VMEM((4096, 2048), jnp.float32)` scratch alone is "
+     "32 MiB"),
+)

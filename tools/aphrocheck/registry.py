@@ -77,7 +77,7 @@ def accessor_reads(module: Module
     """(flag_name, call_node, accessor) for every registry read with a
     literal name in the module."""
     out = []
-    for call in iter_calls(module.tree):
+    for call in module.calls:
         fn = tail_name(call.func)
         if fn in ACCESSORS and call.args:
             name = str_const(call.args[0])

@@ -43,17 +43,11 @@ def resolve(module: Module, scope: Optional[ast.AST],
     if not isinstance(node, ast.Name):
         return [Candidate(node, module.branch_path(node))]
     out: List[Candidate] = []
-    for root in filter(None, [scope, module.tree]):
-        for n in ast.walk(root):
-            if isinstance(n, ast.Assign):
-                for tgt in n.targets:
-                    if isinstance(tgt, ast.Name) and tgt.id == node.id:
-                        out.append(Candidate(
-                            n.value, module.branch_path(n)))
-            elif isinstance(n, (ast.FunctionDef,
-                                ast.AsyncFunctionDef)) and \
-                    n.name == node.id:
-                out.append(Candidate(n, module.branch_path(n)))
+    for root in ([scope] if scope is not None else []) + [None]:
+        for value in module.assign_index(root).get(node.id, ()):
+            out.append(Candidate(value, module.branch_path(value)))
+        for fn in module.def_index(root).get(node.id, ()):
+            out.append(Candidate(fn, module.branch_path(fn)))
         if out:
             break
     return out
@@ -109,7 +103,7 @@ def _variant_from_grid_spec(module: Module, scope, cand: Candidate
 
 def find_sites(module: Module) -> List[PallasSite]:
     sites: List[PallasSite] = []
-    for call in iter_calls(module.tree):
+    for call in module.calls:
         if tail_name(call.func) != "pallas_call":
             continue
         scope = module.top_level_function(call)
@@ -170,6 +164,137 @@ def list_elements(module: Module, scope, node: Optional[ast.AST]
                                    (ast.List, ast.Tuple)):
                     appended.extend(call.args[0].elts)
     return base, appended, resolved
+
+
+@dataclasses.dataclass
+class RefInfo:
+    """What the analyzer knows about one kernel ref parameter."""
+    name: str
+    kind: str                    # 'prefetch' | 'input' | 'output' |
+                                 # 'scratch' | 'sem'
+    dims: Optional[List[ast.AST]]   # shape dim exprs (site scope)
+    dtype: Optional[str]         # dtype tail name when static
+
+
+def _spec_dims(spec: ast.AST) -> Optional[List[ast.AST]]:
+    """Block dims of a BlockSpec entry; None when the operand stays in
+    HBM (memory_space=...) or the block shape is not a literal tuple."""
+    if not isinstance(spec, ast.Call) or \
+            tail_name(spec.func) != "BlockSpec":
+        return None
+    if keyword_arg(spec, "memory_space") is not None:
+        return None
+    if spec.args and isinstance(spec.args[0], ast.Tuple):
+        return list(spec.args[0].elts)
+    return None
+
+
+def _scratch_ref(name: str, entry: ast.AST) -> Optional[RefInfo]:
+    from tools.aphrocheck.core import DTYPE_BYTES, dotted_name
+    if not isinstance(entry, ast.Call):
+        return None
+    fn = dotted_name(entry.func) or tail_name(entry.func) or ""
+    kind = "sem" if "SemaphoreType" in fn or fn.endswith("DMA") or \
+        fn.endswith("REGULAR") else "scratch"
+    dims: Optional[List[ast.AST]] = None
+    if entry.args:
+        shape = entry.args[0]
+        if isinstance(shape, ast.Tuple):
+            dims = list(shape.elts)
+        else:
+            dims = [shape]
+    dtype = None
+    if kind == "scratch" and len(entry.args) > 1:
+        t = tail_name(entry.args[1])
+        dtype = t if t in DTYPE_BYTES else None
+    return RefInfo(name, kind, dims, dtype)
+
+
+def bind_kernel_refs(module: Module, site: "PallasSite",
+                     variant: SpecVariant, kernel_fn: ast.FunctionDef
+                     ) -> Optional[Dict[str, RefInfo]]:
+    """Map the kernel's positional parameters to their ref shapes.
+
+    Pallas binds kernel params positionally: scalar-prefetch refs,
+    then one per in_spec, then one per out_spec (or out_shape entry),
+    then one per scratch_shapes entry. The binding is attempted for
+    the resolved spec lists with and without their conditional
+    `.append(...)` tails (the deferred-accumulator idiom appends one
+    scratch plane, and the matching kernel variant has one more
+    param); a kernel taking *refs, or a site whose counts fit no
+    combination, returns None — unresolvable sites must stay silent,
+    not guess."""
+    args = kernel_fn.args
+    if args.vararg is not None:
+        return None
+    params = [a.arg for a in args.posonlyargs + args.args]
+    nsp = variant.num_scalar_prefetch
+    if nsp is None:
+        return None
+
+    def candidates(specs):
+        """Every branch-alternative reading of a spec-list expression,
+        each offered with and without its conditional appends."""
+        if specs is None:
+            return [[]]
+        if isinstance(specs, ast.Call):
+            return [[specs]]
+        bases = [list(c.node.elts)
+                 for c in resolve(module, site.scope, specs)
+                 if isinstance(c.node, (ast.List, ast.Tuple))]
+        if not bases:
+            return None      # a spec list we cannot see through
+        _, appended, _ = list_elements(module, site.scope, specs)
+        out = []
+        for base in bases:
+            out.append(base)
+            if appended:
+                out.append(base + appended)
+        return out
+
+    in_cands = candidates(variant.in_specs)
+    out_cands = candidates(variant.out_specs)
+    if variant.out_specs is None:
+        # outputs come from out_shape alone (no blocking info)
+        out_shape = keyword_arg(site.call, "out_shape")
+        n_out = len(out_shape.elts) if isinstance(
+            out_shape, (ast.List, ast.Tuple)) else 1
+        out_cands = [[None] * n_out]
+    scr_cands = candidates(variant.scratch_shapes)
+    if in_cands is None or out_cands is None or scr_cands is None:
+        return None
+
+    for ins in in_cands:
+        for outs in out_cands:
+            for scrs in scr_cands:
+                if nsp + len(ins) + len(outs) + len(scrs) != \
+                        len(params):
+                    continue
+                refs: Dict[str, RefInfo] = {}
+                idx = 0
+                for _ in range(nsp):
+                    refs[params[idx]] = RefInfo(params[idx],
+                                                "prefetch", None, None)
+                    idx += 1
+                for spec in ins:
+                    refs[params[idx]] = RefInfo(
+                        params[idx], "input",
+                        _spec_dims(spec) if spec is not None else None,
+                        None)
+                    idx += 1
+                for spec in outs:
+                    refs[params[idx]] = RefInfo(
+                        params[idx], "output",
+                        _spec_dims(spec) if spec is not None else None,
+                        None)
+                    idx += 1
+                for entry in scrs:
+                    info = _scratch_ref(params[idx], entry)
+                    refs[params[idx]] = info if info is not None else \
+                        RefInfo(params[idx], "scratch", None, None)
+                    idx += 1
+                return refs
+    return None
 
 
 def resolve_kernel_functions(module: Module, scope,
