@@ -305,3 +305,39 @@ _register(Flag(
     "APHRODITE_PSTEP", "str", "full,nokv,nosilu,nonorm,norope",
     "Comma list of profile_step.py ablation variants to run (each "
     "costs ~2 min of compiles; subset to fit shell timeouts)."))
+
+_register(Flag(
+    "APHRODITE_STEP_RETRIES", "int", 2,
+    "Max retries of a failed engine step classified as transient "
+    "before the supervised loop declares the engine DEAD. Malformed "
+    "values warn and fall back (a typo must not kill serving).",
+    minimum=0))
+
+_register(Flag(
+    "APHRODITE_STEP_BACKOFF_S", "float", 0.05,
+    "Base delay (seconds) of the exponential backoff between engine-"
+    "step retries: attempt k sleeps base * 2^(k-1).",
+    minimum=0))
+
+_register(Flag(
+    "APHRODITE_STEP_TIMEOUT_S", "float", 0,
+    "Step watchdog: seconds an off-loop engine step may run before "
+    "the engine is declared DEAD (a wedged XLA compile/device call "
+    "cannot be interrupted, only detected). 0 disables the watchdog; "
+    "it also bounds the last-step age before /health reports "
+    "DEGRADED.",
+    minimum=0))
+
+_register(Flag(
+    "APHRODITE_FAULT", "str", "",
+    "Fault-injection spec `point:kind:prob:count[,...]` (points: "
+    "engine.step, scheduler.schedule, block_manager.allocate, "
+    "executor.execute_model, tokenizer.decode; kinds: transient/"
+    "request/fatal). Unset = injection compiled out. See "
+    "common/faultinject.py for the grammar."))
+
+_register(Flag(
+    "APHRODITE_FAULT_SEED", "int", 0,
+    "Seed of the deterministic per-rule RNG behind APHRODITE_FAULT "
+    "probability draws; one (spec, seed) pair replays the exact same "
+    "fault schedule."))

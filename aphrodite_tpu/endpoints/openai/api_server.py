@@ -123,8 +123,20 @@ class OpenAIServer:
     # ---- simple routes ----
 
     async def health(self, request: web.Request) -> web.Response:
-        await self.engine.check_health()
-        return web.Response(status=200)
+        """Engine health as JSON: state (RUNNING/DEGRADED/DEAD), last-
+        step age, step/retry counters. 200 while the engine can serve
+        (DEGRADED included — it is still making progress), 503 once it
+        is DEAD so load balancers eject the replica."""
+        from aphrodite_tpu.engine.async_aphrodite import (
+            AsyncEngineDeadError)
+        try:
+            report = await self.engine.check_health()
+        except AsyncEngineDeadError as e:
+            body = self.engine.health.report().to_json()
+            body["state"] = "DEAD"
+            body["error"] = str(e)
+            return web.json_response(body, status=503)
+        return web.json_response(report.to_json())
 
     async def start_profile(self, request: web.Request) -> web.Response:
         """Begin a jax.profiler trace (xprof/tensorboard viewable);

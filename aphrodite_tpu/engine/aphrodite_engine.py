@@ -14,8 +14,9 @@ prefix pool, metrics) keeps reference semantics.
 from __future__ import annotations
 
 import time
-from typing import Iterable, List, Optional, Union
+from typing import Iterable, List, Optional, Tuple, Union
 
+from aphrodite_tpu.common import faultinject
 from aphrodite_tpu.common.config import (CacheConfig, DeviceConfig,
                                          LoRAConfig, ModelConfig,
                                          ParallelConfig, SchedulerConfig)
@@ -28,6 +29,7 @@ from aphrodite_tpu.common.sequence import (SamplerOutput, Sequence,
                                            SequenceStatus)
 from aphrodite_tpu.engine.args_tools import EngineArgs
 from aphrodite_tpu.engine.metrics import StatLogger, Stats
+from aphrodite_tpu.engine.supervisor import FaultClass, classify_failure
 from aphrodite_tpu.executor.executor import TPUExecutor
 from aphrodite_tpu.processing.scheduler import (Scheduler,
                                                 SchedulerOutputs)
@@ -131,6 +133,15 @@ class AphroditeEngine:
         self._tpot_samples: List[float] = []
         self._e2e_samples: List[float] = []
         self._profiling = False
+        # Fault-isolation bookkeeping: (request_id, exception) pairs
+        # for requests aborted by request-scoped failures or crash-
+        # barrier casualties this step; the async layer drains them and
+        # propagates each exception to exactly that stream.
+        self._step_faults: List[Tuple[str, Exception]] = []
+        # SchedulerOutputs committed by the current step (several when
+        # the step pipelines builder rounds) — the crash barrier's
+        # rollback scope.
+        self._inflight_rounds: List[SchedulerOutputs] = []
 
     # -- profiling (reference aux tracing; TPU-native: jax.profiler
     #    traces carry XLA/TPU timelines viewable in tensorboard/xprof) --
@@ -234,10 +245,43 @@ class AphroditeEngine:
         one round, reference step :754-828 runs one or the other); an
         eligible decode batch with multi_step>1 runs as a device-side
         burst of K tokens per seq. A combined round enqueues the prefill
-        program and the burst back-to-back and pays ONE host sync."""
+        program and the burst back-to-back and pays ONE host sync.
+
+        Failure semantics (the crash barrier): if anything after
+        scheduling fails, every mutation of this round — scheduled
+        groups, freshly allocated/forked pages, swap/copy plans — is
+        rolled back via `Scheduler.crash_rollback` before the exception
+        propagates, so a retried step neither leaks KV pages nor
+        double-schedules. Requests the rollback could not restore are
+        recorded in `_step_faults` (drained by `drain_step_faults`)."""
+        faultinject.fire("engine.step")
+        self._inflight_rounds = []
         seq_group_metadata_list, scheduler_outputs = \
             self.scheduler.schedule()
+        self._inflight_rounds.append(scheduler_outputs)
+        try:
+            return self._execute_round(seq_group_metadata_list,
+                                       scheduler_outputs)
+        except Exception as exc:
+            for rid in self.scheduler.crash_rollback(
+                    self._inflight_rounds):
+                err: Exception = RuntimeError(
+                    f"request {rid} aborted: its KV state could not "
+                    "be rolled back after a failed engine step "
+                    f"({type(exc).__name__}: {exc})")
+                err.__cause__ = exc
+                self._step_faults.append((rid, err))
+            raise
 
+    def drain_step_faults(self) -> List[Tuple[str, Exception]]:
+        """(request_id, exception) pairs for requests this step aborted
+        with request-scoped blast radius; each exception belongs to
+        exactly that request's stream."""
+        faults, self._step_faults = self._step_faults, []
+        return faults
+
+    def _execute_round(self, seq_group_metadata_list,
+                       scheduler_outputs) -> List[RequestOutput]:
         if scheduler_outputs.is_empty():
             return self._process_round(None, [], scheduler_outputs)
 
@@ -329,12 +373,14 @@ class AphroditeEngine:
                 # admitted): no device work, but the FINISHED_IGNORED
                 # outputs must still flow to their streams.
                 rounds.append(outputs2)
+                self._inflight_rounds.append(outputs2)
                 handles.append([])
                 break
             # schedule_prompt_only() has already committed this round's
             # admissions (pages allocated, chunk progress advanced), so
             # an ineligible round must still EXECUTE — synced — not be
             # dropped: its KV writes and sampled tokens are owed.
+            self._inflight_rounds.append(outputs2)
             h2 = None
             if self._prompt_fast_path_ok(mds2):
                 h2 = self.executor.dispatch_prompt_round(
@@ -438,24 +484,30 @@ class AphroditeEngine:
         step's outputs (a burst passes several)."""
         touched: List = []
         tokens_of = {}
+        failed: set = set()
         if prompt_output:
             for chunk, outputs in zip(scheduler_outputs.prompt_chunks,
                                       prompt_output):
                 if not chunk.is_final:
                     continue
-                self._process_sequence_group_outputs(chunk.group, outputs)
-                touched.append(chunk.group)
-                tokens_of[id(chunk.group)] = len(outputs.samples)
+                if self._process_group_isolated(chunk.group, outputs):
+                    touched.append(chunk.group)
+                    tokens_of[id(chunk.group)] = len(outputs.samples)
         decode_groups = scheduler_outputs.decode_groups
         for group in decode_groups:
             tokens_of[id(group)] = 0
         for output in decode_outputs_list:
             for seq_group, outputs in zip(decode_groups, output):
                 if seq_group.is_finished():
-                    continue        # burst overran this group's stop
-                self._process_sequence_group_outputs(seq_group, outputs)
-                tokens_of[id(seq_group)] += len(outputs.samples)
-        touched.extend(decode_groups)
+                    # Burst overran this group's stop, or a request-
+                    # scoped failure aborted it earlier in this burst.
+                    continue
+                if self._process_group_isolated(seq_group, outputs):
+                    tokens_of[id(seq_group)] += len(outputs.samples)
+                else:
+                    failed.add(id(seq_group))
+        touched.extend(g for g in decode_groups
+                       if id(g) not in failed)
         self._record_latencies(touched, tokens_of=tokens_of)
         self.scheduler.free_finished_seq_groups()
 
@@ -497,6 +549,40 @@ class AphroditeEngine:
             if group.is_finished() and group.finished_time is None:
                 group.finished_time = now
                 self._e2e_samples.append(now - group.arrival_time)
+
+    def _process_group_isolated(self, seq_group: SequenceGroup,
+                                outputs: SequenceGroupOutput) -> bool:
+        """Apply one group's sampled outputs, quarantining request-
+        scoped failures (tokenizer/decode errors, per-sequence sampler
+        state bugs): the culprit request is aborted, its pages freed,
+        and its exception recorded for `drain_step_faults` — concurrent
+        requests in the same round are untouched. Engine-scoped
+        failures re-raise into the crash barrier. Returns True when
+        processing succeeded."""
+        try:
+            self._process_sequence_group_outputs(seq_group, outputs)
+            return True
+        except Exception as exc:
+            cls = classify_failure(exc, default=FaultClass.REQUEST)
+            if cls is not FaultClass.REQUEST:
+                raise
+            logger.warning(
+                "request %s aborted by a request-scoped failure during "
+                "output processing: %s: %s", seq_group.request_id,
+                type(exc).__name__, exc)
+            self._fail_request(seq_group, exc)
+            return False
+
+    def _fail_request(self, seq_group: SequenceGroup,
+                      exc: Exception) -> None:
+        """Abort one request with request-scoped blast radius: free its
+        sequences' pages and record the exception for its stream."""
+        for seq in seq_group.get_seqs():
+            if seq.is_finished():
+                continue
+            seq.status = SequenceStatus.FINISHED_ABORTED
+            self.scheduler.free_seq(seq)
+        self._step_faults.append((seq_group.request_id, exc))
 
     def _process_sequence_group_outputs(
             self, seq_group: SequenceGroup,
@@ -641,6 +727,7 @@ class AphroditeEngine:
         """Incremental detokenization (reference :893-911)."""
         if self.tokenizer is None:     # token-id-only mode (benchmarks)
             return
+        faultinject.fire("tokenizer.decode", detail=f"seq {seq.seq_id}")
         tokenizer = self.tokenizer.get_lora_tokenizer()
         (new_tokens, new_output_text, prefix_offset,
          read_offset) = detokenize_incrementally(

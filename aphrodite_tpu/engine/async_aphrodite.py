@@ -1,4 +1,5 @@
-"""Async serving engine: per-request streams over the background step loop.
+"""Async serving engine: per-request streams over a SUPERVISED
+background step loop.
 
 Reference: `aphrodite/engine/async_aphrodite.py` (AsyncStream `:41`,
 RequestTracker `:73`, _AsyncAphrodite.step_async `:175`, AsyncAphrodite
@@ -9,6 +10,17 @@ executor so the asyncio loop stays responsive while XLA runs (the
 reference's Ray/await machinery collapses to one `run_in_executor`); the
 engine-as-Ray-actor mode has no equivalent because there are no worker
 processes.
+
+Supervision (engine/supervisor.py): step failures are classified by
+blast radius — request-scoped failures error only the culprit stream,
+transient engine failures are crash-rolled-back and retried with
+bounded exponential backoff (`APHRODITE_STEP_RETRIES` /
+`APHRODITE_STEP_BACKOFF_S`), and unrecoverable failures move the
+engine to a terminal DEAD state where in-flight, pending, and new
+requests all fail fast with `AsyncEngineDeadError` instead of
+hanging. A watchdog (`APHRODITE_STEP_TIMEOUT_S`) bounds the off-loop
+step so a hung XLA compile is detected rather than wedging forever
+behind a healthy-looking `check_health`.
 """
 from __future__ import annotations
 
@@ -18,12 +30,18 @@ import time
 from typing import (AsyncIterator, Callable, Dict, Iterable, List,
                     Optional, Set, Tuple, Type, Union)
 
+from aphrodite_tpu.common import flags
 from aphrodite_tpu.common.config import ModelConfig
 from aphrodite_tpu.common.logger import init_logger
 from aphrodite_tpu.common.outputs import RequestOutput
 from aphrodite_tpu.common.sampling_params import SamplingParams
 from aphrodite_tpu.engine.aphrodite_engine import AphroditeEngine
 from aphrodite_tpu.engine.args_tools import AsyncEngineArgs
+from aphrodite_tpu.engine.supervisor import (FaultClass, HealthMonitor,
+                                             HealthReport,
+                                             StepTimeoutError,
+                                             classify_failure,
+                                             retry_policy)
 
 logger = init_logger(__name__)
 
@@ -32,23 +50,43 @@ class AsyncEngineDeadError(RuntimeError):
     pass
 
 
-def _raise_exception_on_finish(task: asyncio.Task,
-                               request_tracker: "RequestTracker") -> None:
-    msg = ("Task finished unexpectedly. This should never happen! "
-           "Please open an issue on Github.")
-    try:
-        try:
-            task.result()
-        except asyncio.CancelledError:
-            return
-        except Exception as exc:
-            raise AsyncEngineDeadError(
-                msg + " See stack trace above for the actual cause.") \
-                from exc
-        raise AsyncEngineDeadError(msg)
-    except Exception as exc:
-        request_tracker.propagate_exception(exc)
-        raise exc
+def _consume_abandoned_step(fut) -> None:
+    """Done-callback for a step the watchdog abandoned: retrieve its
+    eventual result/exception so the loop never logs an unretrieved-
+    exception warning for a thread we already declared dead."""
+    if fut.cancelled():
+        return
+    exc = fut.exception()
+    if exc is not None:
+        logger.error("watchdog-abandoned engine step eventually "
+                     "failed: %s: %s", type(exc).__name__, exc)
+    else:
+        logger.warning("watchdog-abandoned engine step eventually "
+                       "completed; its outputs are discarded")
+
+
+def _finalize_engine_loop(task: asyncio.Task,
+                          request_tracker: "RequestTracker",
+                          health: HealthMonitor) -> None:
+    """Done-callback of the background loop. The loop exits cleanly
+    after recording DEAD (engine_step handles its own failures), so an
+    exception here means a bug in the loop itself — record it in the
+    health state machine and fail the streams instead of re-raising
+    into the event loop's unhandled-exception logger (noise nothing
+    catches)."""
+    if task.cancelled():
+        return
+    exc = task.exception()
+    if exc is None:
+        return                  # clean exit: DEAD already recorded
+    logger.error("engine loop terminated unexpectedly: %s: %s",
+                 type(exc).__name__, exc)
+    health.mark_dead(exc)
+    err = AsyncEngineDeadError(
+        "Engine loop terminated unexpectedly "
+        f"({type(exc).__name__}: {exc}). Restart the server.")
+    err.__cause__ = exc
+    request_tracker.fail_all(err)
 
 
 class AsyncStream:
@@ -101,10 +139,26 @@ class RequestTracker:
     def propagate_exception(self, exc: Exception,
                             request_id: Optional[str] = None) -> None:
         if request_id is not None:
-            self._request_streams[request_id].put(exc)
+            # An abort can race a step error: the request may already
+            # be untracked by the time its exception arrives. Dropping
+            # is correct — the stream was finished by the abort — and
+            # must not KeyError (that would kill the loop this call
+            # was trying to save).
+            stream = self._request_streams.get(request_id)
+            if stream is not None:
+                stream.put(exc)
         else:
             for stream in self._request_streams.values():
                 stream.put(exc)
+
+    def fail_all(self, exc: Exception) -> None:
+        """Terminal failure: error every tracked stream AND every
+        queued-but-not-yet-tracked request (a request enqueued just
+        before the engine died must fail fast, not hang)."""
+        while not self._new_requests.empty():
+            stream, _ = self._new_requests.get_nowait()
+            self._request_streams.setdefault(stream.request_id, stream)
+        self.propagate_exception(exc)
 
     def process_request_output(self, request_output: RequestOutput,
                                *, verbose: bool = False) -> None:
@@ -174,6 +228,7 @@ class AsyncAphrodite:
         self.max_log_len = max_log_len
         self.start_engine_loop = start_engine_loop
         self._request_tracker = RequestTracker()
+        self.health = HealthMonitor()
         self.background_loop: Optional[asyncio.Future] = None
         self._background_loop_unshielded = None
 
@@ -197,25 +252,79 @@ class AsyncAphrodite:
     def start_background_loop(self) -> None:
         if self.is_running:
             raise RuntimeError("Background loop is already running.")
+        if self.health.is_dead:
+            raise AsyncEngineDeadError(
+                "Engine is DEAD and cannot be restarted in-process: "
+                + (self.health.dead_reason or "unknown failure"))
         self._request_tracker.init_event()
         loop = asyncio.get_event_loop()
         self._background_loop_unshielded = loop.create_task(
             self.run_engine_loop())
         self._background_loop_unshielded.add_done_callback(
-            functools.partial(_raise_exception_on_finish,
-                              request_tracker=self._request_tracker))
+            functools.partial(_finalize_engine_loop,
+                              request_tracker=self._request_tracker,
+                              health=self.health))
         self.background_loop = asyncio.shield(
             self._background_loop_unshielded)
 
+    async def _step_with_watchdog(self) -> List[RequestOutput]:
+        """Run the (blocking, device-dispatching) step off-loop, bounded
+        by APHRODITE_STEP_TIMEOUT_S when set. A timed-out step leaves
+        its executor thread wedged (a hung XLA compile/device call is
+        uninterruptible from Python), so timeout is terminal — the
+        point is detection instead of a forever-'healthy' hang."""
+        loop = asyncio.get_event_loop()
+        fut = loop.run_in_executor(None, self.engine.step)
+        timeout = flags.get_float("APHRODITE_STEP_TIMEOUT_S")
+        if not timeout or timeout <= 0:
+            return await fut
+        done, _ = await asyncio.wait({fut}, timeout=timeout)
+        if done:
+            return fut.result()
+        fut.add_done_callback(_consume_abandoned_step)
+        raise StepTimeoutError(
+            f"engine step exceeded APHRODITE_STEP_TIMEOUT_S="
+            f"{timeout:g}s; the step thread is wedged (likely a hung "
+            "compile or device call)")
+
+    def _propagate_step_faults(self) -> None:
+        """Deliver request-scoped step failures to exactly the culprit
+        streams (the engine quarantined and freed those requests)."""
+        for request_id, exc in self.engine.drain_step_faults():
+            self._request_tracker.propagate_exception(exc, request_id)
+            self._request_tracker.abort_request(request_id)
+
+    def _die(self, exc: Exception) -> None:
+        """Terminal transition: record DEAD, fail every in-flight and
+        queued stream fast, and stop the loop."""
+        self.health.mark_dead(exc)
+        logger.error(
+            "Engine is DEAD: %s: %s — in-flight and future requests "
+            "will fail fast with AsyncEngineDeadError.",
+            type(exc).__name__, exc)
+        err = AsyncEngineDeadError(
+            f"Engine loop is dead ({type(exc).__name__}: {exc}). "
+            "Restart the server.")
+        err.__cause__ = exc
+        self._request_tracker.fail_all(err)
+        raise err
+
     async def engine_step(self) -> bool:
-        """Kick the engine; returns True if there is in-flight work."""
+        """Kick the engine; returns True if there is in-flight work.
+
+        Supervision: transient step failures are retried (the engine's
+        crash barrier already rolled the round back) with bounded
+        exponential backoff; anything else is terminal."""
         new_requests, finished_requests = \
             self._request_tracker.get_new_and_finished_requests()
 
         for new_request in new_requests:
             try:
                 self.engine.add_request(**new_request)
-            except ValueError as e:
+            except (ValueError, RuntimeError) as e:
+                # Malformed request at admission (bad params, tokenizer
+                # or LoRA failures — RuntimeErrors included): fail that
+                # request, never the loop.
                 request_id = new_request["request_id"]
                 self._request_tracker.propagate_exception(e, request_id)
                 self._request_tracker.abort_request(request_id)
@@ -223,10 +332,35 @@ class AsyncAphrodite:
         if finished_requests:
             self.engine.abort_request(finished_requests)
 
-        # Run the (blocking, device-dispatching) step off-loop.
-        loop = asyncio.get_event_loop()
-        request_outputs = await loop.run_in_executor(None,
-                                                     self.engine.step)
+        max_retries, backoff = retry_policy()
+        attempt = 0
+        while True:
+            try:
+                request_outputs = await self._step_with_watchdog()
+                break
+            except Exception as exc:
+                # Crash-barrier casualties first: their streams get the
+                # rollback error even when the step itself is retried.
+                self._propagate_step_faults()
+                cls = classify_failure(exc)
+                if cls is not FaultClass.FATAL and attempt < max_retries:
+                    attempt += 1
+                    self.health.record_failure(exc)
+                    delay = backoff * (2 ** (attempt - 1))
+                    logger.warning(
+                        "Transient engine-step failure (attempt %d/%d,"
+                        " retrying in %.3fs): %s: %s", attempt,
+                        max_retries, delay, type(exc).__name__, exc)
+                    await asyncio.sleep(delay)
+                    continue
+                self._die(exc)
+
+        if attempt:
+            self.health.record_recovery()
+            logger.info("Engine step recovered after %d retr%s.",
+                        attempt, "y" if attempt == 1 else "ies")
+        self.health.beat()
+        self._propagate_step_faults()
         for request_output in request_outputs:
             self._request_tracker.process_request_output(
                 request_output, verbose=self.log_requests)
@@ -241,7 +375,14 @@ class AsyncAphrodite:
         while True:
             if not has_requests_in_progress:
                 await self._request_tracker.wait_for_new_requests()
-            has_requests_in_progress = await self.engine_step()
+            try:
+                has_requests_in_progress = await self.engine_step()
+            except AsyncEngineDeadError:
+                # Terminal: streams already failed, health already
+                # DEAD. Exit cleanly — the done-callback treats a
+                # clean exit as 'already handled' (no event-loop
+                # unhandled-exception noise).
+                return
             await asyncio.sleep(0)
 
     async def add_request(
@@ -261,6 +402,14 @@ class AsyncAphrodite:
                 shortened = prompt[:max_len] + ("…" if max_len else "")
             logger.info("Received request %s: prompt=%r params=%s",
                         request_id, shortened, sampling_params)
+        if self.health.is_dead:
+            # Fail fast BEFORE enqueueing: a dead engine's loop will
+            # never drain the queue, and it must not be restarted over
+            # a possibly-wedged step thread.
+            raise AsyncEngineDeadError(
+                "Engine is DEAD ("
+                + (self.health.dead_reason or "unknown failure")
+                + "); new requests fail fast. Restart the server.")
         if not self.is_running:
             if self.start_engine_loop:
                 self.start_background_loop()
@@ -309,6 +458,15 @@ class AsyncAphrodite:
     async def get_model_config(self) -> ModelConfig:
         return self.engine.get_model_config()
 
-    async def check_health(self) -> None:
+    async def check_health(self) -> HealthReport:
+        """RUNNING/DEGRADED/DEAD report with last-step age and retry
+        counters (surfaced by the OpenAI /health endpoint); raises
+        AsyncEngineDeadError when the engine can no longer serve."""
+        if self.health.is_dead:
+            raise AsyncEngineDeadError(
+                "Engine is DEAD: "
+                + (self.health.dead_reason or "unknown failure"))
         if not self.is_running:
             raise AsyncEngineDeadError("Background loop is stopped.")
+        return self.health.report(
+            in_flight=self.engine.has_unfinished_requests())

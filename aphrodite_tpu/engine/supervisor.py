@@ -1,0 +1,210 @@
+"""Engine supervision: fault classification and the health state
+machine behind the supervised serving loop.
+
+Before this layer, one exception anywhere in ``engine.step()``
+permanently killed the background loop and every in-flight request,
+and ``check_health()`` only knew "task not done". The supervised loop
+(`AsyncAphrodite.engine_step`) now consults two pieces that live
+here:
+
+- :func:`classify_failure` sorts a step failure into one of three
+  failure classes with distinct blast radii:
+
+  * ``REQUEST`` — bad params, tokenizer/decode failures, per-sequence
+    sampler errors: abort only the culprit request and propagate the
+    exception to that stream alone.
+  * ``TRANSIENT`` — engine-scoped but recoverable (device RPC blips,
+    injected transient faults): the step is rolled back by the crash
+    barrier (`Scheduler.crash_rollback`) and retried with bounded
+    exponential backoff (``APHRODITE_STEP_RETRIES`` /
+    ``APHRODITE_STEP_BACKOFF_S``).
+  * ``FATAL`` — everything else, plus watchdog timeouts: the engine
+    moves to the terminal DEAD state where pending and new requests
+    fail fast with ``AsyncEngineDeadError`` instead of hanging.
+
+- :class:`HealthMonitor` is the RUNNING/DEGRADED/DEAD state machine:
+  a monotonic heartbeat stamped per completed step, failure/recovery
+  counters, and a :class:`HealthReport` the OpenAI ``/health``
+  endpoint serializes (state, last-step age, retry totals).
+
+This module imports only ``common`` pieces so both the sync engine
+and the async wrapper can use it without cycles.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+from typing import Any, Dict, Optional
+
+from aphrodite_tpu.common import flags
+from aphrodite_tpu.common.faultinject import InjectedFault
+
+__all__ = [
+    "EngineState", "FaultClass", "HealthMonitor", "HealthReport",
+    "StepTimeoutError", "classify_failure", "retry_policy",
+]
+
+
+class StepTimeoutError(RuntimeError):
+    """The watchdog expired while a step ran off-loop. The executor
+    thread is still wedged inside the step (a hung XLA compile or
+    device call cannot be interrupted from Python), so this is always
+    FATAL: retrying would double-execute the round."""
+
+
+class EngineState(enum.Enum):
+    RUNNING = "RUNNING"
+    DEGRADED = "DEGRADED"
+    DEAD = "DEAD"
+
+
+class FaultClass(enum.Enum):
+    REQUEST = enum.auto()    # abort the culprit request only
+    TRANSIENT = enum.auto()  # roll back + retry the step
+    FATAL = enum.auto()      # terminal: engine goes DEAD
+
+
+#: Lowercased substrings marking transient device/RPC failures (the
+#: classes a retry can plausibly clear: runtime RPC deadlines,
+#: temporary unavailability, transient resource pressure).
+_TRANSIENT_MARKERS = (
+    "deadline_exceeded",
+    "deadline exceeded",
+    "unavailable",
+    "connection reset",
+    "temporarily",
+    "try again",
+)
+
+
+def classify_failure(exc: BaseException,
+                     default: FaultClass = FaultClass.FATAL
+                     ) -> FaultClass:
+    """Failure class of one exception; `default` applies when nothing
+    matches (step-level callers default to FATAL — an unknown failure
+    must fail fast, not loop — while per-request output processing
+    passes REQUEST, where the blast radius is one stream)."""
+    if isinstance(exc, InjectedFault):
+        return {
+            "transient": FaultClass.TRANSIENT,
+            "request": FaultClass.REQUEST,
+            "fatal": FaultClass.FATAL,
+        }[exc.kind]
+    if isinstance(exc, StepTimeoutError):
+        return FaultClass.FATAL
+    text = f"{type(exc).__name__}: {exc}".lower()
+    if any(marker in text for marker in _TRANSIENT_MARKERS):
+        return FaultClass.TRANSIENT
+    return default
+
+
+def retry_policy() -> tuple:
+    """(max_retries, base_backoff_s) from the flag registry, read per
+    step so operators can tune a live server via the environment."""
+    return (flags.get_int("APHRODITE_STEP_RETRIES"),
+            flags.get_float("APHRODITE_STEP_BACKOFF_S"))
+
+
+@dataclasses.dataclass
+class HealthReport:
+    """One /health snapshot (serialized verbatim by the endpoint)."""
+    state: str
+    last_step_age_s: Optional[float]
+    steps_completed: int
+    retries_total: int
+    recovered_steps: int
+    consecutive_failures: int
+    dead_reason: Optional[str] = None
+
+    def to_json(self) -> Dict[str, Any]:
+        body = dataclasses.asdict(self)
+        if self.last_step_age_s is not None:
+            body["last_step_age_s"] = round(self.last_step_age_s, 3)
+        return body
+
+
+class HealthMonitor:
+    """RUNNING/DEGRADED/DEAD state machine with a per-step heartbeat.
+
+    DEGRADED means "alive but limping": the loop is mid-retry
+    (consecutive failures > 0) or, with the watchdog enabled, the last
+    completed step is older than the step timeout while work is in
+    flight. DEAD is terminal — nothing un-deads an engine short of a
+    restart (the process may hold a wedged executor thread)."""
+
+    def __init__(self) -> None:
+        self._last_step_at: Optional[float] = None
+        self._steps_completed = 0
+        self._retries_total = 0
+        self._recovered_steps = 0
+        self._consecutive_failures = 0
+        self._dead_reason: Optional[str] = None
+
+    # -- transitions (called by the supervised loop) --
+
+    def beat(self) -> None:
+        """One step completed: stamp the monotonic heartbeat."""
+        self._last_step_at = time.monotonic()
+        self._steps_completed += 1
+        self._consecutive_failures = 0
+
+    def record_failure(self, exc: BaseException) -> None:
+        """A step attempt failed and will be retried."""
+        self._retries_total += 1
+        self._consecutive_failures += 1
+
+    def record_recovery(self) -> None:
+        """A retried step succeeded."""
+        self._recovered_steps += 1
+
+    def mark_dead(self, reason: BaseException | str) -> None:
+        if self._dead_reason is None:
+            self._dead_reason = (reason if isinstance(reason, str)
+                                 else f"{type(reason).__name__}: "
+                                      f"{reason}")
+
+    # -- queries --
+
+    @property
+    def is_dead(self) -> bool:
+        return self._dead_reason is not None
+
+    @property
+    def dead_reason(self) -> Optional[str]:
+        return self._dead_reason
+
+    @property
+    def retries_total(self) -> int:
+        return self._retries_total
+
+    @property
+    def recovered_steps(self) -> int:
+        return self._recovered_steps
+
+    def state(self, in_flight: bool = False) -> EngineState:
+        if self.is_dead:
+            return EngineState.DEAD
+        if self._consecutive_failures > 0:
+            return EngineState.DEGRADED
+        timeout = flags.get_float("APHRODITE_STEP_TIMEOUT_S")
+        if (timeout and in_flight and self._last_step_at is not None
+                and time.monotonic() - self._last_step_at > timeout):
+            # The watchdog only observes COMPLETED steps; a step that
+            # never returns shows up here as a stale heartbeat.
+            return EngineState.DEGRADED
+        return EngineState.RUNNING
+
+    def report(self, in_flight: bool = False) -> HealthReport:
+        age = None
+        if self._last_step_at is not None:
+            age = time.monotonic() - self._last_step_at
+        return HealthReport(
+            state=self.state(in_flight=in_flight).value,
+            last_step_age_s=age,
+            steps_completed=self._steps_completed,
+            retries_total=self._retries_total,
+            recovered_steps=self._recovered_steps,
+            consecutive_failures=self._consecutive_failures,
+            dead_reason=self._dead_reason,
+        )

@@ -18,7 +18,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 import jax
 
-from aphrodite_tpu.common import flags
+from aphrodite_tpu.common import faultinject, flags
 from aphrodite_tpu.common.config import (CacheConfig, DeviceConfig,
                                          ModelConfig, ParallelConfig,
                                          SchedulerConfig)
@@ -132,8 +132,9 @@ class TPUExecutor:
             in_use = stats.get("bytes_in_use", 0)
             if limit:
                 return int(limit - in_use)
-        except Exception:      # CPU backend / axon: no memory_stats
-            pass
+        except Exception as e:  # CPU backend / axon: no memory_stats
+            logger.debug("device memory_stats unavailable (%s); "
+                         "falling back to the per-kind HBM table", e)
         kind = getattr(dev, "device_kind", "").lower()
         for marker, total in self._HBM_BY_KIND:
             if marker in kind:
@@ -211,6 +212,10 @@ class TPUExecutor:
     def _pre_step(self, seq_group_metadata_list, blocks_to_swap_in,
                   blocks_to_swap_out) -> None:
         """Swaps + LoRA activation shared by single-step and burst."""
+        # Every execution path (single-step, burst, combined, pipelined
+        # prompt dispatch) funnels through here, so one injection point
+        # covers the whole device-round surface.
+        faultinject.fire("executor.execute_model")
         if blocks_to_swap_out:
             self.cache_engine.swap_out(blocks_to_swap_out)
         if blocks_to_swap_in:

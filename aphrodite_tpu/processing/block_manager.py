@@ -13,6 +13,7 @@ from __future__ import annotations
 import enum
 from typing import Dict, List, Optional, Set, Tuple
 
+from aphrodite_tpu.common import faultinject
 from aphrodite_tpu.common.block import (BlockTable, Device,
                                         PhysicalTokenBlock)
 from aphrodite_tpu.common.sequence import (Sequence, SequenceGroup,
@@ -117,6 +118,8 @@ class BlockSpaceManager:
         return AllocStatus.LATER
 
     def allocate(self, seq_group: SequenceGroup) -> None:
+        faultinject.fire("block_manager.allocate",
+                         detail=seq_group.request_id)
         # All waiting sequences in a group share one prompt, hence one
         # physical block table (forked on first divergent append).
         seq = seq_group.get_seqs(status=SequenceStatus.WAITING)[0]

@@ -26,7 +26,7 @@ import time
 from collections import deque
 from typing import Deque, Dict, Iterable, List, Optional, Tuple, Union
 
-from aphrodite_tpu.common import flags
+from aphrodite_tpu.common import faultinject, flags
 from aphrodite_tpu.common.config import (CacheConfig, LoRAConfig,
                                          SchedulerConfig)
 from aphrodite_tpu.common.logger import init_logger
@@ -142,6 +142,13 @@ class Scheduler:
         self.prefilling: Deque[SequenceGroup] = deque()
         self.running: Deque[SequenceGroup] = deque()
         self.swapped: Deque[SequenceGroup] = deque()
+        # Crash-barrier bookkeeping, reset per schedule() round: the
+        # groups swapped OUT this round (their host pages are garbage
+        # until the device copy actually runs) and the groups ignored
+        # this round (popped from `waiting` before their FINISHED_
+        # IGNORED outputs were delivered).
+        self._round_swapped_out: List[SequenceGroup] = []
+        self._round_ignored: List[SequenceGroup] = []
 
     @property
     def lora_enabled(self) -> bool:
@@ -264,6 +271,7 @@ class Scheduler:
                     "limit of %d", prompt_len, self.prompt_limit)
                 seqs[0].status = SequenceStatus.FINISHED_IGNORED
                 ignored.append(group)
+                self._round_ignored.append(group)
                 self.waiting.popleft()
                 continue
 
@@ -276,6 +284,7 @@ class Scheduler:
                     "the capacity of the block manager", prompt_len)
                 seqs[0].status = SequenceStatus.FINISHED_IGNORED
                 ignored.append(group)
+                self._round_ignored.append(group)
                 self.waiting.popleft()
                 continue
 
@@ -329,8 +338,11 @@ class Scheduler:
 
             if lora_int_id > 0:
                 curr_loras.add(lora_int_id)
-            self.waiting.popleft()
+            # Allocate BEFORE popping from `waiting`: if the allocator
+            # faults, the group is still queued and a crash-rolled-back
+            # retry re-admits it instead of losing the request.
             self._allocate(group)
+            self.waiting.popleft()
             num_curr_seqs += num_new_seqs
             seq = group.get_seqs(status=SequenceStatus.RUNNING)[0]
             chunks.append(PromptChunk(group, ctx, n, final))
@@ -390,7 +402,14 @@ class Scheduler:
         self
     ) -> Optional[Tuple[List[SequenceGroupMetadata], SchedulerOutputs]]:
         """Next batch-building round, or None outside that regime."""
-        outputs = self._schedule_batch_building()
+        try:
+            outputs = self._schedule_batch_building()
+        except Exception:
+            # Mid-schedule crash: partial admissions/chunk progress of
+            # unknown extent — conservatively roll back every in-flight
+            # group (idempotent; the engine-level barrier may run too).
+            self.crash_rollback(None)
+            raise
         if outputs is None:
             return None
         mds = [
@@ -568,15 +587,126 @@ class Scheduler:
 
     def schedule(
             self) -> Tuple[List[SequenceGroupMetadata], SchedulerOutputs]:
-        scheduler_outputs = self._schedule()
-        seq_group_metadata_list = [
-            self._group_metadata(c.group, is_prompt=True, chunk=c)
-            for c in scheduler_outputs.prompt_chunks
-        ] + [
-            self._group_metadata(g, is_prompt=False)
-            for g in scheduler_outputs.decode_groups
-        ]
-        return seq_group_metadata_list, scheduler_outputs
+        faultinject.fire("scheduler.schedule")
+        self._round_swapped_out = []
+        self._round_ignored = []
+        try:
+            scheduler_outputs = self._schedule()
+            seq_group_metadata_list = [
+                self._group_metadata(c.group, is_prompt=True, chunk=c)
+                for c in scheduler_outputs.prompt_chunks
+            ] + [
+                self._group_metadata(g, is_prompt=False)
+                for g in scheduler_outputs.decode_groups
+            ]
+            return seq_group_metadata_list, scheduler_outputs
+        except Exception:
+            # Mid-schedule crash: some admissions/slot appends/chunk
+            # advances may have landed, some not — conservatively roll
+            # back EVERY in-flight group so a retried schedule starts
+            # from a consistent queue + page state.
+            self.crash_rollback(None)
+            raise
+
+    # -- crash barrier ------------------------------------------------
+
+    def crash_rollback(self, rounds=None) -> List[str]:
+        """Roll back this round's scheduler/block-manager mutations
+        after a failed step, so a retried step neither leaks KV pages
+        nor double-schedules.
+
+        `rounds` is the list of SchedulerOutputs committed by the
+        failed engine step (several when the step pipelined builder
+        rounds); None means the failure happened MID-SCHEDULE and the
+        mutation extent is unknown, so every in-flight group rolls
+        back.
+
+        The rollback reuses preemption's RECOMPUTE machinery: a
+        single-sequence group drops its pages, resets its computed-
+        token count, and re-enters the waiting queue as a fresh prompt
+        (original + generated tokens) — re-prefilling reproduces its
+        KV exactly, and the failed round's sampled tokens were never
+        applied. Groups RECOMPUTE cannot restore (forked KV, or a
+        swap-out whose device copy never ran) are aborted; their
+        request ids are returned so the caller can propagate the
+        failure to exactly those streams. Idempotent: a group already
+        rolled back (its seq back to WAITING) is skipped."""
+        casualties: List[str] = []
+
+        def abort_group(group: SequenceGroup) -> None:
+            casualties.append(group.request_id)
+            for queue in (self.waiting, self.prefilling, self.running,
+                          self.swapped):
+                if group in queue:
+                    queue.remove(group)
+            for seq in group.get_seqs():
+                if seq.is_finished():
+                    continue
+                seq.status = SequenceStatus.FINISHED_ABORTED
+                self.free_seq(seq)
+
+        # Swapped OUT this round: their HBM pages are already freed
+        # but the device copy backing the host pages never executed.
+        for group in self._round_swapped_out:
+            if not group.is_finished():
+                abort_group(group)
+        self._round_swapped_out = []
+
+        if rounds is None:
+            groups = list(self.prefilling) + list(self.running)
+        else:
+            seen, groups = set(), []
+            for out in rounds:
+                for group in out.scheduled_seq_groups:
+                    if id(group) not in seen:
+                        seen.add(id(group))
+                        groups.append(group)
+
+        for group in groups:
+            if group.is_finished():
+                # Fully processed before the failure; just make sure it
+                # is off the queues (free_finished never ran).
+                for queue in (self.running, self.prefilling):
+                    if group in queue:
+                        queue.remove(group)
+                continue
+            unfinished = [s for s in group.get_seqs()
+                          if not s.is_finished()]
+            if len(unfinished) == 1 and \
+                    unfinished[0].status == SequenceStatus.WAITING:
+                continue        # already rolled back (nested barrier)
+            if len(unfinished) == 1 and \
+                    unfinished[0].status == SequenceStatus.RUNNING:
+                self._rollback_by_recompute(group, unfinished[0])
+            else:
+                abort_group(group)
+
+        # Re-queue this round's ignored groups so the retried round
+        # re-emits their FINISHED_IGNORED outputs (they were already
+        # popped from `waiting`; without this their streams hang).
+        for group in self._round_ignored:
+            requeued = False
+            for seq in group.get_seqs():
+                if seq.status == SequenceStatus.FINISHED_IGNORED:
+                    seq.status = SequenceStatus.WAITING
+                    requeued = True
+            if requeued:
+                self.waiting.appendleft(group)
+        self._round_ignored = []
+        return casualties
+
+    def _rollback_by_recompute(self, group: SequenceGroup,
+                               seq: Sequence) -> None:
+        """RECOMPUTE-style rollback of one single-sequence group (the
+        _preempt_by_recompute seam, applied by object instead of by
+        scheduling priority)."""
+        for queue in (self.running, self.prefilling):
+            if group in queue:
+                queue.remove(group)
+        seq.status = SequenceStatus.WAITING
+        self.block_manager.free(seq)
+        seq.data.num_computed_tokens = 0
+        self.waiting.appendleft(group)
 
     def reserve_decode_burst(self, seq_group_metadata_list,
                              max_extra: int, extra_cap=None,
@@ -711,6 +841,9 @@ class Scheduler:
                          blocks_to_swap_out: Dict[int, int]) -> None:
         self._swap_out(seq_group, blocks_to_swap_out)
         self.swapped.append(seq_group)
+        # Crash barrier: until the device executes this round's swap
+        # plan, the group's host pages are garbage (see crash_rollback).
+        self._round_swapped_out.append(seq_group)
 
     def _swap_in(self, seq_group: SequenceGroup,
                  blocks_to_swap_in: Dict[int, int]) -> None:

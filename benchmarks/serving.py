@@ -11,6 +11,18 @@ Usage:
     python benchmarks/serving.py --model <path-or-id> [--request-rate 4]
         [--num-requests 128] [--prompt-len 128] [--output-len 64]
 Prints one JSON line with the percentile table.
+
+Chaos mode (`--chaos`): injects faults via APHRODITE_FAULT
+(`--chaos-fault`, default a low-probability transient executor fault)
+and fires an abort storm (`--chaos-abort-rate` of requests aborted at
+a random point of their lifetime). The JSON gains a `chaos` section —
+recovered-step / retry counters from the engine health monitor,
+requests failed vs survived vs aborted, and the injected-fault tally —
+alongside the usual TTFT/throughput percentiles, so fault-tolerance
+overhead and degradation are measured with the same harness as the
+baseline. A `--chaos` run with `--chaos-fault none --chaos-abort-rate
+0` measures pure accounting overhead and must match baseline
+throughput within noise.
 """
 from __future__ import annotations
 
@@ -27,6 +39,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
+def logger_warn(msg: str, *fmt_args) -> None:
+    print("[serving] " + (msg % fmt_args if fmt_args else msg),
+          file=sys.stderr, flush=True)
+
+
 async def poisson_arrivals(n: int, rate: float, rng: np.random.RandomState):
     for i in range(n):
         yield i
@@ -36,9 +53,22 @@ async def poisson_arrivals(n: int, rate: float, rng: np.random.RandomState):
 
 
 async def run(args) -> dict:
+    from aphrodite_tpu.common import faultinject
     from aphrodite_tpu.common.sampling_params import SamplingParams
     from aphrodite_tpu.engine.args_tools import AsyncEngineArgs
     from aphrodite_tpu.engine.async_aphrodite import AsyncAphrodite
+
+    chaos = bool(getattr(args, "chaos", False))
+    chaos_fault = str(getattr(args, "chaos_fault", "") or "")
+    chaos_abort_rate = float(getattr(args, "chaos_abort_rate", 0.0)
+                             or 0.0)
+    if chaos and chaos_fault and chaos_fault != "none":
+        # Env WRITES are the sanctioned way for a harness to configure
+        # the (per-call-read) fault-injection flags.
+        os.environ["APHRODITE_FAULT"] = chaos_fault
+        os.environ["APHRODITE_FAULT_SEED"] = str(
+            getattr(args, "chaos_seed", 0) or 0)
+        faultinject.reset()
 
     engine = AsyncAphrodite.from_engine_args(AsyncEngineArgs(
         model=args.model, load_format=args.load_format,
@@ -53,23 +83,64 @@ async def run(args) -> dict:
         rng.randint(5, vocab - 5, size=args.prompt_len).tolist()
         for _ in range(args.num_requests)
     ]
+    # Deterministic abort plan: request index -> abort delay fraction.
+    abort_rng = np.random.RandomState(
+        int(getattr(args, "chaos_seed", 0) or 0) + 99)
+    abort_frac = {
+        i: float(abort_rng.uniform(0.05, 0.95))
+        for i in range(args.num_requests)
+        if chaos and abort_rng.uniform() < chaos_abort_rate
+    }
 
     ttfts, tpots, e2es = [], [], []
+    outcomes = {"survived": 0, "aborted": 0, "failed": 0}
 
-    async def one(i: int) -> None:
+    async def one(i: int, *, measured: bool = True) -> None:
         sp = SamplingParams(temperature=0.0, max_tokens=args.output_len,
                             ignore_eos=True)
+        rid = f"req-{i}" if measured else f"warm-req-{i}"
+        aborter = None
+        if measured and i in abort_frac:
+            async def fire_abort():
+                # Abort at a random point of the request's expected
+                # lifetime (prefill included: small fractions hit
+                # before the first token).
+                await asyncio.sleep(abort_frac[i] *
+                                    max(args.output_len * 0.05, 0.2))
+                try:
+                    await engine.abort(rid)
+                except Exception as e:
+                    logger_warn("abort %s failed: %s", rid, e)
+
+            aborter = asyncio.create_task(fire_abort())
         t0 = time.perf_counter()
         first = None
         final = None
-        async for out in engine.generate(
-                None, sp, f"req-{i}", prompt_token_ids=prompts[i]):
-            if first is None and out.outputs and \
-                    out.outputs[0].token_ids:
-                first = time.perf_counter()
-            final = out
+        try:
+            async for out in engine.generate(
+                    None, sp, rid, prompt_token_ids=prompts[i]):
+                if first is None and out.outputs and \
+                        out.outputs[0].token_ids:
+                    first = time.perf_counter()
+                final = out
+        except Exception as e:
+            if measured:
+                outcomes["failed"] += 1
+                logger_warn("request %s failed: %s: %s", rid,
+                            type(e).__name__, e)
+            return
+        finally:
+            if aborter is not None:
+                aborter.cancel()
         t1 = time.perf_counter()
-        n_out = len(final.outputs[0].token_ids)
+        n_out = len(final.outputs[0].token_ids) if final and \
+            final.outputs else 0
+        if not measured:
+            return
+        if n_out < args.output_len:
+            outcomes["aborted"] += 1
+            return                  # partial: excluded from latency
+        outcomes["survived"] += 1
         ttfts.append((first or t1) - t0)
         if n_out > 1:
             tpots.append((t1 - (first or t1)) / (n_out - 1))
@@ -132,6 +203,8 @@ async def run(args) -> dict:
         ttfts.clear()
         tpots.clear()
         e2es.clear()
+        for key in outcomes:
+            outcomes[key] = 0
 
     wall = await drive()
 
@@ -139,22 +212,40 @@ async def run(args) -> dict:
         # 0.0 (not None) for empty series: round() downstream.
         return float(np.percentile(np.asarray(xs), p)) if xs else 0.0
 
+    detail = {
+        "request_rate": args.request_rate,
+        "num_requests": args.num_requests,
+        "throughput_out_tok_s": round(
+            outcomes["survived"] * args.output_len / wall, 1),
+        "ttft_p50": round(pct(ttfts, 50), 4),
+        "ttft_p90": round(pct(ttfts, 90), 4),
+        "ttft_p99": round(pct(ttfts, 99), 4),
+        "tpot_p50": round(pct(tpots, 50), 5),
+        "e2e_p50": round(pct(e2es, 50), 4),
+        "e2e_p99": round(pct(e2es, 99), 4),
+    }
+    if chaos:
+        health = engine.health.report(
+            in_flight=engine.engine.has_unfinished_requests())
+        detail["chaos"] = {
+            "fault_spec": chaos_fault or "none",
+            "abort_rate": chaos_abort_rate,
+            "requests_survived": outcomes["survived"],
+            "requests_aborted": outcomes["aborted"],
+            "requests_failed": outcomes["failed"],
+            "steps_retried": health.retries_total,
+            "steps_recovered": health.recovered_steps,
+            "engine_state": health.state,
+            "faults_fired": faultinject.stats(),
+            # Degradation headline: p99 TTFT under chaos rides in the
+            # shared ttft_p99 field above; survivors only.
+            "degraded_ttft_p99": detail["ttft_p99"],
+        }
     return {
         "metric": "serving_p50_ttft_s",
         "value": round(pct(ttfts, 50), 4),
         "unit": "s",
-        "detail": {
-            "request_rate": args.request_rate,
-            "num_requests": args.num_requests,
-            "throughput_out_tok_s": round(
-                args.num_requests * args.output_len / wall, 1),
-            "ttft_p50": round(pct(ttfts, 50), 4),
-            "ttft_p90": round(pct(ttfts, 90), 4),
-            "ttft_p99": round(pct(ttfts, 99), 4),
-            "tpot_p50": round(pct(tpots, 50), 5),
-            "e2e_p50": round(pct(e2es, 50), 4),
-            "e2e_p99": round(pct(e2es, 99), 4),
-        },
+        "detail": detail,
     }
 
 
@@ -199,6 +290,19 @@ def main() -> None:
     parser.add_argument("--warmup", type=int, default=1,
                         help="run the workload once first to absorb "
                              "shape-bucket compiles (0 to disable)")
+    parser.add_argument("--chaos", action="store_true",
+                        help="chaos mode: inject faults + abort storm "
+                             "and report fault-tolerance counters")
+    parser.add_argument("--chaos-fault",
+                        default="executor.execute_model:transient"
+                                ":0.02:4",
+                        help="APHRODITE_FAULT spec to inject "
+                             "('none' = abort storm only)")
+    parser.add_argument("--chaos-abort-rate", type=float, default=0.15,
+                        help="fraction of requests aborted at a random "
+                             "point of their lifetime")
+    parser.add_argument("--chaos-seed", type=int, default=0,
+                        help="seed for the fault RNG and abort plan")
     args = parser.parse_args()
     if args.model == "synthetic-7b":
         args.model = synthetic_7b_dir()

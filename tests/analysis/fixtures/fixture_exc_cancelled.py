@@ -1,0 +1,18 @@
+"""Seeded EXC002 violation: asyncio.CancelledError caught and
+discarded (exactly one; the re-raising handler must stay quiet, and
+EXC001 must not fire — no broad Exception handler swallows here)."""
+import asyncio
+
+
+async def drain(task):
+    try:
+        await task
+    except asyncio.CancelledError:    # EXC002: cancellation discarded
+        return None
+
+
+async def drain_propagating(task):
+    try:
+        await task
+    except asyncio.CancelledError:    # clean: cancellation re-raised
+        raise

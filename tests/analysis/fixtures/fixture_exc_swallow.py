@@ -1,0 +1,36 @@
+"""Seeded EXC001 violation: a broad except that swallows a hot-path
+failure without logging or re-raising (exactly one; the logged and
+re-raising handlers around it must stay quiet, and EXC002 must not
+fire — nothing here touches CancelledError)."""
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def execute_round(runner):
+    try:
+        return runner.go()
+    except Exception:             # EXC001: swallowed silently
+        return None
+
+
+def execute_round_logged(runner):
+    try:
+        return runner.go()
+    except Exception as exc:      # clean: the failure is logged
+        logger.warning("round failed: %s", exc)
+        return None
+
+
+def execute_round_reraised(runner):
+    try:
+        return runner.go()
+    except Exception:             # clean: re-raised for the supervisor
+        raise
+
+
+def execute_round_narrow(runner):
+    try:
+        return runner.go()
+    except ValueError:            # clean: narrow handlers are policy
+        return None

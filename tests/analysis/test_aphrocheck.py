@@ -31,9 +31,9 @@ import pytest
 from tools.aphrocheck import DEFAULT_ALLOWLIST, build_context, run
 from tools.aphrocheck.core import (FLAGS_MODULE, REPO_ROOT, Allowlist,
                                    collect_files)
-from tools.aphrocheck.passes import (dma_pass, flag_pass, grid_pass,
-                                     recomp_pass, ref_pass, shard_pass,
-                                     sync_pass, vmem_pass)
+from tools.aphrocheck.passes import (dma_pass, exc_pass, flag_pass,
+                                     grid_pass, recomp_pass, ref_pass,
+                                     shard_pass, sync_pass, vmem_pass)
 from tools.aphrocheck.registry import parse_registry
 
 FIXDIR = os.path.join("tests", "analysis", "fixtures")
@@ -157,6 +157,8 @@ def test_scan_covers_benches():
     (recomp_pass.run, "fixture_recomp_if.py", "RECOMP001"),
     (recomp_pass.run, "fixture_recomp_shape.py", "RECOMP002"),
     (recomp_pass.run, "fixture_recomp_fstring.py", "RECOMP003"),
+    (exc_pass.run, "fixture_exc_swallow.py", "EXC001"),
+    (exc_pass.run, "fixture_exc_cancelled.py", "EXC002"),
 ])
 def test_rule_fires_exactly_once(pass_fn, fixture, rule):
     findings = _pass_findings(pass_fn, [_fixture(fixture)])
@@ -230,6 +232,31 @@ def test_seeded_ref_fixtures_fire_only_their_rule():
         findings = _pass_findings(ref_pass.run, [_fixture(fixture)])
         assert [f.rule for f in findings] == [rule], \
             f"{fixture}: {[f.render() for f in findings]}"
+
+
+def test_exc_fixtures_fire_only_their_rule():
+    """The EXC fixtures each seed exactly their one rule: the swallow
+    fixture must not trip EXC002 (no CancelledError there) and the
+    cancelled fixture must not trip EXC001 (no broad handler), with
+    the clean logged/re-raising handlers quiet on both."""
+    s = _pass_findings(exc_pass.run, [_fixture("fixture_exc_swallow.py")])
+    assert [f.rule for f in s] == ["EXC001"], [f.render() for f in s]
+    c = _pass_findings(exc_pass.run,
+                       [_fixture("fixture_exc_cancelled.py")])
+    assert [f.rule for f in c] == ["EXC002"], [f.render() for f in c]
+
+
+def test_exc001_scope_exempts_endpoints():
+    """EXC001 is a hot-path rule: a swallowing broad handler in
+    endpoints/ (HTTP error mapping) must stay quiet, while the same
+    AST in engine/ would fire (the real tree is clean, so scope is
+    proven on the exempt side here and by the gate on the hot side)."""
+    findings = _pass_findings(
+        exc_pass.run,
+        ["aphrodite_tpu/endpoints/openai/api_server.py",
+         "aphrodite_tpu/endpoints/kobold/api_server.py"])
+    assert not [f for f in findings if f.rule == "EXC001"], \
+        [f.render() for f in findings]
 
 
 def test_shard_fixtures_stay_precise():
@@ -376,7 +403,7 @@ def test_cli_rules_md_and_readme_drift():
     table = proc.stdout.strip()
     for rule in ("FLAG001", "FLAG006", "VMEM001", "DMA003", "GRID002",
                  "SYNC003", "REF001", "REF004", "SHARD003",
-                 "RECOMP003"):
+                 "RECOMP003", "EXC001", "EXC002"):
         assert f"| {rule} |" in table, f"{rule} missing from rules-md"
     with open(os.path.join(REPO_ROOT, "README.md"),
               encoding="utf-8") as f:

@@ -51,7 +51,54 @@ def test_health(server_ctx):
         assert r.status == 200, await r.text()
         r = await client.get("/health")
         assert r.status == 200
+        body = await r.json()
+        assert body["state"] == "RUNNING"
+        assert body["steps_completed"] >= 1
+        assert body["last_step_age_s"] >= 0
+        assert body["consecutive_failures"] == 0
+        assert body["dead_reason"] is None
     run(server_ctx, go)
+
+
+def test_health_reports_dead_after_fatal_fault(tiny_model_dir,
+                                               monkeypatch):
+    """An unrecoverable injected fault must flip /health to 503/DEAD
+    (load balancers eject the replica) while requests fail fast."""
+    from aphrodite_tpu.common import faultinject
+    monkeypatch.setenv("APHRODITE_FAULT",
+                       "executor.execute_model:fatal:1:1")
+    faultinject.reset()
+
+    async def go():
+        engine = AsyncAphrodite.from_engine_args(AsyncEngineArgs(
+            model=tiny_model_dir, load_format="dummy", dtype="float32",
+            max_model_len=256, max_num_seqs=4, swap_space=0.01,
+            disable_log_stats=True, disable_log_requests=True))
+        client = TestClient(TestServer(build_app(engine, MODEL_KEY)))
+        await client.start_server()
+        try:
+            r = await client.post("/v1/completions", json={
+                "model": MODEL_KEY, "prompt": "hi", "max_tokens": 2,
+                "ignore_eos": True})
+            assert r.status >= 500   # the engine died mid-request
+            r = await client.get("/health")
+            assert r.status == 503
+            body = await r.json()
+            assert body["state"] == "DEAD"
+            assert "fatal" in body["error"] or \
+                "fatal" in (body["dead_reason"] or "")
+            # Subsequent requests fail fast, not hang.
+            r = await asyncio.wait_for(
+                client.post("/v1/completions", json={
+                    "model": MODEL_KEY, "prompt": "hi",
+                    "max_tokens": 2, "ignore_eos": True}),
+                timeout=10)
+            assert r.status >= 500
+        finally:
+            await client.close()
+            faultinject.reset()
+
+    asyncio.run(go())
 
 
 def test_models(server_ctx):

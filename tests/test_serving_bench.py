@@ -5,18 +5,76 @@ import asyncio
 import sys
 
 
-def test_serving_harness(tiny_model_dir):
-    sys.path.insert(0, "benchmarks")
-    from serving import run
-
-    args = argparse.Namespace(
+def _args(tiny_model_dir, **kw):
+    defaults = dict(
         model=tiny_model_dir, load_format="dummy", dtype="float32",
         quantization=None, kv_cache_dtype="auto", max_num_seqs=4,
         max_model_len=256, multi_step=4, request_rate=float("inf"),
         num_requests=6, prompt_len=12, output_len=5, warmup=0)
-    result = asyncio.run(run(args))
+    defaults.update(kw)
+    return argparse.Namespace(**defaults)
+
+
+def test_serving_harness(tiny_model_dir):
+    sys.path.insert(0, "benchmarks")
+    from serving import run
+
+    result = asyncio.run(run(_args(tiny_model_dir)))
     assert result["metric"] == "serving_p50_ttft_s"
     d = result["detail"]
     assert d["ttft_p50"] > 0 and d["ttft_p99"] >= d["ttft_p50"]
     assert d["e2e_p50"] >= d["ttft_p50"]
     assert d["throughput_out_tok_s"] > 0
+    assert "chaos" not in d
+
+
+def test_serving_harness_chaos_mode(tiny_model_dir, monkeypatch):
+    """--chaos JSON artifact: injected transient faults are retried
+    (requests still survive), the abort storm is accounted, and the
+    chaos counters ride alongside the usual percentiles."""
+    sys.path.insert(0, "benchmarks")
+    from serving import run
+    from aphrodite_tpu.common import faultinject
+
+    monkeypatch.delenv("APHRODITE_FAULT", raising=False)
+    faultinject.reset()
+    try:
+        result = asyncio.run(run(_args(
+            tiny_model_dir, num_requests=8, chaos=True,
+            chaos_fault="executor.execute_model:transient:1:2",
+            chaos_abort_rate=0.3, chaos_seed=3)))
+    finally:
+        monkeypatch.delenv("APHRODITE_FAULT", raising=False)
+        faultinject.reset()
+    c = result["detail"]["chaos"]
+    assert c["engine_state"] == "RUNNING"
+    assert c["steps_recovered"] >= 1
+    assert c["steps_retried"] >= 2
+    assert c["faults_fired"] == {
+        "executor.execute_model:transient": 2}
+    assert c["requests_survived"] >= 1
+    assert (c["requests_survived"] + c["requests_aborted"]
+            + c["requests_failed"]) == 8
+    assert c["degraded_ttft_p99"] >= 0
+
+
+def test_serving_harness_chaos_fault_free_matches_baseline(
+        tiny_model_dir, monkeypatch):
+    """A fault-free --chaos run (no spec, no aborts) must report every
+    request survived — pure accounting, no semantic drift."""
+    sys.path.insert(0, "benchmarks")
+    from serving import run
+    from aphrodite_tpu.common import faultinject
+
+    monkeypatch.delenv("APHRODITE_FAULT", raising=False)
+    faultinject.reset()
+    result = asyncio.run(run(_args(
+        tiny_model_dir, chaos=True, chaos_fault="none",
+        chaos_abort_rate=0.0)))
+    c = result["detail"]["chaos"]
+    assert c["fault_spec"] == "none"
+    assert c["requests_survived"] == 6
+    assert c["requests_aborted"] == c["requests_failed"] == 0
+    assert c["steps_retried"] == 0
+    assert c["faults_fired"] == {}
+    assert result["detail"]["throughput_out_tok_s"] > 0

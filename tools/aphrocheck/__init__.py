@@ -20,6 +20,11 @@ Rule families (see each pass module's docstring for the contract):
   RECOMP001-003  jit recompile hazards: traced-value branching,
                  unbucketed shapes into jitted callees, trace-time
                  formatting
+  EXC001-002     exception-handling hygiene on the supervised step
+                 path: broad excepts that swallow without logging or
+                 re-raising in engine//executor//processing hot
+                 paths, and except clauses that discard
+                 asyncio.CancelledError
 
 Name resolution is interprocedural: a same-package call graph
 (core.CallGraph) lets helper parameters resolve through their call
@@ -45,7 +50,7 @@ DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(
     os.path.abspath(__file__)), "allowlist.json")
 
 _RULE_ORDER = ("PARSE", "FLAG", "VMEM", "DMA", "GRID", "SYNC", "REF",
-               "SHARD", "RECOMP")
+               "SHARD", "RECOMP", "EXC")
 
 
 @dataclasses.dataclass

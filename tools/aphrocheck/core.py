@@ -481,25 +481,26 @@ class IntervalEvaluator:
         self._stack: List[str] = []    # recursion guard
 
     def _collect_mutated(self) -> set:
-        key = id(self.scope) if self.scope is not None else 0
-        cached = self.module._mutated_idx.get(key)
+        # One module-wide walk, cached per module: every scope is a
+        # subtree of module.tree, so the module walk already covers it
+        # (re-walking per evaluator dominated the 2 s runtime budget).
+        cached = self.module._mutated_idx.get(0)
         if cached is not None:
             return cached
         bad = set()
-        for root in filter(None, [self.scope, self.module.tree]):
-            for node in ast.walk(root):
-                if isinstance(node, ast.AugAssign) and \
-                        isinstance(node.target, ast.Name):
-                    bad.add(node.target.id)
-                elif isinstance(node, (ast.For, ast.While)):
-                    for inner in ast.walk(node):
-                        if isinstance(inner, (ast.Assign, ast.AugAssign)):
-                            tgts = inner.targets if isinstance(
-                                inner, ast.Assign) else [inner.target]
-                            for t in tgts:
-                                if isinstance(t, ast.Name):
-                                    bad.add(t.id)
-        self.module._mutated_idx[key] = bad
+        for node in ast.walk(self.module.tree):
+            if isinstance(node, ast.AugAssign) and \
+                    isinstance(node.target, ast.Name):
+                bad.add(node.target.id)
+            elif isinstance(node, (ast.For, ast.While)):
+                for inner in ast.walk(node):
+                    if isinstance(inner, (ast.Assign, ast.AugAssign)):
+                        tgts = inner.targets if isinstance(
+                            inner, ast.Assign) else [inner.target]
+                        for t in tgts:
+                            if isinstance(t, ast.Name):
+                                bad.add(t.id)
+        self.module._mutated_idx[0] = bad
         return bad
 
     def eval(self, node: ast.AST,

@@ -13,10 +13,13 @@ Rule ID families:
 - REF001..REF004       — in-kernel ref bounds/dtype abstract interpretation
 - SHARD001..SHARD003   — PartitionSpec/mesh consistency, deprecated imports
 - RECOMP001..RECOMP003 — jit recompile/trace-time hazards
+- EXC001..EXC002       — exception-handling hygiene on the supervised
+                         step path (silent swallows, discarded
+                         CancelledError)
 """
-from tools.aphrocheck.passes import (dma_pass, flag_pass, grid_pass,
-                                     recomp_pass, ref_pass, shard_pass,
-                                     sync_pass, vmem_pass)
+from tools.aphrocheck.passes import (dma_pass, exc_pass, flag_pass,
+                                     grid_pass, recomp_pass, ref_pass,
+                                     shard_pass, sync_pass, vmem_pass)
 
 ALL_PASSES = (
     ("FLAG", flag_pass.run),
@@ -27,4 +30,5 @@ ALL_PASSES = (
     ("REF", ref_pass.run),
     ("SHARD", shard_pass.run),
     ("RECOMP", recomp_pass.run),
+    ("EXC", exc_pass.run),
 )

@@ -208,6 +208,9 @@ class _Kernel:
 
 
 def _top_level_kernel_fns(module: Module) -> List[ast.AST]:
+    cached = getattr(module, "_dma_kernel_fns", None)
+    if cached is not None:
+        return cached
     out = []
     for node in module.tree.body:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -221,6 +224,7 @@ def _top_level_kernel_fns(module: Module) -> List[ast.AST]:
                         any(tail_name(c.func) == "make_async_copy"
                             for c in iter_calls(meth)):
                     out.append(meth)
+    module._dma_kernel_fns = out
     return out
 
 

@@ -1,0 +1,118 @@
+"""EXC pass: exception-handling hygiene on the engine's supervised
+step path.
+
+The supervision layer (engine/supervisor.py) only works if failures
+actually REACH it: a broad handler that silently swallows turns a
+classifiable fault into a wrong answer, and a discarded
+CancelledError breaks asyncio's cancellation contract (aborted
+requests stop cancelling cleanly).
+
+- EXC001: a broad handler (`except Exception`, `except BaseException`,
+  or a bare `except`) whose body neither re-raises nor logs, in the
+  engine/executor/processing hot paths. Logging counts as any
+  `logger.*` / `logging.*` / `warnings.warn` call; `raise` anywhere in
+  the handler counts as re-raising. Scope: modules under
+  `aphrodite_tpu/engine/`, `aphrodite_tpu/executor/`,
+  `aphrodite_tpu/processing/` — plus any explicitly-passed module
+  outside the scanned roots (that is how the seeded fixtures are
+  checked). Endpoints/modeling/bench modules are exempt: their
+  handlers answer HTTP requests or probe optional deps, not drive the
+  step loop.
+- EXC002: an `except` clause that catches `asyncio.CancelledError`
+  (named directly, or via `BaseException`) and discards it — no
+  `raise` in the handler body. Cancellation must propagate; swallowing
+  it leaves aborted requests running and `asyncio.wait_for` hanging.
+  Applies module-wide across every scanned file (async correctness is
+  not path-local). Bare `except` is EXC001's finding (in scope) and
+  intentionally not double-reported here.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.aphrocheck.core import Finding, Module, dotted_name, iter_calls
+
+#: EXC001 scope: the supervised step surface.
+_HOT_PREFIXES = ("aphrodite_tpu/engine/", "aphrodite_tpu/executor/",
+                 "aphrodite_tpu/processing/")
+
+#: Everything the CLI normally scans; explicitly-passed files outside
+#: these roots (the seeded fixtures) are treated as hot-path scope.
+_SCAN_PREFIXES = ("aphrodite_tpu/", "benchmarks/", "bench.py")
+
+
+def _exc001_in_scope(rel: str) -> bool:
+    rel = rel.replace("\\", "/")
+    if any(rel.startswith(p) for p in _HOT_PREFIXES):
+        return True
+    return not any(rel == p.rstrip("/") or rel.startswith(p)
+                   for p in _SCAN_PREFIXES)
+
+
+def _type_names(node) -> List[str]:
+    """Tail names of the exception types one handler catches
+    ([''] marks a bare except)."""
+    if node is None:
+        return [""]
+    nodes = node.elts if isinstance(node, ast.Tuple) else [node]
+    out = []
+    for n in nodes:
+        name = dotted_name(n)
+        if name:
+            out.append(name.rsplit(".", 1)[-1])
+    return out
+
+
+def _handler_reraises(handler: ast.ExceptHandler) -> bool:
+    return any(isinstance(n, ast.Raise) for n in ast.walk(handler))
+
+
+def _handler_logs(handler: ast.ExceptHandler) -> bool:
+    for call in iter_calls(handler):
+        name = dotted_name(call.func) or ""
+        head = name.split(".", 1)[0]
+        if head in ("logger", "logging", "warnings", "log"):
+            return True
+    return False
+
+
+def run(ctx) -> List[Finding]:
+    findings: List[Finding] = []
+    for module in ctx.modules:
+        exc001_scope = _exc001_in_scope(module.rel)
+        for node in module.nodes:
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            caught = _type_names(node.type)
+            broad = any(n in ("", "Exception", "BaseException")
+                        for n in caught)
+            reraises = _handler_reraises(node)
+            if exc001_scope and broad and not reraises and \
+                    not _handler_logs(node):
+                findings.append(module.finding(
+                    "EXC001", node,
+                    "broad except swallows silently in a hot path; "
+                    "log the failure or re-raise so the supervision "
+                    "layer can classify it"))
+            swallows_cancel = any(n in ("CancelledError", "BaseException")
+                                  for n in caught)
+            if swallows_cancel and not reraises:
+                findings.append(module.finding(
+                    "EXC002", node,
+                    "except clause catches and discards asyncio."
+                    "CancelledError; cancellation must propagate "
+                    "(re-raise it) or aborted requests keep running"))
+    return findings
+
+
+#: (rule, one-line contract, example) — rendered by `--rules-md`.
+RULES = (
+    ("EXC001", "broad `except Exception`/bare except that neither "
+     "logs nor re-raises in the `engine/`/`executor/`/`processing/` "
+     "hot paths",
+     "`except Exception: return None` in a step-path helper"),
+    ("EXC002", "`except` clause catching `asyncio.CancelledError` "
+     "(or `BaseException`) without re-raising",
+     "`except asyncio.CancelledError: pass`"),
+)
