@@ -341,3 +341,42 @@ _register(Flag(
     "Seed of the deterministic per-rule RNG behind APHRODITE_FAULT "
     "probability draws; one (spec, seed) pair replays the exact same "
     "fault schedule."))
+
+_register(Flag(
+    "APHRODITE_DEFAULT_TTFT_SLO_S", "float", 0,
+    "Default per-request TTFT deadline (seconds) for requests that "
+    "carry no explicit `ttft_slo_s`; drives deadline-aware admission "
+    "shedding and waiting-queue expiry. 0 = no default deadline.",
+    minimum=0))
+
+_register(Flag(
+    "APHRODITE_MAX_QUEUE_DEPTH", "int", 0,
+    "Admission cap on the scheduler waiting-queue depth; arrivals "
+    "past it are shed with HTTP 429 + Retry-After instead of "
+    "queueing to death. 0 = derived (16 x max_num_seqs).",
+    minimum=0))
+
+_register(Flag(
+    "APHRODITE_MAX_WAITING_TOKENS", "int", 0,
+    "Admission cap on queued prefill tokens across the waiting "
+    "queue; arrivals that would exceed it are shed with HTTP 429 + "
+    "Retry-After. 0 = derived (8 x max_num_batched_tokens).",
+    minimum=0))
+
+_register(Flag(
+    "APHRODITE_PAGE_LOW_WATERMARK", "float", 0,
+    "Free-page low watermark as a fraction of the KV pool: prompt "
+    "admission additionally reserves this many pages PLUS one page "
+    "per running sequence, so admitting a prompt can never "
+    "immediately force a preemption of a running group. 0 disables "
+    "the reserve (the allocator's 1% hysteresis still applies).",
+    minimum=0))
+
+_register(Flag(
+    "APHRODITE_PREEMPT_BUDGET", "int", 4,
+    "Max RECOMPUTE/SWAP preemptions per scheduling round; decode "
+    "rows that still lack a free page past the budget skip the round "
+    "holding their pages instead of cascading evictions (every "
+    "preempted group re-prefills from scratch — an undamped storm "
+    "collapses goodput under page pressure).",
+    minimum=1))

@@ -73,6 +73,11 @@ class SamplingParams:
     spaces_between_special_tokens: bool = True
     logits_processors: Optional[List[LogitsProcessorFunc]] = None
     seed: Optional[int] = None
+    # TTFT service-level objective in seconds (None = the
+    # APHRODITE_DEFAULT_TTFT_SLO_S default): admission sheds requests
+    # whose predicted TTFT already exceeds it, and the scheduler
+    # expires deadline-missed requests still sitting in `waiting`.
+    ttft_slo_s: Optional[float] = None
 
     def __post_init__(self) -> None:
         if self.best_of is None:
@@ -168,6 +173,9 @@ class SamplingParams:
         if self.prompt_logprobs is not None and self.prompt_logprobs < 0:
             raise ValueError("prompt_logprobs must be non-negative, got "
                              f"{self.prompt_logprobs}.")
+        if self.ttft_slo_s is not None and self.ttft_slo_s <= 0:
+            raise ValueError(
+                f"ttft_slo_s must be positive, got {self.ttft_slo_s}.")
 
     def _verify_beam_search(self) -> None:
         if self.best_of == 1:

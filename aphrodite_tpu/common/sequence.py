@@ -206,6 +206,7 @@ class SequenceGroup:
         arrival_time: float,
         prefix: Optional[Prefix] = None,
         lora_request=None,
+        deadline: Optional[float] = None,
     ) -> None:
         self.request_id = request_id
         self.seqs_dict = {seq.seq_id: seq for seq in seqs}
@@ -213,6 +214,10 @@ class SequenceGroup:
         self.arrival_time = arrival_time
         self.prefix = prefix
         self.lora_request = lora_request
+        # Absolute TTFT deadline (monotonic clock, arrival + SLO):
+        # the scheduler expires the group if it is still waiting,
+        # never computed, past this instant. None = no deadline.
+        self.deadline = deadline
         self.prompt_logprobs: Optional[PromptLogprobs] = None
         # Latency stamps (reference RequestMetrics): written by the
         # engine as tokens arrive, drained by _get_stats.

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 from typing import List, Optional, Tuple
 
 from aiohttp import web
@@ -19,13 +20,24 @@ from aphrodite_tpu.common.logger import init_logger
 from aphrodite_tpu.common.sampling_params import SamplingParams
 from aphrodite_tpu.common.utils import random_uuid
 from aphrodite_tpu.endpoints.kobold.protocol import KAIGenerationInputSchema
+from aphrodite_tpu.endpoints.utils import request_disconnected
 from aphrodite_tpu.engine.args_tools import AsyncEngineArgs
 from aphrodite_tpu.engine.async_aphrodite import AsyncAphrodite
+from aphrodite_tpu.processing.admission import (RequestRejectedError,
+                                                RequestTimeoutError)
 
 logger = init_logger(__name__)
 
 _SAMPLING_EPS = 1e-5
 KAI_VERSION = "1.2.4"          # KoboldAI United API version we speak
+
+
+def _overloaded(e: RequestRejectedError) -> web.Response:
+    """HTTP 429 + Retry-After for an admission-shed request."""
+    return web.json_response(
+        {"detail": str(e)}, status=429,
+        headers={"Retry-After": str(max(1, int(math.ceil(
+            e.retry_after_s))))})
 
 
 def _set_badwords(tokenizer, hf_config) -> List[int]:
@@ -155,8 +167,17 @@ class KoboldServer:
             async for res in self.engine.generate(None, sampling_params,
                                                   payload.genkey,
                                                   input_tokens):
+                if await request_disconnected(request):
+                    # Client hung up: free its KV pages within one
+                    # step instead of waiting on GC.
+                    await self.engine.abort(payload.genkey)
+                    return web.json_response({"results": [{"text": ""}]})
                 final = res
                 self.gen_cache[payload.genkey] = res.outputs[0].text
+        except RequestRejectedError as e:
+            return _overloaded(e)
+        except RequestTimeoutError as e:
+            return web.json_response({"detail": str(e)}, status=408)
         finally:
             # Cancellation/abort must not leak the polling cache entry.
             self.gen_cache.pop(payload.genkey, None)
@@ -176,6 +197,13 @@ class KoboldServer:
         except (ValidationError, ValueError) as e:
             return web.json_response({"detail": str(e)}, status=422)
 
+        # Admit before the SSE prelude so sheds are real 429s.
+        try:
+            stream = await self.engine.add_request(
+                payload.genkey, None, sampling_params,
+                prompt_token_ids=input_tokens)
+        except RequestRejectedError as e:
+            return _overloaded(e)
         response = web.StreamResponse(headers={
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
@@ -183,14 +211,23 @@ class KoboldServer:
         })
         await response.prepare(request)
         previous_output = ""
-        async for res in self.engine.generate(None, sampling_params,
-                                              payload.genkey,
-                                              input_tokens):
-            new_chunk = res.outputs[0].text[len(previous_output):]
-            previous_output = res.outputs[0].text
-            await response.write(b"event: message\n")
+        try:
+            async for res in stream:
+                if await request_disconnected(request):
+                    stream.cancel()
+                    return response
+                new_chunk = res.outputs[0].text[len(previous_output):]
+                previous_output = res.outputs[0].text
+                await response.write(b"event: message\n")
+                await response.write(
+                    f"data: "
+                    f"{json.dumps({'token': new_chunk})}\n\n".encode())
+        except RequestTimeoutError as e:
             await response.write(
-                f"data: {json.dumps({'token': new_chunk})}\n\n".encode())
+                f"data: {json.dumps({'error': str(e)})}\n\n".encode())
+        except BaseException:
+            stream.cancel()
+            raise
         await response.write_eof()
         return response
 

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 from dataclasses import fields as dataclass_fields
 
 from aiohttp import web
@@ -20,6 +21,8 @@ from aphrodite_tpu.common.utils import random_uuid
 from aphrodite_tpu.endpoints.utils import request_disconnected
 from aphrodite_tpu.engine.args_tools import AsyncEngineArgs
 from aphrodite_tpu.engine.async_aphrodite import AsyncAphrodite
+from aphrodite_tpu.processing.admission import (RequestRejectedError,
+                                                RequestTimeoutError)
 
 logger = init_logger(__name__)
 
@@ -78,25 +81,53 @@ class OobaServer:
             return web.json_response({"detail": str(err)}, status=422)
 
         request_id = random_uuid()
-        gen = self.engine.generate(prompt, sampling_params, request_id)
 
         if stream:
+            # Admit before streaming starts so sheds are real 429s.
+            try:
+                out_stream = await self.engine.add_request(
+                    request_id, prompt, sampling_params)
+            except RequestRejectedError as e:
+                return web.json_response(
+                    {"detail": str(e)}, status=429,
+                    headers={"Retry-After": str(max(1, int(math.ceil(
+                        e.retry_after_s))))})
             response = web.StreamResponse()
             await response.prepare(request)
-            async for request_output in gen:
-                ret = {"results": [{"text": out.text}
-                                   for out in request_output.outputs]}
+            try:
+                async for request_output in out_stream:
+                    if await request_disconnected(request):
+                        out_stream.cancel()
+                        return response
+                    ret = {"results": [{"text": out.text}
+                                       for out in
+                                       request_output.outputs]}
+                    await response.write(
+                        (json.dumps(ret) + "\n\n").encode())
+            except RequestTimeoutError as e:
                 await response.write(
-                    (json.dumps(ret) + "\n\n").encode())
+                    (json.dumps({"detail": str(e)}) + "\n\n").encode())
+            except BaseException:
+                out_stream.cancel()
+                raise
             await response.write_eof()
             return response
 
         final = None
-        async for request_output in gen:
-            if await request_disconnected(request):
-                await self.engine.abort(request_id)
-                return web.Response(status=499)
-            final = request_output
+        try:
+            async for request_output in self.engine.generate(
+                    prompt, sampling_params, request_id):
+                if await request_disconnected(request):
+                    await self.engine.abort(request_id)
+                    return web.Response(status=499)
+                final = request_output
+        except RequestRejectedError as e:
+            return web.json_response(
+                {"detail": str(e)}, status=429,
+                headers={"Retry-After": str(max(1, int(math.ceil(
+                    e.retry_after_s))))})
+        except RequestTimeoutError as e:
+            return web.json_response({"detail": str(e)}, status=408)
         assert final is not None
         return web.json_response(
             {"results": [{"text": out.text} for out in final.outputs]})

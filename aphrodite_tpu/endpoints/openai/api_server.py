@@ -14,6 +14,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import math
 from typing import AsyncIterator, List, Optional
 
 from aiohttp import web
@@ -35,6 +36,8 @@ from aphrodite_tpu.endpoints.openai.protocol import (
 from aphrodite_tpu.endpoints.utils import request_disconnected
 from aphrodite_tpu.engine.args_tools import AsyncEngineArgs
 from aphrodite_tpu.engine.async_aphrodite import AsyncAphrodite
+from aphrodite_tpu.processing.admission import (RequestRejectedError,
+                                                RequestTimeoutError)
 
 logger = init_logger(__name__)
 
@@ -45,6 +48,24 @@ def _error(message: str, err_type: str = "invalid_request_error",
            status: int = 400) -> web.Response:
     body = ErrorResponse(message=message, type=err_type).model_dump()
     return web.json_response(body, status=status)
+
+
+def _overloaded(e: RequestRejectedError) -> web.Response:
+    """HTTP 429 for an admission-shed request, with the controller's
+    Retry-After estimate (whole seconds, at least 1)."""
+    body = ErrorResponse(message=str(e), type="overloaded_error",
+                         code="429").model_dump()
+    retry_after = max(1, int(math.ceil(e.retry_after_s)))
+    return web.json_response(body, status=429,
+                             headers={"Retry-After": str(retry_after)})
+
+
+def _timed_out(e: RequestTimeoutError) -> web.Response:
+    """HTTP 408 for a request that expired in the waiting queue past
+    its TTFT deadline."""
+    body = ErrorResponse(message=str(e), type="timeout_error",
+                         code="408").model_dump()
+    return web.json_response(body, status=408)
 
 
 def _make_logprobs(token_ids, id_logprobs, tokenizer,
@@ -271,8 +292,16 @@ class OpenAIServer:
                 final = output
             return final
 
-        finals = await asyncio.gather(
-            *(consume(i, p) for i, p in enumerate(prompts)))
+        try:
+            finals = await asyncio.gather(
+                *(consume(i, p) for i, p in enumerate(prompts)))
+        except (RequestRejectedError, RequestTimeoutError) as e:
+            # Shed at admission (429 + Retry-After) or expired in the
+            # queue (408); siblings of a batch are aborted with it.
+            for i in range(len(prompts)):
+                self.engine.abort_request(f"{request_id}-{i}")
+            return _overloaded(e) \
+                if isinstance(e, RequestRejectedError) else _timed_out(e)
         if any(f is None for f in finals):
             return _error("Client disconnected", status=499)
 
@@ -302,15 +331,27 @@ class OpenAIServer:
 
     async def _stream_completion(self, request, req, sampling_params,
                                  prompt, request_id) -> web.StreamResponse:
-        response = _sse_response()
-        await response.prepare(request)
         kwargs = dict(prompt_token_ids=prompt) \
             if isinstance(prompt, list) else dict()
         text = None if isinstance(prompt, list) else prompt
+        # Admit BEFORE preparing the SSE response: a shed request gets
+        # a real HTTP 429 + Retry-After, not an error inside a 200
+        # event stream.
+        try:
+            stream = await self.engine.add_request(
+                request_id, text, sampling_params, **kwargs)
+        except RequestRejectedError as e:
+            return _overloaded(e)
+        response = _sse_response()
+        await response.prepare(request)
         previous_texts = {}
         try:
-            async for output in self.engine.generate(
-                    text, sampling_params, request_id, **kwargs):
+            async for output in stream:
+                if await request_disconnected(request):
+                    # Client hung up mid-stream: release its KV pages
+                    # within one step instead of at GC time.
+                    stream.cancel()
+                    return response
                 for out in output.outputs:
                     prev = previous_texts.get(out.index, "")
                     delta = out.text[len(prev):]
@@ -323,7 +364,16 @@ class OpenAIServer:
                     await _sse_send(response, chunk.model_dump())
             await _sse_done(response)
         except asyncio.CancelledError:
-            await self.engine.abort(request_id)
+            stream.cancel()
+            raise
+        except RequestTimeoutError as e:
+            # Expired in the queue after the SSE prelude: surface the
+            # typed timeout in-band, then close.
+            await _sse_send(response, {"error": {
+                "message": str(e), "type": "timeout_error"}})
+            await response.write_eof()
+        except Exception:
+            stream.cancel()
             raise
         return response
 
@@ -370,12 +420,17 @@ class OpenAIServer:
                                            prompt, request_id)
 
         final: Optional[RequestOutput] = None
-        async for output in self.engine.generate(prompt, sampling_params,
-                                                 request_id):
-            if await request_disconnected(request):
-                await self.engine.abort(request_id)
-                return _error("Client disconnected", status=499)
-            final = output
+        try:
+            async for output in self.engine.generate(
+                    prompt, sampling_params, request_id):
+                if await request_disconnected(request):
+                    await self.engine.abort(request_id)
+                    return _error("Client disconnected", status=499)
+                final = output
+        except RequestRejectedError as e:
+            return _overloaded(e)
+        except RequestTimeoutError as e:
+            return _timed_out(e)
         assert final is not None
         choices = [
             ChatCompletionResponseChoice(
@@ -396,6 +451,12 @@ class OpenAIServer:
 
     async def _stream_chat(self, request, req, sampling_params, prompt,
                            request_id) -> web.StreamResponse:
+        # Admit before the SSE prelude so sheds are real 429s.
+        try:
+            stream = await self.engine.add_request(
+                request_id, prompt, sampling_params)
+        except RequestRejectedError as e:
+            return _overloaded(e)
         response = _sse_response()
         await response.prepare(request)
         first = ChatCompletionStreamResponse(
@@ -405,8 +466,10 @@ class OpenAIServer:
         await _sse_send(response, first.model_dump(exclude_unset=True))
         previous_texts = {}
         try:
-            async for output in self.engine.generate(
-                    prompt, sampling_params, request_id):
+            async for output in stream:
+                if await request_disconnected(request):
+                    stream.cancel()
+                    return response
                 for out in output.outputs:
                     prev = previous_texts.get(out.index, "")
                     delta = out.text[len(prev):]
@@ -420,7 +483,14 @@ class OpenAIServer:
                     await _sse_send(response, chunk.model_dump())
             await _sse_done(response)
         except asyncio.CancelledError:
-            await self.engine.abort(request_id)
+            stream.cancel()
+            raise
+        except RequestTimeoutError as e:
+            await _sse_send(response, {"error": {
+                "message": str(e), "type": "timeout_error"}})
+            await response.write_eof()
+        except Exception:
+            stream.cancel()
             raise
         return response
 

@@ -91,6 +91,12 @@ class _SamplerFields(BaseModel):
     spaces_between_special_tokens: Optional[bool] = True
     logit_bias: Optional[Dict[str, float]] = None
     seed: Optional[int] = None
+    # Aphrodite extension: per-request TTFT deadline (seconds).
+    # Admission sheds the request with HTTP 429 + Retry-After when its
+    # predicted TTFT already exceeds this; a queued request past its
+    # deadline expires with a timeout error. Default:
+    # APHRODITE_DEFAULT_TTFT_SLO_S.
+    ttft_slo_s: Optional[float] = None
     n: Optional[int] = 1
     best_of: Optional[int] = None
     logprobs: Optional[int] = None
@@ -136,6 +142,7 @@ class _SamplerFields(BaseModel):
             logprobs=self.logprobs,
             prompt_logprobs=self.prompt_logprobs,
             seed=self.seed,
+            ttft_slo_s=self.ttft_slo_s,
             logits_processors=logits_processors,
         )
 

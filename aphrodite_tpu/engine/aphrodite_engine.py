@@ -16,7 +16,7 @@ from __future__ import annotations
 import time
 from typing import Iterable, List, Optional, Tuple, Union
 
-from aphrodite_tpu.common import faultinject
+from aphrodite_tpu.common import faultinject, flags
 from aphrodite_tpu.common.config import (CacheConfig, DeviceConfig,
                                          LoRAConfig, ModelConfig,
                                          ParallelConfig, SchedulerConfig)
@@ -31,6 +31,9 @@ from aphrodite_tpu.engine.args_tools import EngineArgs
 from aphrodite_tpu.engine.metrics import StatLogger, Stats
 from aphrodite_tpu.engine.supervisor import FaultClass, classify_failure
 from aphrodite_tpu.executor.executor import TPUExecutor
+from aphrodite_tpu.processing.admission import (AdmissionController,
+                                                AdmissionSnapshot,
+                                                RequestTimeoutError)
 from aphrodite_tpu.processing.scheduler import (Scheduler,
                                                 SchedulerOutputs)
 from aphrodite_tpu.transformers_utils.tokenizer import (
@@ -125,6 +128,10 @@ class AphroditeEngine:
                                     device_config, lora_config)
         self.scheduler = Scheduler(scheduler_config, cache_config,
                                    lora_config)
+        # Overload control: throughput EWMAs + shed/expired counters
+        # (processing/admission.py). The async frontend consults it
+        # via try_admit BEFORE a request touches the tracker.
+        self.admission = AdmissionController()
         self.stat_logger = StatLogger(
             labels=dict(model_name=model_config.model)) if log_stats \
             else None
@@ -222,8 +229,82 @@ class AphroditeEngine:
 
         seq_group = SequenceGroup(request_id, [seq], sampling_params,
                                   arrival_time, prefix=prefix,
-                                  lora_request=lora_request)
+                                  lora_request=lora_request,
+                                  deadline=self._deadline_of(
+                                      sampling_params, arrival_time))
         self.scheduler.add_seq_group(seq_group)
+
+    @staticmethod
+    def _deadline_of(sampling_params: SamplingParams,
+                     arrival_time: float) -> Optional[float]:
+        """Absolute TTFT deadline (monotonic clock) from the request's
+        `ttft_slo_s` or the APHRODITE_DEFAULT_TTFT_SLO_S default;
+        None when neither sets a deadline."""
+        slo = sampling_params.ttft_slo_s
+        if slo is None:
+            slo = flags.get_float("APHRODITE_DEFAULT_TTFT_SLO_S")
+        if not slo or slo <= 0:
+            return None
+        return arrival_time + slo
+
+    # -- overload control (processing/admission.py) --
+
+    def admission_limits(self) -> Tuple[int, int]:
+        """(max queue depth, max queued prefill tokens) with the
+        0 = derived defaults resolved against the scheduler config."""
+        depth = flags.get_int("APHRODITE_MAX_QUEUE_DEPTH")
+        if depth <= 0:
+            depth = 16 * self.scheduler_config.max_num_seqs
+        tokens = flags.get_int("APHRODITE_MAX_WAITING_TOKENS")
+        if tokens <= 0:
+            tokens = 8 * self.scheduler_config.max_num_batched_tokens
+        return depth, tokens
+
+    def try_admit(self, num_tokens: int,
+                  sampling_params: SamplingParams,
+                  extra_depth: int = 0, extra_tokens: int = 0) -> None:
+        """Admission gate for a new request of ~`num_tokens` prompt
+        tokens: raises RequestRejectedError (with a Retry-After
+        estimate) when the queue caps or the request's predicted TTFT
+        vs its deadline say it cannot be served in time. Touches no
+        allocator state — a shed request costs queue inspection only.
+        `extra_depth`/`extra_tokens` account load the async tracker
+        holds that has not reached the scheduler queue yet."""
+        slo = sampling_params.ttft_slo_s
+        if slo is None:
+            slo = flags.get_float("APHRODITE_DEFAULT_TTFT_SLO_S")
+        max_depth, max_tokens = self.admission_limits()
+        self.admission.admit_or_raise(
+            num_tokens=num_tokens,
+            deadline_s=slo if slo and slo > 0 else None,
+            queue_depth=len(self.scheduler.waiting) + extra_depth,
+            queued_tokens=(self.scheduler.waiting_prefill_tokens() +
+                           extra_tokens),
+            max_depth=max_depth, max_tokens=max_tokens)
+
+    def overload_snapshot(self) -> AdmissionSnapshot:
+        """Queue depth, queued prefill tokens, shed/expired counters,
+        and throughput EWMAs — serialized into /health (the metrics
+        rider) so load balancers see DEGRADED-while-shedding before
+        DEAD."""
+        return self.admission.snapshot(
+            queue_depth=len(self.scheduler.waiting),
+            waiting_tokens=self.scheduler.waiting_prefill_tokens())
+
+    def _expire_deadlines(self) -> None:
+        """Expire deadline-missed groups still in `waiting` (never
+        computed — no pages, no schedule round) and record a typed
+        RequestTimeoutError for each stream via the step-fault seam."""
+        expired = self.scheduler.expire_waiting(time.monotonic())
+        if not expired:
+            return
+        self.admission.record_expired(len(expired))
+        for group in expired:
+            self._step_faults.append((group.request_id,
+                                      RequestTimeoutError(
+                f"request {group.request_id} missed its TTFT deadline "
+                "while queued (never scheduled); shed by deadline "
+                "expiry")))
 
     def abort_request(self, request_id: Union[str, Iterable[str]]) -> None:
         self.scheduler.abort_seq_group(request_id)
@@ -256,6 +337,7 @@ class AphroditeEngine:
         recorded in `_step_faults` (drained by `drain_step_faults`)."""
         faultinject.fire("engine.step")
         self._inflight_rounds = []
+        self._expire_deadlines()
         seq_group_metadata_list, scheduler_outputs = \
             self.scheduler.schedule()
         self._inflight_rounds.append(scheduler_outputs)
@@ -516,14 +598,18 @@ class AphroditeEngine:
         ]
         for seq_group in scheduler_outputs.ignored_seq_groups:
             request_outputs.append(RequestOutput.from_seq_group(seq_group))
+        generation_tokens = sum(tokens_of[id(g)] for g in decode_groups)
+        # Feed the admission controller's throughput EWMAs — the basis
+        # of predicted-TTFT shedding and Retry-After estimates.
+        self.admission.observe_round(scheduler_outputs.num_prefill_tokens,
+                                     generation_tokens)
         if self.stat_logger is not None:
             # Reference semantics: the token sampled off a prefill
             # counts under prompt throughput; generation counts decode
             # rows only (K per row for a K-step burst).
             self.stat_logger.log(self._get_stats(
                 scheduler_outputs,
-                generation_tokens=sum(tokens_of[id(g)]
-                                      for g in decode_groups)))
+                generation_tokens=generation_tokens))
         return request_outputs
 
     def _record_latencies(self, scheduled_seq_groups,
@@ -813,4 +899,9 @@ class AphroditeEngine:
             num_generation_tokens=num_generation_tokens,
             time_to_first_tokens=ttfts,
             time_per_output_tokens=tpots,
-            time_e2e_requests=e2es)
+            time_e2e_requests=e2es,
+            num_waiting_tokens=self.scheduler.waiting_prefill_tokens(),
+            sheds_total=self.admission.sheds_total,
+            expired_total=self.admission.expired_total,
+            ewma_prefill_tok_s=self.admission.ewma_prefill_tok_s,
+            ewma_decode_tok_s=self.admission.ewma_decode_tok_s)

@@ -21,6 +21,14 @@ requests all fail fast with `AsyncEngineDeadError` instead of
 hanging. A watchdog (`APHRODITE_STEP_TIMEOUT_S`) bounds the off-loop
 step so a hung XLA compile is detected rather than wedging forever
 behind a healthy-looking `check_health`.
+
+Overload control (processing/admission.py): `add_request` consults
+the engine's admission controller BEFORE enqueueing — requests past
+the queue caps or whose predicted TTFT already exceeds their deadline
+raise `RequestRejectedError` (HTTP 429 + Retry-After at the
+frontends) and flip health to DEGRADED-while-shedding; disconnects
+route through `AsyncStream.cancel()`/`__del__`/`GeneratorExit` into
+`abort()` so hung-up clients release KV pages within one step.
 """
 from __future__ import annotations
 
@@ -42,6 +50,7 @@ from aphrodite_tpu.engine.supervisor import (FaultClass, HealthMonitor,
                                              StepTimeoutError,
                                              classify_failure,
                                              retry_policy)
+from aphrodite_tpu.processing.admission import RequestRejectedError
 
 logger = init_logger(__name__)
 
@@ -90,12 +99,24 @@ def _finalize_engine_loop(task: asyncio.Task,
 
 
 class AsyncStream:
-    """Per-request stream of RequestOutputs (reference `:41`)."""
+    """Per-request stream of RequestOutputs (reference `:41`).
 
-    def __init__(self, request_id: str) -> None:
+    Disconnect propagation: a consumer that stops iterating (client
+    hung up, response handler GC'd) must not leave the request
+    running — `cancel()` (and, as a backstop, `__del__`) routes
+    through the tracker's abort so the engine releases the request's
+    KV pages within one step instead of at garbage-collection time.
+    """
+
+    def __init__(self, request_id: str,
+                 abort_cb: Optional[Callable[[str], None]] = None
+                 ) -> None:
         self.request_id = request_id
+        # bounded-by: reader-paced; at most one item per engine round,
+        # capped by the request's max_tokens outputs
         self._queue: asyncio.Queue = asyncio.Queue()
         self._finished = False
+        self._abort_cb = abort_cb
 
     def put(self, item: Union[RequestOutput, Exception]) -> None:
         if self._finished:
@@ -105,6 +126,26 @@ class AsyncStream:
     def finish(self) -> None:
         self._queue.put_nowait(StopAsyncIteration())
         self._finished = True
+        self._abort_cb = None
+
+    def cancel(self) -> None:
+        """Consumer is gone: abort the underlying request so its KV
+        pages free within one step. Idempotent; a finished stream is
+        a no-op."""
+        cb, self._abort_cb = self._abort_cb, None
+        if cb is not None and not self._finished:
+            cb(self.request_id)
+
+    def __del__(self) -> None:
+        # Backstop for consumers that drop the stream mid-request
+        # without finish/cancel (the disconnect-storm leak this layer
+        # exists to close). Best-effort: GC can run after the event
+        # loop is gone.
+        try:
+            self.cancel()
+        except Exception as e:
+            logger.debug("stream %s cleanup abort failed: %s",
+                         self.request_id, e)
 
     @property
     def finished(self) -> bool:
@@ -126,9 +167,23 @@ class RequestTracker:
 
     def __init__(self) -> None:
         self._request_streams: Dict[str, AsyncStream] = {}
+        # bounded-by: at most one entry per tracked request, drained
+        # every engine_step
         self._finished_requests: asyncio.Queue = asyncio.Queue()
+        # bounded-by: admission controller caps arrivals
+        # (APHRODITE_MAX_QUEUE_DEPTH) before they reach this queue
         self._new_requests: asyncio.Queue = asyncio.Queue()
         self.new_requests_event: Optional[asyncio.Event] = None
+        # Enqueued-but-not-yet-transferred load, counted by admission
+        # so a same-tick burst cannot slip past the queue caps before
+        # the engine loop moves it into the scheduler's queue.
+        self._pending_new = 0
+        self._pending_tokens = 0
+
+    def pending_load(self) -> Tuple[int, int]:
+        """(requests, estimated prompt tokens) enqueued but not yet
+        handed to the engine."""
+        return self._pending_new, self._pending_tokens
 
     def __contains__(self, item) -> bool:
         return item in self._request_streams
@@ -175,10 +230,14 @@ class RequestTracker:
                     **engine_add_request_kwargs) -> AsyncStream:
         if request_id in self._request_streams:
             raise KeyError(f"Request {request_id} already exists.")
-        stream = AsyncStream(request_id)
+        stream = AsyncStream(request_id, abort_cb=self.abort_request)
         self._new_requests.put_nowait(
             (stream, {"request_id": request_id,
                       **engine_add_request_kwargs}))
+        self._pending_new += 1
+        self._pending_tokens += AsyncAphrodite._estimate_prompt_tokens(
+            engine_add_request_kwargs.get("prompt"),
+            engine_add_request_kwargs.get("prompt_token_ids"))
         if self.new_requests_event is not None:
             self.new_requests_event.set()
         return stream
@@ -208,6 +267,10 @@ class RequestTracker:
                 continue
             self._request_streams[stream.request_id] = stream
             new_requests.append(request)
+        # The queue drained fully: the pending load is now visible to
+        # admission through the scheduler's own queue.
+        self._pending_new = 0
+        self._pending_tokens = 0
         if self.new_requests_event is not None:
             self.new_requests_event.clear()
         return new_requests, finished_requests
@@ -410,6 +473,20 @@ class AsyncAphrodite:
                 "Engine is DEAD ("
                 + (self.health.dead_reason or "unknown failure")
                 + "); new requests fail fast. Restart the server.")
+        # Overload gate: shed BEFORE enqueueing — a queue we cannot
+        # drain in time is a promise we cannot keep. Rejected requests
+        # never touch the tracker or the allocator; the frontends map
+        # RequestRejectedError to HTTP 429 + Retry-After.
+        pending_depth, pending_tokens = \
+            self._request_tracker.pending_load()
+        try:
+            self.engine.try_admit(
+                self._estimate_prompt_tokens(prompt, prompt_token_ids),
+                sampling_params, extra_depth=pending_depth,
+                extra_tokens=pending_tokens)
+        except RequestRejectedError:
+            self.health.record_shed()
+            raise
         if not self.is_running:
             if self.start_engine_loop:
                 self.start_background_loop()
@@ -442,6 +519,13 @@ class AsyncAphrodite:
                 prompt_token_ids=prompt_token_ids, prefix_pos=prefix_pos)
             async for request_output in stream:
                 yield request_output
+        except GeneratorExit:
+            # Consumer dropped the generator without cancelling (the
+            # client hung up and the handler was collected): abort so
+            # the request's KV pages free within one step, not at GC
+            # time.
+            self._abort(request_id)
+            raise
         except (Exception, asyncio.CancelledError) as e:
             self._abort(request_id)
             raise e
@@ -451,9 +535,26 @@ class AsyncAphrodite:
             raise AsyncEngineDeadError("Background loop is not running.")
         self._abort(request_id)
 
+    def abort_request(self, request_id: str) -> None:
+        """Non-raising abort for disconnect/cleanup paths (the async
+        `abort` raises once the loop is down; cleanup must not)."""
+        self._abort(request_id)
+
     def _abort(self, request_id: str) -> None:
         self._request_tracker.abort_request(
             request_id, verbose=self.log_requests)
+
+    @staticmethod
+    def _estimate_prompt_tokens(prompt: Optional[str],
+                                prompt_token_ids: Optional[List[int]]
+                                ) -> int:
+        """Admission-sizing estimate (tokenization happens later, on
+        the engine loop): exact for token-id prompts, ~4 chars/token
+        for text. Admission caps are coarse backlog bounds, so the
+        estimate only needs to be the right order of magnitude."""
+        if prompt_token_ids is not None:
+            return len(prompt_token_ids)
+        return max(1, len(prompt or "") // 4)
 
     async def get_model_config(self) -> ModelConfig:
         return self.engine.get_model_config()
@@ -469,4 +570,5 @@ class AsyncAphrodite:
         if not self.is_running:
             raise AsyncEngineDeadError("Background loop is stopped.")
         return self.health.report(
-            in_flight=self.engine.has_unfinished_requests())
+            in_flight=self.engine.has_unfinished_requests(),
+            overload=self.engine.overload_snapshot().to_json())

@@ -72,6 +72,28 @@ class Metrics:
             labelnames,
             buckets=[1.0, 2.5, 5.0, 10.0, 15.0, 20.0, 30.0, 40.0, 50.0,
                      60.0])
+        # Overload-control gauges/counters (processing/admission.py):
+        # the same numbers ride in the /health report so load
+        # balancers can act on DEGRADED-while-shedding before DEAD.
+        self.gauge_waiting_prefill_tokens = _get_or_create(
+            Gauge, "aphrodite:queued_prefill_tokens",
+            "Prefill tokens queued across the waiting queue.",
+            labelnames)
+        self.gauge_ewma_prefill = _get_or_create(
+            Gauge, "aphrodite:ewma_prefill_tokens_per_s",
+            "EWMA prefill throughput driving admission TTFT "
+            "prediction.", labelnames)
+        self.gauge_ewma_decode = _get_or_create(
+            Gauge, "aphrodite:ewma_decode_tokens_per_s",
+            "EWMA decode throughput.", labelnames)
+        self.counter_requests_shed = _get_or_create(
+            Counter, "aphrodite:num_requests_shed",
+            "Requests rejected at admission by overload control.",
+            labelnames)
+        self.counter_requests_expired = _get_or_create(
+            Counter, "aphrodite:num_requests_expired",
+            "Requests expired in the waiting queue past their TTFT "
+            "deadline.", labelnames)
 
 
 @dataclass
@@ -88,6 +110,13 @@ class Stats:
     time_to_first_tokens: List[float]
     time_per_output_tokens: List[float]
     time_e2e_requests: List[float]
+    # Overload-control snapshot (cumulative counters; the logger
+    # tracks deltas for the Prometheus counters).
+    num_waiting_tokens: int = 0
+    sheds_total: int = 0
+    expired_total: int = 0
+    ewma_prefill_tok_s: float = 0.0
+    ewma_decode_tok_s: float = 0.0
 
 
 class StatLogger:
@@ -100,6 +129,9 @@ class StatLogger:
         self.labels = labels or {}
         self.num_prompt_tokens: List[int] = []
         self.num_generation_tokens: List[int] = []
+        # Cumulative counts already exported, for counter deltas.
+        self._sheds_exported = 0
+        self._expired_exported = 0
         self.metrics = Metrics(labelnames=list(self.labels.keys()))
 
     def _throughput(self, tracked: List[int], now: float) -> float:
@@ -118,6 +150,18 @@ class StatLogger:
         labeled(m.counter_prompt_tokens).inc(stats.num_prompt_tokens)
         labeled(m.counter_generation_tokens).inc(
             stats.num_generation_tokens)
+        labeled(m.gauge_waiting_prefill_tokens).set(
+            stats.num_waiting_tokens)
+        labeled(m.gauge_ewma_prefill).set(stats.ewma_prefill_tok_s)
+        labeled(m.gauge_ewma_decode).set(stats.ewma_decode_tok_s)
+        labeled(m.counter_requests_shed).inc(
+            max(0, stats.sheds_total - self._sheds_exported))
+        self._sheds_exported = max(self._sheds_exported,
+                                   stats.sheds_total)
+        labeled(m.counter_requests_expired).inc(
+            max(0, stats.expired_total - self._expired_exported))
+        self._expired_exported = max(self._expired_exported,
+                                     stats.expired_total)
         for t in stats.time_to_first_tokens:
             labeled(m.histogram_time_to_first_token).observe(t)
         for t in stats.time_per_output_tokens:

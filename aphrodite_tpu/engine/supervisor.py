@@ -116,11 +116,19 @@ class HealthReport:
     recovered_steps: int
     consecutive_failures: int
     dead_reason: Optional[str] = None
+    sheds_total: int = 0
+    # Overload-control section (queue depth, queued prefill tokens,
+    # shed/expired counters, throughput EWMAs — the engine/metrics.py
+    # rider) so load balancers can act on DEGRADED-while-shedding
+    # before the replica is DEAD.
+    overload: Optional[Dict[str, Any]] = None
 
     def to_json(self) -> Dict[str, Any]:
         body = dataclasses.asdict(self)
         if self.last_step_age_s is not None:
             body["last_step_age_s"] = round(self.last_step_age_s, 3)
+        if self.overload is None:
+            body.pop("overload")
         return body
 
 
@@ -128,10 +136,18 @@ class HealthMonitor:
     """RUNNING/DEGRADED/DEAD state machine with a per-step heartbeat.
 
     DEGRADED means "alive but limping": the loop is mid-retry
-    (consecutive failures > 0) or, with the watchdog enabled, the last
-    completed step is older than the step timeout while work is in
-    flight. DEAD is terminal — nothing un-deads an engine short of a
-    restart (the process may hold a wedged executor thread)."""
+    (consecutive failures > 0), the admission controller shed a
+    request within the last `SHED_DEGRADED_WINDOW_S` seconds
+    (overload — the replica is up but turning work away), or, with
+    the watchdog enabled, the last completed step is older than the
+    step timeout while work is in flight. DEAD is terminal — nothing
+    un-deads an engine short of a restart (the process may hold a
+    wedged executor thread)."""
+
+    #: Seconds after the last load-shed during which the state reads
+    #: DEGRADED (long enough for a load balancer's probe interval to
+    #: observe a shedding burst, short enough to recover promptly).
+    SHED_DEGRADED_WINDOW_S = 5.0
 
     def __init__(self) -> None:
         self._last_step_at: Optional[float] = None
@@ -140,6 +156,8 @@ class HealthMonitor:
         self._recovered_steps = 0
         self._consecutive_failures = 0
         self._dead_reason: Optional[str] = None
+        self._sheds_total = 0
+        self._last_shed_at: Optional[float] = None
 
     # -- transitions (called by the supervised loop) --
 
@@ -157,6 +175,12 @@ class HealthMonitor:
     def record_recovery(self) -> None:
         """A retried step succeeded."""
         self._recovered_steps += 1
+
+    def record_shed(self) -> None:
+        """Admission shed a request: DEGRADED-while-shedding for the
+        next SHED_DEGRADED_WINDOW_S seconds."""
+        self._sheds_total += 1
+        self._last_shed_at = time.monotonic()
 
     def mark_dead(self, reason: BaseException | str) -> None:
         if self._dead_reason is None:
@@ -182,10 +206,20 @@ class HealthMonitor:
     def recovered_steps(self) -> int:
         return self._recovered_steps
 
+    @property
+    def sheds_total(self) -> int:
+        return self._sheds_total
+
     def state(self, in_flight: bool = False) -> EngineState:
         if self.is_dead:
             return EngineState.DEAD
         if self._consecutive_failures > 0:
+            return EngineState.DEGRADED
+        if self._last_shed_at is not None and \
+                time.monotonic() - self._last_shed_at < \
+                self.SHED_DEGRADED_WINDOW_S:
+            # Shedding load: alive, making progress, but turning work
+            # away — load balancers should route around the replica.
             return EngineState.DEGRADED
         timeout = flags.get_float("APHRODITE_STEP_TIMEOUT_S")
         if (timeout and in_flight and self._last_step_at is not None
@@ -195,7 +229,8 @@ class HealthMonitor:
             return EngineState.DEGRADED
         return EngineState.RUNNING
 
-    def report(self, in_flight: bool = False) -> HealthReport:
+    def report(self, in_flight: bool = False,
+               overload: Optional[Dict[str, Any]] = None) -> HealthReport:
         age = None
         if self._last_step_at is not None:
             age = time.monotonic() - self._last_step_at
@@ -207,4 +242,6 @@ class HealthMonitor:
             recovered_steps=self._recovered_steps,
             consecutive_failures=self._consecutive_failures,
             dead_reason=self._dead_reason,
+            sheds_total=self._sheds_total,
+            overload=overload,
         )

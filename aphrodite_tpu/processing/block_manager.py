@@ -106,14 +106,20 @@ class BlockSpaceManager:
             needed = min(needed, self.block_sliding_window)
         return needed
 
-    def can_allocate(self, seq_group: SequenceGroup) -> AllocStatus:
+    def can_allocate(self, seq_group: SequenceGroup,
+                     extra_reserved: int = 0) -> AllocStatus:
+        """Admission verdict. `extra_reserved` blocks are treated as
+        unavailable on top of the watermark hysteresis — the
+        scheduler passes its low-watermark reserve (pages held back
+        for running sequences' next decode slots) so admitting a
+        prompt can never immediately force a preemption."""
         needed = self._prompt_blocks_needed(seq_group)
         free = self.gpu_allocator.get_num_free_blocks()
         # The watermark hysteresis avoids admitting a prompt that would
         # immediately force evictions.
         if self.num_total_gpu_blocks - needed < self.watermark_blocks:
             return AllocStatus.NEVER
-        if free - needed >= self.watermark_blocks:
+        if free - needed >= self.watermark_blocks + extra_reserved:
             return AllocStatus.OK
         return AllocStatus.LATER
 

@@ -186,6 +186,60 @@ class Scheduler:
         return (len(self.waiting) + len(self.prefilling) +
                 len(self.running) + len(self.swapped))
 
+    def waiting_prefill_tokens(self) -> int:
+        """Prefill tokens queued across `waiting` (admission gauge;
+        the deque is admission-capped so the walk stays bounded)."""
+        return sum(
+            seq.get_len() - seq.data.num_computed_tokens
+            for group in self.waiting
+            for seq in group.get_seqs(status=SequenceStatus.WAITING))
+
+    def expire_waiting(self, now: float) -> List[SequenceGroup]:
+        """Abort deadline-missed groups still sitting in `waiting`
+        that were never computed — no pages were ever allocated and
+        no schedule round runs for them, so the abort is free.
+
+        Groups a preemption requeued (they already produced output
+        tokens, i.e. met their TTFT) are never expired. Returns the
+        expired groups so the engine can surface a typed
+        RequestTimeoutError on exactly those streams.
+        """
+        expired: List[SequenceGroup] = []
+        kept: Deque[SequenceGroup] = deque()
+        for group in self.waiting:
+            deadline = group.deadline
+            seqs = group.get_seqs(status=SequenceStatus.WAITING)
+            never_computed = all(
+                seq.data.num_computed_tokens == 0 and
+                seq.get_output_len() == 0 for seq in seqs)
+            if deadline is not None and now > deadline and seqs and \
+                    never_computed:
+                for seq in seqs:
+                    seq.status = SequenceStatus.FINISHED_ABORTED
+                    self.free_seq(seq)       # no-op: never allocated
+                expired.append(group)
+            else:
+                kept.append(group)
+        if expired:
+            self.waiting = kept
+        return expired
+
+    def _admission_page_reserve(self) -> int:
+        """Extra free pages prompt admission must leave untouched:
+        the APHRODITE_PAGE_LOW_WATERMARK fraction of the pool PLUS
+        one page per running sequence (the worst-case next decode
+        slot), so admitting a prompt can never immediately force
+        `can_append_slot` to start evicting running groups. 0 (the
+        default) keeps the allocator's own 1% hysteresis only."""
+        frac = flags.get_float("APHRODITE_PAGE_LOW_WATERMARK")
+        if not frac or frac <= 0:
+            return 0
+        running_slots = sum(
+            g.num_seqs(status=SequenceStatus.RUNNING)
+            for g in self.running)
+        return int(frac * self.block_manager.num_total_gpu_blocks) + \
+            running_slots
+
     # ------------------------------------------------------------------
 
     def _fit_chunk(self, remaining: int, seq_lens: List[int],
@@ -256,6 +310,7 @@ class Scheduler:
         # which does not model sliding-window rings; such models admit
         # whole prompts only.
         can_split = self.cache_config.sliding_window is None
+        page_reserve = self._admission_page_reserve()
 
         while self.waiting:
             group = self.waiting[0]
@@ -275,7 +330,8 @@ class Scheduler:
                 self.waiting.popleft()
                 continue
 
-            can_allocate = self.block_manager.can_allocate(group)
+            can_allocate = self.block_manager.can_allocate(
+                group, extra_reserved=page_reserve)
             if can_allocate == AllocStatus.LATER:
                 break
             if can_allocate == AllocStatus.NEVER:
@@ -444,13 +500,25 @@ class Scheduler:
         # 1. Decode batch: reserve one slot per running sequence,
         # preempting from the back of the priority order when pages run
         # out. (Groups mid-prefill are not decode rows and hold their
-        # pages until done.)
+        # pages until done.) A per-round preemption budget
+        # (APHRODITE_PREEMPT_BUDGET) damps cascade RECOMPUTE storms:
+        # every preempted group re-prefills from scratch, so an
+        # undamped round under page pressure evicts half the batch and
+        # collapses goodput. Rows still without a free page past the
+        # budget SKIP the round holding their pages (no device work, no
+        # eviction) and retry next round, when the budgeted preemptions
+        # have freed pages.
+        preempt_budget = flags.get_int("APHRODITE_PREEMPT_BUDGET")
         self.running = self.policy.sort_by_priority(now, self.running)
         running: Deque[SequenceGroup] = deque()
         preempted: List[SequenceGroup] = []
+        deferred: List[SequenceGroup] = []
         while self.running:
             seq_group = self.running.popleft()
             while not self.block_manager.can_append_slot(seq_group):
+                if len(preempted) >= preempt_budget:
+                    deferred.append(seq_group)
+                    break
                 if self.running:
                     victim = self.running.pop()
                     self._preempt(victim, blocks_to_swap_out)
@@ -464,11 +532,15 @@ class Scheduler:
                 running.append(seq_group)
         self.running = running
         decode_groups = list(self.running)
+        # Deferred rows stay RUNNING (they keep their pages and their
+        # priority) but are not decode rows this round.
+        self.running.extend(deferred)
 
         # 2. Bring swapped groups back while there is room (unless this
-        # very step preempted — swapping both directions is forbidden).
+        # very step preempted or deferred — swapping both directions is
+        # forbidden, and deferred rows mean the pool is exhausted).
         self.swapped = self.policy.sort_by_priority(now, self.swapped)
-        if not preempted:
+        if not preempted and not deferred:
             num_curr_seqs = sum(g.get_max_num_running_seqs()
                                 for g in self.running)
             curr_loras = (set(g.lora_int_id for g in self.running)
@@ -531,7 +603,7 @@ class Scheduler:
             budget = full
         if budget > 0:
             self._continue_prefills(seq_lens, budget, chunks)
-            if not preempted and not self.swapped:
+            if not preempted and not deferred and not self.swapped:
                 self._admit_prompts(seq_lens, budget, chunks, ignored)
         elif self.prefilling:
             # max_chunk_tokens == 0 disables chunk-mixing for NEW

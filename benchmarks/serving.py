@@ -12,6 +12,17 @@ Usage:
         [--num-requests 128] [--prompt-len 128] [--output-len 64]
 Prints one JSON line with the percentile table.
 
+Overload mode (`--overload`): multiplies the offered rate
+(`--overload-mult`, default 2x), attaches a per-request TTFT deadline
+drawn from a distribution around `--deadline-s`, and fires a
+disconnect storm (`--disconnect-rate` of requests hang up mid-stream
+by dropping their generators — the GeneratorExit abort path, not a
+polite abort). The JSON gains an `overload` section: goodput for
+admitted requests, shed/expired/served/disconnected counts, rejection
+latency (shed requests must observe sub-100 ms rejections), admitted-
+request TTFT percentiles, and the post-storm free-page check
+(`kv_leak_pages` must be 0 — KV returns to `free0`).
+
 Chaos mode (`--chaos`): injects faults via APHRODITE_FAULT
 (`--chaos-fault`, default a low-probability transient executor fault)
 and fires an abort storm (`--chaos-abort-rate` of requests aborted at
@@ -57,11 +68,27 @@ async def run(args) -> dict:
     from aphrodite_tpu.common.sampling_params import SamplingParams
     from aphrodite_tpu.engine.args_tools import AsyncEngineArgs
     from aphrodite_tpu.engine.async_aphrodite import AsyncAphrodite
+    from aphrodite_tpu.processing.admission import (RequestRejectedError,
+                                                    RequestTimeoutError)
 
     chaos = bool(getattr(args, "chaos", False))
     chaos_fault = str(getattr(args, "chaos_fault", "") or "")
     chaos_abort_rate = float(getattr(args, "chaos_abort_rate", 0.0)
                              or 0.0)
+    overload = bool(getattr(args, "overload", False))
+    overload_mult = float(getattr(args, "overload_mult", 2.0) or 2.0)
+    deadline_s = float(getattr(args, "deadline_s", 2.0) or 2.0)
+    disconnect_rate = float(getattr(args, "disconnect_rate", 0.1)
+                            or 0.0)
+    if overload:
+        # Offered load = mult x the configured rate; the admission
+        # layer must shed the excess instead of queueing to death.
+        if args.request_rate != float("inf"):
+            args.request_rate = args.request_rate * overload_mult
+        # Engage the anti-preemption-storm page reserve unless the
+        # operator pinned a value (env writes are the sanctioned way
+        # for a harness to configure per-call-read flags).
+        os.environ.setdefault("APHRODITE_PAGE_LOW_WATERMARK", "0.05")
     if chaos and chaos_fault and chaos_fault != "none":
         # Env WRITES are the sanctioned way for a harness to configure
         # the (per-call-read) fault-injection flags.
@@ -91,13 +118,33 @@ async def run(args) -> dict:
         for i in range(args.num_requests)
         if chaos and abort_rng.uniform() < chaos_abort_rate
     }
+    # Deterministic overload plans: per-request TTFT deadline drawn
+    # around --deadline-s, and a disconnect storm (hang up after a
+    # random number of tokens by DROPPING the generator — the
+    # GeneratorExit path, not a polite abort).
+    dl_rng = np.random.RandomState(
+        int(getattr(args, "chaos_seed", 0) or 0) + 7)
+    deadline_of = {
+        i: float(dl_rng.uniform(0.5, 1.5) * deadline_s)
+        for i in range(args.num_requests)
+    } if overload else {}
+    disc_rng = np.random.RandomState(
+        int(getattr(args, "chaos_seed", 0) or 0) + 17)
+    disconnect_after = {
+        i: int(disc_rng.randint(1, max(2, args.output_len)))
+        for i in range(args.num_requests)
+        if overload and disc_rng.uniform() < disconnect_rate
+    }
 
     ttfts, tpots, e2es = [], [], []
-    outcomes = {"survived": 0, "aborted": 0, "failed": 0}
+    outcomes = {"survived": 0, "aborted": 0, "failed": 0,
+                "shed": 0, "expired": 0, "disconnected": 0}
+    rejection_ms: list = []
 
     async def one(i: int, *, measured: bool = True) -> None:
         sp = SamplingParams(temperature=0.0, max_tokens=args.output_len,
-                            ignore_eos=True)
+                            ignore_eos=True,
+                            ttft_slo_s=deadline_of.get(i))
         rid = f"req-{i}" if measured else f"warm-req-{i}"
         aborter = None
         if measured and i in abort_frac:
@@ -116,6 +163,7 @@ async def run(args) -> dict:
         t0 = time.perf_counter()
         first = None
         final = None
+        hung_up = False
         try:
             async for out in engine.generate(
                     None, sp, rid, prompt_token_ids=prompts[i]):
@@ -123,6 +171,23 @@ async def run(args) -> dict:
                         out.outputs[0].token_ids:
                     first = time.perf_counter()
                 final = out
+                if measured and i in disconnect_after and final.outputs \
+                        and len(final.outputs[0].token_ids) >= \
+                        disconnect_after[i]:
+                    # Client hangs up: stop iterating and DROP the
+                    # generator (no abort call) — disconnect
+                    # propagation must free the KV pages anyway.
+                    hung_up = True
+                    break
+        except RequestRejectedError:
+            if measured:
+                outcomes["shed"] += 1
+                rejection_ms.append((time.perf_counter() - t0) * 1e3)
+            return
+        except RequestTimeoutError:
+            if measured:
+                outcomes["expired"] += 1
+            return
         except Exception as e:
             if measured:
                 outcomes["failed"] += 1
@@ -133,6 +198,9 @@ async def run(args) -> dict:
             if aborter is not None:
                 aborter.cancel()
         t1 = time.perf_counter()
+        if hung_up:
+            outcomes["disconnected"] += 1
+            return
         n_out = len(final.outputs[0].token_ids) if final and \
             final.outputs else 0
         if not measured:
@@ -196,17 +264,37 @@ async def run(args) -> dict:
         await asyncio.gather(*tasks)
         return time.perf_counter() - t0
 
+    async def drain_to_idle() -> None:
+        """Wait until every in-flight request (including disconnect
+        casualties whose aborts ride the generator finalizers) has
+        fully released its KV pages."""
+        import gc
+        for _ in range(600):
+            gc.collect()        # finalize dropped async generators
+            await asyncio.sleep(0.05)
+            if not engine.engine.has_unfinished_requests() and \
+                    not engine.engine.scheduler.block_manager.\
+                    block_tables:
+                return
+        logger_warn("drain_to_idle: engine still busy after 30 s")
+
     if int(getattr(args, "warmup", 0) or 0):
         await bucket_warmup()
     for _ in range(int(getattr(args, "warmup", 0) or 0)):
         await drive()
+        await drain_to_idle()
         ttfts.clear()
         tpots.clear()
         e2es.clear()
+        rejection_ms.clear()
         for key in outcomes:
             outcomes[key] = 0
 
+    block_manager = engine.engine.scheduler.block_manager
+    free0 = block_manager.get_num_free_gpu_blocks()
     wall = await drive()
+    if overload:
+        await drain_to_idle()
 
     def pct(xs, p):
         # 0.0 (not None) for empty series: round() downstream.
@@ -224,6 +312,36 @@ async def run(args) -> dict:
         "e2e_p50": round(pct(e2es, 50), 4),
         "e2e_p99": round(pct(e2es, 99), 4),
     }
+    if overload:
+        admission = engine.engine.admission
+        free_end = block_manager.get_num_free_gpu_blocks()
+        detail["overload"] = {
+            "offered_rate": args.request_rate,
+            "deadline_s": deadline_s,
+            "disconnect_rate": disconnect_rate,
+            "requests_served": outcomes["survived"],
+            "requests_shed": outcomes["shed"],
+            "requests_expired": outcomes["expired"],
+            "requests_disconnected": outcomes["disconnected"],
+            "requests_failed": outcomes["failed"],
+            # Goodput: output tokens of fully-served admitted
+            # requests over the measured wall time.
+            "goodput_out_tok_s": round(
+                outcomes["survived"] * args.output_len / wall, 1),
+            "rejection_ms_p50": round(pct(rejection_ms, 50), 2),
+            "rejection_ms_max": round(max(rejection_ms), 2)
+            if rejection_ms else 0.0,
+            "admitted_ttft_p50": round(pct(ttfts, 50), 4),
+            "admitted_ttft_p90": round(pct(ttfts, 90), 4),
+            "admitted_ttft_p99": round(pct(ttfts, 99), 4),
+            "free_pages_before": free0,
+            "free_pages_after": free_end,
+            "kv_leak_pages": free0 - free_end,
+            "sheds_total": admission.sheds_total,
+            "expired_total": admission.expired_total,
+            "ewma_prefill_tok_s": round(
+                admission.ewma_prefill_tok_s, 1),
+        }
     if chaos:
         health = engine.health.report(
             in_flight=engine.engine.has_unfinished_requests())
@@ -290,6 +408,22 @@ def main() -> None:
     parser.add_argument("--warmup", type=int, default=1,
                         help="run the workload once first to absorb "
                              "shape-bucket compiles (0 to disable)")
+    parser.add_argument("--overload", action="store_true",
+                        help="overload mode: offered load x "
+                             "--overload-mult, per-request deadlines, "
+                             "disconnect storm; emits an `overload` "
+                             "JSON section (goodput, shed/expired "
+                             "counts, rejection latency, KV-leak "
+                             "check)")
+    parser.add_argument("--overload-mult", type=float, default=2.0,
+                        help="offered-load multiplier over "
+                             "--request-rate in overload mode")
+    parser.add_argument("--deadline-s", type=float, default=2.0,
+                        help="center of the per-request TTFT deadline "
+                             "distribution (uniform 0.5x-1.5x)")
+    parser.add_argument("--disconnect-rate", type=float, default=0.1,
+                        help="fraction of requests that hang up "
+                             "mid-stream by dropping their generator")
     parser.add_argument("--chaos", action="store_true",
                         help="chaos mode: inject faults + abort storm "
                              "and report fault-tolerance counters")

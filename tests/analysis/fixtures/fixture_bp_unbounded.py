@@ -1,0 +1,19 @@
+"""Seeded BP001 violation: one unbounded asyncio.Queue with no
+registered bound, next to the clean constructs that must stay quiet
+(real bounds, a config-expression bound, and the bounded-by pragma)."""
+import asyncio
+from collections import deque
+
+_DEPTH = 64
+
+
+class _Tracker:
+
+    def __init__(self) -> None:
+        self.backlog = asyncio.Queue()            # BP001: fires here
+        self.done = asyncio.Queue(maxsize=128)    # bounded: quiet
+        self.sized = asyncio.Queue(_DEPTH)        # config bound: quiet
+        self.recent = deque(maxlen=16)            # bounded: quiet
+        self.window = deque([], 8)                # positional: quiet
+        # bounded-by: drained every round by the step loop
+        self.pending = deque()                    # pragma: quiet

@@ -31,9 +31,10 @@ import pytest
 from tools.aphrocheck import DEFAULT_ALLOWLIST, build_context, run
 from tools.aphrocheck.core import (FLAGS_MODULE, REPO_ROOT, Allowlist,
                                    collect_files)
-from tools.aphrocheck.passes import (dma_pass, exc_pass, flag_pass,
-                                     grid_pass, recomp_pass, ref_pass,
-                                     shard_pass, sync_pass, vmem_pass)
+from tools.aphrocheck.passes import (bound_pass, dma_pass, exc_pass,
+                                     flag_pass, grid_pass, recomp_pass,
+                                     ref_pass, shard_pass, sync_pass,
+                                     vmem_pass)
 from tools.aphrocheck.registry import parse_registry
 
 FIXDIR = os.path.join("tests", "analysis", "fixtures")
@@ -159,6 +160,7 @@ def test_scan_covers_benches():
     (recomp_pass.run, "fixture_recomp_fstring.py", "RECOMP003"),
     (exc_pass.run, "fixture_exc_swallow.py", "EXC001"),
     (exc_pass.run, "fixture_exc_cancelled.py", "EXC002"),
+    (bound_pass.run, "fixture_bp_unbounded.py", "BP001"),
 ])
 def test_rule_fires_exactly_once(pass_fn, fixture, rule):
     findings = _pass_findings(pass_fn, [_fixture(fixture)])
@@ -257,6 +259,20 @@ def test_exc001_scope_exempts_endpoints():
          "aphrodite_tpu/endpoints/kobold/api_server.py"])
     assert not [f for f in findings if f.rule == "EXC001"], \
         [f.render() for f in findings]
+
+
+def test_bp001_scope_and_precision():
+    """BP001 fires exactly once on its fixture (the clean bounded /
+    config-bound / pragma constructs stay quiet — proven by the
+    exactly-once parametrized case) and stays quiet outside the
+    engine/endpoints scope: the scheduler's deques in processing/ are
+    bounded by the admission controller by construction, not by
+    maxlen."""
+    findings = _pass_findings(
+        bound_pass.run,
+        ["aphrodite_tpu/processing/scheduler.py",
+         "benchmarks/serving.py"])
+    assert not findings, [f.render() for f in findings]
 
 
 def test_shard_fixtures_stay_precise():
@@ -403,7 +419,7 @@ def test_cli_rules_md_and_readme_drift():
     table = proc.stdout.strip()
     for rule in ("FLAG001", "FLAG006", "VMEM001", "DMA003", "GRID002",
                  "SYNC003", "REF001", "REF004", "SHARD003",
-                 "RECOMP003", "EXC001", "EXC002"):
+                 "RECOMP003", "EXC001", "EXC002", "BP001"):
         assert f"| {rule} |" in table, f"{rule} missing from rules-md"
     with open(os.path.join(REPO_ROOT, "README.md"),
               encoding="utf-8") as f:

@@ -1,0 +1,478 @@
+"""Overload-control suite: deadline-aware admission, bounded queues,
+load shedding, queue-side deadline expiry, disconnect propagation,
+and preemption-storm damping.
+
+The headline scenario is a deterministic 2x burst with mixed
+deadlines proving (a) shed requests get RequestRejectedError without
+touching the allocator and in well under 100 ms, (b) admitted
+requests complete, (c) free pages return to `free0` after the storm
+(the PR-6 crash-barrier invariant), and (d) deadline expiry in
+`waiting` aborts without a schedule round.
+"""
+import asyncio
+import gc
+import time
+
+import pytest
+
+from aphrodite_tpu.common.sampling_params import SamplingParams
+from aphrodite_tpu.processing.admission import (AdmissionController,
+                                                RequestRejectedError,
+                                                RequestTimeoutError)
+
+SP = dict(temperature=0.0, max_tokens=8, ignore_eos=True)
+
+_OVERLOAD_FLAGS = ("APHRODITE_MAX_QUEUE_DEPTH",
+                   "APHRODITE_MAX_WAITING_TOKENS",
+                   "APHRODITE_DEFAULT_TTFT_SLO_S",
+                   "APHRODITE_PAGE_LOW_WATERMARK",
+                   "APHRODITE_PREEMPT_BUDGET")
+
+
+@pytest.fixture(autouse=True)
+def _clean_flags(monkeypatch):
+    for name in _OVERLOAD_FLAGS:
+        monkeypatch.delenv(name, raising=False)
+    yield
+
+
+def _prompt(i, n=12):
+    return [(i * 7 + j * 3) % 90 + 5 for j in range(n)]
+
+
+def _sync_engine(tiny_model_dir, **kw):
+    from aphrodite_tpu.engine.args_tools import EngineArgs
+    from aphrodite_tpu.engine.aphrodite_engine import AphroditeEngine
+    defaults = dict(model=tiny_model_dir, load_format="dummy",
+                    dtype="float32", block_size=16, max_model_len=256,
+                    max_num_seqs=8, swap_space=0.01,
+                    disable_log_stats=True, skip_tokenizer_init=True)
+    defaults.update(kw)
+    return AphroditeEngine(
+        *EngineArgs(**defaults).create_engine_configs())
+
+
+def _async_engine(tiny_model_dir, **kw):
+    from aphrodite_tpu.engine.args_tools import AsyncEngineArgs
+    from aphrodite_tpu.engine.async_aphrodite import AsyncAphrodite
+    defaults = dict(model=tiny_model_dir, load_format="dummy",
+                    dtype="float32", block_size=16, max_model_len=256,
+                    max_num_seqs=8, swap_space=0.01,
+                    disable_log_stats=True, disable_log_requests=True)
+    defaults.update(kw)
+    return AsyncAphrodite.from_engine_args(AsyncEngineArgs(**defaults))
+
+
+# ------------------------------------------------------------------
+# controller units
+# ------------------------------------------------------------------
+
+def test_controller_depth_and_token_caps():
+    c = AdmissionController()
+    # Under both caps: admitted.
+    c.admit_or_raise(num_tokens=10, deadline_s=None, queue_depth=3,
+                     queued_tokens=100, max_depth=8, max_tokens=1000)
+    with pytest.raises(RequestRejectedError) as ei:
+        c.admit_or_raise(num_tokens=10, deadline_s=None, queue_depth=8,
+                         queued_tokens=100, max_depth=8,
+                         max_tokens=1000)
+    assert ei.value.retry_after_s > 0
+    with pytest.raises(RequestRejectedError):
+        c.admit_or_raise(num_tokens=64, deadline_s=None, queue_depth=1,
+                         queued_tokens=960, max_depth=8,
+                         max_tokens=1000)
+    assert c.sheds_total == 2
+
+
+def test_controller_deadline_prediction():
+    c = AdmissionController()
+    # Cold estimator: a deadline alone never sheds (no guess yet).
+    c.admit_or_raise(num_tokens=500, deadline_s=0.001, queue_depth=0,
+                     queued_tokens=10_000, max_depth=0, max_tokens=0)
+    # Warm the EWMA to ~1000 prefill tok/s with controlled clocks.
+    c.observe_round(0, 0, now=100.0)
+    c.observe_round(1000, 0, now=101.0)
+    assert c.ewma_prefill_tok_s == pytest.approx(1000.0)
+    assert c.predicted_ttft_s(4000, 1000) == pytest.approx(5.0)
+    # Predicted 5 s vs a 1 s deadline: shed, Retry-After ~= excess.
+    with pytest.raises(RequestRejectedError) as ei:
+        c.admit_or_raise(num_tokens=1000, deadline_s=1.0,
+                         queue_depth=1, queued_tokens=4000,
+                         max_depth=0, max_tokens=0)
+    assert ei.value.retry_after_s == pytest.approx(4.0, rel=0.01)
+    # Same backlog, a 10 s deadline: admitted.
+    c.admit_or_raise(num_tokens=1000, deadline_s=10.0, queue_depth=1,
+                     queued_tokens=4000, max_depth=0, max_tokens=0)
+    assert c.sheds_total == 1
+
+
+def test_controller_ewma_smooths_pipelined_rounds():
+    c = AdmissionController()
+    c.observe_round(0, 0, now=10.0)
+    # Three builder rounds microseconds apart accumulate into ONE
+    # rate update instead of three absurd spikes.
+    c.observe_round(100, 0, now=10.50)
+    c.observe_round(100, 0, now=10.5001)
+    c.observe_round(100, 0, now=10.5002)
+    c.observe_round(100, 16, now=11.0)
+    assert 0 < c.ewma_prefill_tok_s < 2000
+
+
+def test_controller_idle_gap_does_not_crater_ewma():
+    """The loop only steps while requests exist: a rate computed over
+    a 60 s idle gap would crater the estimate; the window restarts
+    instead."""
+    c = AdmissionController()
+    c.observe_round(0, 0, now=10.0)
+    c.observe_round(1000, 0, now=11.0)     # 1000 tok/s established
+    c.observe_round(500, 0, now=71.0)      # first round after a gap
+    assert c.ewma_prefill_tok_s == pytest.approx(1000.0)
+    c.observe_round(500, 0, now=72.0)      # normal window resumes
+    assert c.ewma_prefill_tok_s == pytest.approx(
+        0.25 * 1000 + 0.75 * 1000)
+
+
+# ------------------------------------------------------------------
+# the 2x burst headline scenario
+# ------------------------------------------------------------------
+
+def test_overload_burst_sheds_and_serves(tiny_model_dir, monkeypatch):
+    """2x burst against a depth cap of 4: the excess is shed with
+    sub-100 ms RequestRejectedError and zero allocator traffic, the
+    admitted half completes, and free pages return to free0."""
+    monkeypatch.setenv("APHRODITE_MAX_QUEUE_DEPTH", "4")
+    engine = _async_engine(tiny_model_dir)
+    bm = engine.engine.scheduler.block_manager
+    free0 = bm.get_num_free_gpu_blocks()
+
+    allocs = []
+    real_allocate = bm.allocate
+
+    def counting_allocate(group):
+        allocs.append(group.request_id)
+        return real_allocate(group)
+
+    monkeypatch.setattr(bm, "allocate", counting_allocate)
+
+    async def one(i):
+        t0 = time.perf_counter()
+        try:
+            final = None
+            async for out in engine.generate(
+                    None, SamplingParams(**SP), f"burst-{i}",
+                    prompt_token_ids=_prompt(i)):
+                final = out
+            return ("served", final, None)
+        except RequestRejectedError as e:
+            return ("shed", time.perf_counter() - t0, e)
+
+    async def go():
+        results = await asyncio.gather(*(one(i) for i in range(8)))
+        # Admission caps the same-tick burst deterministically: the
+        # tracker-pending count makes request 5.. see depth >= 4.
+        shed = [r for r in results if r[0] == "shed"]
+        served = [r for r in results if r[0] == "served"]
+        assert len(shed) == 4 and len(served) == 4, results
+        for _, dt, exc in shed:
+            assert dt < 0.1, f"rejection took {dt * 1e3:.1f} ms"
+            assert exc.retry_after_s > 0
+        for _, final, _ in served:
+            assert final is not None
+            assert len(final.outputs[0].token_ids) == SP["max_tokens"]
+        # DEGRADED-while-shedding, with counters in the report.
+        report = await engine.check_health()
+        assert report.state == "DEGRADED"
+        assert report.sheds_total == 4
+        assert report.overload["sheds_total"] == 4
+        assert report.overload["queue_depth"] == 0
+
+    asyncio.run(go())
+    # (a) shed requests never touched the allocator...
+    assert sorted(allocs) == [f"burst-{i}" for i in range(4)]
+    # (c) ...and the storm leaked nothing.
+    assert bm.get_num_free_gpu_blocks() == free0
+    assert not bm.block_tables, "ghost block tables after the burst"
+    assert engine.engine.admission.sheds_total == 4
+
+
+# ------------------------------------------------------------------
+# deadline expiry in `waiting`
+# ------------------------------------------------------------------
+
+def test_deadline_expiry_in_waiting_is_free(tiny_model_dir):
+    """(d): a queued request whose deadline passes before it is ever
+    scheduled is aborted by expiry — no pages allocated, no schedule
+    round consumed, typed RequestTimeoutError on the fault seam."""
+    engine = _sync_engine(tiny_model_dir, max_num_seqs=1)
+    free0 = engine.scheduler.block_manager.get_num_free_gpu_blocks()
+    # A occupies the single seq slot; B must wait.
+    engine.add_request("A", None, SamplingParams(**SP),
+                       prompt_token_ids=_prompt(0))
+    engine.step()
+    engine.add_request(
+        "B", None,
+        SamplingParams(ttft_slo_s=0.01, **SP),
+        prompt_token_ids=_prompt(1))
+    assert len(engine.scheduler.waiting) == 1
+    time.sleep(0.05)                      # B's deadline passes
+
+    # Scheduler-level: expiry needs no schedule round at all.
+    expired = engine.scheduler.expire_waiting(time.monotonic())
+    assert [g.request_id for g in expired] == ["B"]
+    assert not engine.scheduler.waiting
+    assert "B" not in {g.request_id for g in engine.scheduler.running}
+
+    # Engine-level: the same path surfaces the typed error via the
+    # step-fault seam (re-queue B', then let step() expire it).
+    engine.add_request(
+        "B2", None, SamplingParams(ttft_slo_s=0.01, **SP),
+        prompt_token_ids=_prompt(2))
+    time.sleep(0.05)
+    engine.step()
+    faults = engine.drain_step_faults()
+    assert [rid for rid, _ in faults] == ["B2"]
+    assert all(isinstance(exc, RequestTimeoutError)
+               for _, exc in faults)
+    assert engine.admission.expired_total >= 1
+    while engine.has_unfinished_requests():
+        engine.step()
+    assert engine.scheduler.block_manager.get_num_free_gpu_blocks() \
+        == free0
+
+
+def test_deadline_expiry_surfaces_typed_error_on_stream(
+        tiny_model_dir):
+    """Async: the expired request's stream raises RequestTimeoutError
+    (not a generic engine error), while the running request
+    completes."""
+    engine = _async_engine(tiny_model_dir, max_num_seqs=1)
+
+    async def go():
+        async def long_req():
+            final = None
+            async for out in engine.generate(
+                    None,
+                    SamplingParams(temperature=0.0, max_tokens=48,
+                                   ignore_eos=True),
+                    "long", prompt_token_ids=_prompt(0)):
+                final = out
+            return final
+
+        async def doomed():
+            async for _ in engine.generate(
+                    None, SamplingParams(ttft_slo_s=0.005, **SP),
+                    "doomed", prompt_token_ids=_prompt(1)):
+                pass
+
+        long_task = asyncio.create_task(long_req())
+        await asyncio.sleep(0.05)         # long is running
+        with pytest.raises(RequestTimeoutError):
+            await doomed()
+        final = await long_task
+        assert len(final.outputs[0].token_ids) == 48
+
+    asyncio.run(go())
+
+
+# ------------------------------------------------------------------
+# disconnect propagation
+# ------------------------------------------------------------------
+
+def test_disconnect_storm_zero_ghost_tables(tiny_model_dir):
+    """Consumers that stop iterating (client gone — generator dropped
+    without abort) must release their KV within the storm: afterwards
+    zero ghost block tables and free pages == free0."""
+    engine = _async_engine(tiny_model_dir)
+    bm = engine.engine.scheduler.block_manager
+    free0 = bm.get_num_free_gpu_blocks()
+
+    async def one(i, hang_up):
+        sp = SamplingParams(temperature=0.0, max_tokens=16,
+                            ignore_eos=True)
+        gen = engine.generate(None, sp, f"dc-{i}",
+                              prompt_token_ids=_prompt(i))
+        n = 0
+        final = None
+        async for out in gen:
+            final = out
+            n += 1
+            if hang_up and n >= 1:
+                break                     # client hangs up; NO abort
+        del gen
+        return final if not hang_up else None
+
+    async def go():
+        results = await asyncio.gather(
+            *(one(i, hang_up=(i % 2 == 0)) for i in range(10)))
+        # Dropped generators finalize via the loop's asyncgen hooks;
+        # the GeneratorExit path aborts each request, and the engine
+        # loop then frees the pages.
+        for _ in range(200):
+            gc.collect()
+            await asyncio.sleep(0.02)
+            if not engine.engine.has_unfinished_requests() and \
+                    not bm.block_tables:
+                break
+        assert not engine.engine.has_unfinished_requests()
+        # Survivors (odd i) completed fully.
+        for i, final in enumerate(results):
+            if i % 2 == 1:
+                assert final is not None
+                assert len(final.outputs[0].token_ids) == 16
+
+    asyncio.run(go())
+    assert not bm.block_tables, \
+        "ghost block tables survived the disconnect storm"
+    assert bm.get_num_free_gpu_blocks() == free0
+
+
+def test_stream_del_and_cancel_route_through_abort():
+    """AsyncStream finalization paths: cancel() and __del__ both
+    route through the abort callback exactly once; finish() disarms
+    them."""
+    from aphrodite_tpu.engine.async_aphrodite import AsyncStream
+
+    aborted = []
+    s = AsyncStream("r1", abort_cb=aborted.append)
+    s.cancel()
+    s.cancel()                            # idempotent
+    assert aborted == ["r1"]
+
+    aborted.clear()
+    s2 = AsyncStream("r2", abort_cb=aborted.append)
+    del s2
+    gc.collect()
+    assert aborted == ["r2"]
+
+    aborted.clear()
+    s3 = AsyncStream("r3", abort_cb=aborted.append)
+    s3.finish()
+    s3.cancel()
+    del s3
+    gc.collect()
+    assert aborted == []                  # finished: nothing to abort
+
+
+# ------------------------------------------------------------------
+# preemption-storm damping + low-watermark admission
+# ------------------------------------------------------------------
+
+def _make_scheduler(num_gpu_blocks, **kw):
+    from aphrodite_tpu.common.config import (CacheConfig,
+                                             SchedulerConfig)
+    from aphrodite_tpu.processing.scheduler import Scheduler
+    cache_config = CacheConfig(block_size=4)
+    cache_config.num_gpu_blocks = num_gpu_blocks
+    cache_config.num_cpu_blocks = 16
+    defaults = dict(max_num_batched_tokens=256, max_num_seqs=8,
+                    max_model_len=256, max_paddings=1024)
+    defaults.update(kw)
+    return Scheduler(SchedulerConfig(**defaults), cache_config, None)
+
+
+_seq_counter = iter(range(100_000))
+
+
+def _make_group(request_id, prompt_len=7):
+    from aphrodite_tpu.common.sequence import Sequence, SequenceGroup
+    seq = Sequence(next(_seq_counter), "x", list(range(prompt_len)), 4)
+    return SequenceGroup(request_id, [seq], SamplingParams(),
+                         arrival_time=0.0)
+
+
+def _fill_to_boundary(group, n=1):
+    from aphrodite_tpu.common.sequence import SequenceStatus
+    for seq in group.get_seqs(status=SequenceStatus.RUNNING):
+        for _ in range(n):
+            tok = seq.get_len()
+            seq.append_token_id(tok, {tok: 0.0})
+
+
+def test_preempt_budget_defers_instead_of_cascading(monkeypatch):
+    """With budget 1, a round where every row needs a page preempts
+    exactly ONE victim; the rest skip the round holding their pages
+    (no cascade RECOMPUTE) and stay schedulable."""
+    from aphrodite_tpu.common.sequence import SequenceStatus
+    monkeypatch.setenv("APHRODITE_PREEMPT_BUDGET", "1")
+    sched = _make_scheduler(num_gpu_blocks=8)
+    groups = [_make_group(f"g{i}") for i in range(4)]
+    for g in groups:
+        sched.add_seq_group(g)
+    _, out = sched.schedule()
+    assert len(out.prompt_chunks) == 4    # 4 x 2 blocks = full pool
+    for g in groups:
+        _fill_to_boundary(g, 2)           # 7+2=9 -> all need block 3
+    _, out2 = sched.schedule()
+    preempted = [g for g in groups
+                 if g.get_seqs()[0].status == SequenceStatus.WAITING]
+    assert len(preempted) == 1, \
+        "budget 1 must preempt exactly one victim"
+    # Exactly one row deferred: running but not decoded this round.
+    deferred = [g for g in sched.running
+                if g not in out2.decode_groups]
+    assert len(deferred) == 1
+    (dg,) = deferred
+    assert dg.get_seqs()[0].status == SequenceStatus.RUNNING
+    assert sched.block_manager.block_tables[
+        dg.get_seqs()[0].seq_id], "deferred row lost its pages"
+    assert len(out2.decode_groups) == 2
+
+
+def test_low_watermark_admission_never_forces_preemption(monkeypatch):
+    """With the low watermark set, admission defers a prompt that
+    would leave the running rows without append headroom — the
+    test_preemption_by_recompute scenario then decodes with ZERO
+    preemptions."""
+    from aphrodite_tpu.common.sequence import SequenceStatus
+    monkeypatch.setenv("APHRODITE_PAGE_LOW_WATERMARK", "0.25")
+    sched = _make_scheduler(num_gpu_blocks=4)
+    g1, g2 = _make_group("r1"), _make_group("r2")
+    sched.add_seq_group(g1)
+    sched.add_seq_group(g2)
+    _, out = sched.schedule()
+    # Without the watermark both admit (and round 2 preempts one);
+    # with it, g2 defers in waiting.
+    assert [c.group.request_id for c in out.prompt_chunks] == ["r1"]
+    assert [g.request_id for g in sched.waiting] == ["r2"]
+    _fill_to_boundary(g1, 2)
+    _, out2 = sched.schedule()            # r1 crosses a page boundary
+    assert g1.get_seqs()[0].status == SequenceStatus.RUNNING
+    assert g1 in out2.decode_groups
+    assert sched.waiting[0].request_id == "r2"
+
+
+# ------------------------------------------------------------------
+# HTTP 429 semantics
+# ------------------------------------------------------------------
+
+def test_openai_429_with_retry_after(tiny_model_dir, monkeypatch):
+    """The OpenAI frontend maps RequestRejectedError to HTTP 429 with
+    a Retry-After header while admitted requests still answer 200."""
+    monkeypatch.setenv("APHRODITE_MAX_QUEUE_DEPTH", "2")
+    from aiohttp.test_utils import TestClient, TestServer
+    from aphrodite_tpu.endpoints.openai.api_server import build_app
+
+    async def go():
+        engine = _async_engine(tiny_model_dir,
+                               skip_tokenizer_init=False,
+                               max_num_seqs=2)
+        client = TestClient(TestServer(build_app(engine, "tiny")))
+        await client.start_server()
+        try:
+            async def post(i):
+                r = await client.post("/v1/completions", json={
+                    "model": "tiny", "prompt": "hello world " * 4,
+                    "max_tokens": 8, "ignore_eos": True})
+                return r.status, dict(r.headers), await r.json()
+
+            results = await asyncio.gather(*(post(i) for i in range(8)))
+        finally:
+            await client.close()
+        statuses = [s for s, _, _ in results]
+        assert 429 in statuses and 200 in statuses, statuses
+        for status, headers, body in results:
+            if status == 429:
+                assert int(headers["Retry-After"]) >= 1
+                assert body["type"] == "overloaded_error"
+
+    asyncio.run(go())

@@ -58,6 +58,33 @@ def test_serving_harness_chaos_mode(tiny_model_dir, monkeypatch):
     assert c["degraded_ttft_p99"] >= 0
 
 
+def test_serving_harness_overload_mode(tiny_model_dir, monkeypatch):
+    """--overload JSON artifact: the offered rate doubles, deadlines
+    and the disconnect storm are applied, and the `overload` section
+    reports goodput, shed/expired/served/disconnected counts, shed
+    rejection latency, and a zero KV leak (free pages == free0)."""
+    sys.path.insert(0, "benchmarks")
+    from serving import run
+
+    monkeypatch.delenv("APHRODITE_PAGE_LOW_WATERMARK", raising=False)
+    # A 2-deep queue cap forces real shedding even on the tiny model.
+    monkeypatch.setenv("APHRODITE_MAX_QUEUE_DEPTH", "2")
+    result = asyncio.run(run(_args(
+        tiny_model_dir, num_requests=10, max_num_seqs=2,
+        request_rate=float("inf"), overload=True, overload_mult=2.0,
+        deadline_s=30.0, disconnect_rate=0.3, chaos_seed=1)))
+    o = result["detail"]["overload"]
+    assert (o["requests_served"] + o["requests_shed"]
+            + o["requests_expired"] + o["requests_disconnected"]
+            + o["requests_failed"]) == 10
+    assert o["requests_shed"] >= 1, o
+    assert o["requests_served"] >= 1, o
+    assert o["rejection_ms_max"] < 100, o
+    assert o["kv_leak_pages"] == 0, o
+    assert o["goodput_out_tok_s"] > 0
+    assert o["sheds_total"] >= o["requests_shed"]
+
+
 def test_serving_harness_chaos_fault_free_matches_baseline(
         tiny_model_dir, monkeypatch):
     """A fault-free --chaos run (no spec, no aborts) must report every

@@ -50,7 +50,7 @@ DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(
     os.path.abspath(__file__)), "allowlist.json")
 
 _RULE_ORDER = ("PARSE", "FLAG", "VMEM", "DMA", "GRID", "SYNC", "REF",
-               "SHARD", "RECOMP", "EXC")
+               "SHARD", "RECOMP", "EXC", "BP")
 
 
 @dataclasses.dataclass

@@ -16,10 +16,14 @@ Rule ID families:
 - EXC001..EXC002       — exception-handling hygiene on the supervised
                          step path (silent swallows, discarded
                          CancelledError)
+- BP001                — bounded-queue hygiene: unbounded
+                         asyncio.Queue/deque construction on the
+                         serving path without a registered bound
 """
-from tools.aphrocheck.passes import (dma_pass, exc_pass, flag_pass,
-                                     grid_pass, recomp_pass, ref_pass,
-                                     shard_pass, sync_pass, vmem_pass)
+from tools.aphrocheck.passes import (bound_pass, dma_pass, exc_pass,
+                                     flag_pass, grid_pass, recomp_pass,
+                                     ref_pass, shard_pass, sync_pass,
+                                     vmem_pass)
 
 ALL_PASSES = (
     ("FLAG", flag_pass.run),
@@ -31,4 +35,5 @@ ALL_PASSES = (
     ("SHARD", shard_pass.run),
     ("RECOMP", recomp_pass.run),
     ("EXC", exc_pass.run),
+    ("BP", bound_pass.run),
 )

@@ -1,0 +1,128 @@
+"""BP pass: bounded-queue hygiene on the serving path.
+
+PR 7's overload-control layer exists because unbounded queues between
+the HTTP frontends and the scheduler turned overload into goodput
+collapse: every enqueue is a promise, and a queue nothing bounds is a
+promise nothing keeps. This pass keeps the invariant from silently
+regressing as new queues appear.
+
+- BP001: an `asyncio.Queue()` / `collections.deque()` constructed
+  WITHOUT a capacity bound (`maxsize=`/`maxlen=`, non-zero) in the
+  engine/endpoints scope (`aphrodite_tpu/engine/`,
+  `aphrodite_tpu/endpoints/`), unless the construction carries a
+  registered bound: a `# bounded-by: <reason>` comment on the same
+  line or in the contiguous comment block directly above, naming WHY
+  the queue cannot grow without limit (admission-capped upstream,
+  one-entry-per-tracked-request, reader-paced...). The scheduler's
+  deques (`processing/`) are exempt — they are bounded by the
+  admission controller by construction, which is the layer this rule
+  protects.
+
+An `asyncio.Queue(0)`/`maxsize=0` counts as unbounded (that is
+asyncio's "infinite" spelling); a non-literal bound expression counts
+as bounded (the value is configuration, the INTENT is a bound).
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from tools.aphrocheck.core import (Finding, dotted_name, int_const,
+                                   keyword_arg)
+
+#: BP001 scope: the layers between a client connection and the
+#: scheduler, where an unbounded queue defeats admission control.
+_HOT_PREFIXES = ("aphrodite_tpu/engine/", "aphrodite_tpu/endpoints/")
+
+#: Everything the CLI normally scans; explicitly-passed files outside
+#: these roots (the seeded fixtures) are treated as hot-path scope.
+_SCAN_PREFIXES = ("aphrodite_tpu/", "benchmarks/", "bench.py")
+
+#: The pragma registering a bound for a deliberately capacity-free
+#: queue (same line or the contiguous comment block directly above).
+_PRAGMA = "bounded-by:"
+
+
+def _in_scope(rel: str) -> bool:
+    rel = rel.replace("\\", "/")
+    if any(rel.startswith(p) for p in _HOT_PREFIXES):
+        return True
+    return not any(rel == p.rstrip("/") or rel.startswith(p)
+                   for p in _SCAN_PREFIXES)
+
+
+def _queue_kind(call: ast.Call) -> Optional[str]:
+    """'queue' for asyncio.Queue-family constructors, 'deque' for
+    collections.deque, else None."""
+    name = dotted_name(call.func)
+    if name is None:
+        return None
+    head, _, tail = name.rpartition(".")
+    if tail in ("Queue", "LifoQueue", "PriorityQueue") and \
+            head in ("", "asyncio"):
+        return "queue"
+    if tail == "deque" and head in ("", "collections"):
+        return "deque"
+    return None
+
+
+def _is_bounded(call: ast.Call, kind: str) -> bool:
+    if kind == "queue":
+        bound = keyword_arg(call, "maxsize")
+        if bound is None and call.args:
+            bound = call.args[0]
+    else:
+        bound = keyword_arg(call, "maxlen")
+        if bound is None and len(call.args) >= 2:
+            bound = call.args[1]
+    if bound is None:
+        return False
+    if isinstance(bound, ast.Constant) and bound.value is None:
+        return False                      # deque(maxlen=None)
+    if int_const(bound) == 0:
+        return False                      # asyncio's "infinite"
+    return True                           # literal or config expression
+
+
+def _has_pragma(module, lineno: int) -> bool:
+    if _PRAGMA in module.line_text(lineno):
+        return True
+    line = lineno - 1
+    while line >= 1:
+        text = module.line_text(line).strip()
+        if not text.startswith("#"):
+            return False
+        if _PRAGMA in text:
+            return True
+        line -= 1
+    return False
+
+
+def run(ctx) -> List[Finding]:
+    findings: List[Finding] = []
+    for module in ctx.modules:
+        if not _in_scope(module.rel):
+            continue
+        for call in module.calls:
+            kind = _queue_kind(call)
+            if kind is None or _is_bounded(call, kind):
+                continue
+            if _has_pragma(module, call.lineno):
+                continue
+            findings.append(module.finding(
+                "BP001", call,
+                "unbounded queue construction on the serving path; "
+                "give it a capacity (maxsize/maxlen), register the "
+                "bound with a `# bounded-by: <reason>` comment, or "
+                "allowlist it"))
+    return findings
+
+
+#: (rule, one-line contract, example) — rendered by `--rules-md`.
+RULES = (
+    ("BP001", "`asyncio.Queue()`/`deque()` constructed without a "
+     "capacity bound in the `engine/`/`endpoints/` scope and without "
+     "a `# bounded-by: <reason>` comment registering why it cannot "
+     "grow unboundedly",
+     "`self._backlog = asyncio.Queue()` with no bound or pragma"),
+)
