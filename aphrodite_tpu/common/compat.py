@@ -22,3 +22,25 @@ def get_shard_map():
         return sm
     from jax.experimental import shard_map as _legacy
     return _legacy.shard_map
+
+
+def get_context_mesh():
+    """The Mesh the caller is tracing under (`with mesh:`), or None.
+
+    The layer code annotates activations with bare `PartitionSpec`s
+    that only resolve against a context mesh; outside any mesh the
+    annotations must vanish entirely (single-chip jit has no mesh and
+    with_sharding_constraint would raise). The thread-local lives at
+    different paths across jax versions, so the probe belongs here."""
+    try:
+        from jax.interpreters import pxla
+        mesh = pxla.thread_resources.env.physical_mesh
+    except (ImportError, AttributeError):
+        try:
+            from jax._src import mesh as _mesh_lib
+            mesh = _mesh_lib.thread_resources.env.physical_mesh
+        except (ImportError, AttributeError):
+            return None
+    if mesh is None or mesh.empty:
+        return None
+    return mesh

@@ -288,6 +288,17 @@ class ParallelConfig:
                            data_parallel_size * sequence_parallel_size)
         self._verify_args()
 
+    #: Mesh axis names, in executor.build_mesh's construction order.
+    MESH_AXES = ("dp", "pp", "sp", "tp")
+
+    @property
+    def mesh_shape(self) -> tuple:
+        """(dp, pp, sp, tp) — ONE source of truth for the mesh layout,
+        shared by executor.build_mesh and the bench harnesses' output
+        JSON (so every capture records the topology it ran on)."""
+        return (self.data_parallel_size, self.pipeline_parallel_size,
+                self.sequence_parallel_size, self.tensor_parallel_size)
+
     def _verify_args(self) -> None:
         for name, value in (
             ("pipeline_parallel_size", self.pipeline_parallel_size),

@@ -93,8 +93,10 @@ class EngineArgs:
                             "TPU build has no Ray workers")
         parser.add_argument("--pipeline-parallel-size", "-pp", type=int,
                             default=1)
-        parser.add_argument("--tensor-parallel-size", "-tp", type=int,
-                            default=1)
+        # --tp is the spelling the bench harnesses document; all three
+        # land on tensor_parallel_size.
+        parser.add_argument("--tensor-parallel-size", "-tp", "--tp",
+                            type=int, default=1)
         parser.add_argument("--data-parallel-size", "-dp", type=int,
                             default=1)
         parser.add_argument("--sequence-parallel-size", "-sp", type=int,

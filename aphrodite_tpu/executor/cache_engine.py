@@ -36,6 +36,20 @@ logger = init_logger(__name__)
 
 KVCache = Tuple[jax.Array, jax.Array]
 
+
+def kv_partition_spec(num_heads: int, mesh: Mesh) -> P:
+    """PartitionSpec for one layer's [pages, page, heads*dim] KV plane.
+
+    Lane partition == head partition (heads are contiguous lane
+    blocks), so dividing kv heads shard over "tp"; fewer KV heads than
+    chips replicate the pages, exactly as the reference replicates KV
+    heads when heads < tp (common/config.py:265-273). One function so
+    CacheEngine allocation, the model runner's plan, and tests agree
+    on the spec by construction."""
+    if num_heads % mesh.shape["tp"] == 0:
+        return P(None, None, "tp")
+    return P(None, None, None)
+
 _CACHE_DTYPES = {
     "auto": None,                 # follow model dtype
     "fp8": jnp.float8_e5m2,
@@ -122,21 +136,23 @@ class CacheEngine:
                      num_heads * self.head_size)
             z = jnp.zeros(shape, dtype=self.dtype)
             if self.mesh is not None:
-                tp = self.mesh.shape["tp"]
-                if num_heads % tp == 0:
-                    # Lane partition == head partition (heads are
-                    # contiguous lane blocks).
-                    spec = P(None, None, "tp")
-                else:
-                    # Fewer KV heads than chips: replicate the pages,
-                    # exactly as the reference replicates KV heads when
-                    # heads < tp (common/config.py:265-273).
-                    spec = P(None, None, None)
-                z = jax.device_put(z, NamedSharding(self.mesh, spec))
+                z = jax.device_put(z, NamedSharding(
+                    self.mesh, kv_partition_spec(num_heads, self.mesh)))
             return z
 
         return [(alloc(heads), alloc(heads))
                 for heads in self.kv_heads_per_layer]
+
+    def kv_shardings(self) -> Optional[List[NamedSharding]]:
+        """Per-layer NamedSharding of the KV planes (None off-mesh) —
+        the explicit spec record tests and the runner's sharding plan
+        check against."""
+        if self.mesh is None:
+            return None
+        return [
+            NamedSharding(self.mesh, kv_partition_spec(heads, self.mesh))
+            for heads in self.kv_heads_per_layer
+        ]
 
     @property
     def num_slots(self) -> int:

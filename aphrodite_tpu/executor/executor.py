@@ -51,13 +51,10 @@ def build_mesh(parallel_config: ParallelConfig,
         raise ValueError(
             f"world_size {parallel_config.world_size} exceeds available "
             f"devices ({len(devices)}).")
-    shape = (parallel_config.data_parallel_size,
-             parallel_config.pipeline_parallel_size,
-             parallel_config.sequence_parallel_size,
-             parallel_config.tensor_parallel_size)
     mesh_devices = np.asarray(
-        devices[:parallel_config.world_size]).reshape(shape)
-    return Mesh(mesh_devices, ("dp", "pp", "sp", "tp"))
+        devices[:parallel_config.world_size]).reshape(
+            parallel_config.mesh_shape)
+    return Mesh(mesh_devices, ParallelConfig.MESH_AXES)
 
 
 class TPUExecutor:
@@ -79,6 +76,13 @@ class TPUExecutor:
         self.lora_config = lora_config
 
         self.mesh = build_mesh(parallel_config, device_config)
+        if self.mesh is not None:
+            logger.info(
+                "SPMD mesh %s over %d %s devices: weights "
+                "column/row-sharded on tp, KV pages lane(=head)-"
+                "sharded, batch inputs replicated",
+                dict(self.mesh.shape), self.mesh.size,
+                jax.devices()[0].platform)
         logger.info("Loading model %s ...", model_config.model)
         self.model, self.params = get_model(model_config, self.mesh,
                                             lora_config)
@@ -108,6 +112,15 @@ class TPUExecutor:
                 write_slot_fn=self.model_runner.write_lora_slot,
                 clear_slot_fn=self.model_runner.clear_lora_slot,
                 module_layouts=layouts_from_model(self.model))
+
+    @property
+    def mesh_shape(self) -> Optional[Tuple[int, int, int, int]]:
+        """(dp, pp, sp, tp) of the live mesh, None single-device —
+        recorded by the bench harnesses next to every number."""
+        if self.mesh is None:
+            return None
+        return tuple(int(self.mesh.shape[a])
+                     for a in ("dp", "pp", "sp", "tp"))
 
     # -- sizing --
 

@@ -18,6 +18,7 @@ Reference: `aphrodite/task_handler/model_runner.py` (_prepare_prompt
 from __future__ import annotations
 
 import bisect
+import contextlib
 import functools
 from typing import Dict, List, Optional, Tuple
 
@@ -112,12 +113,30 @@ class ModelRunner:
         self.mesh = mesh
         self.kv_scale = kv_scale            # int8 KV dequant scale
         self.sp = sp                        # ring-prefill routing
+        # Mesh sharding plan for the step programs: weights and KV
+        # pages arrive committed with their NamedShardings (loader /
+        # CacheEngine); every host-built batch input is committed
+        # REPLICATED here (dp>1 would shard the batch dim instead —
+        # see the README Multichip section for why dp is descoped).
+        # Explicit specs on every operand mean GSPMD solves no layout
+        # inference for the inputs: the per-layer collectives are the
+        # ones the layer annotations (layers/linear.py shard_along)
+        # declare, which is what the MULTICHIP ICI cost model priced.
+        self._tp = int(mesh.shape["tp"]) if mesh is not None else 1
+        self._input_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+            self._input_sharding = NamedSharding(mesh, P())
         # Whether the Pallas prefill page writer can ever run (TPU +
-        # fp page dtype): gates building its cell descriptors at all —
-        # ineligible configs skip the host loop and keep ONE jit
-        # treedef for aligned and unaligned prompts.
+        # fp page dtype + single-device mesh — the writer is a
+        # per-chip program; tp-sharded pages take the scatter path):
+        # gates building its cell descriptors at all — ineligible
+        # configs skip the host loop and keep ONE jit treedef for
+        # aligned and unaligned prompts.
         self._prefill_writer_ok = (
             jax.default_backend() == "tpu" and
+            (mesh is None or mesh.size == 1) and
             kv_cache_dtype in (jnp.bfloat16, jnp.float32) and
             page_size % 8 == 0)
         self.sampler = Sampler(model_config.get_vocab_size())
@@ -159,6 +178,30 @@ class ModelRunner:
             donate_argnums=(3,),      # kv_caches
         )
         self._copy_fn = jax.jit(self._copy_blocks, donate_argnums=(0,))
+
+    # ---- mesh placement helpers ----
+
+    def _dev(self, arr):
+        """Host array -> device, with the batch-input sharding made
+        EXPLICIT under a mesh (replicated NamedSharding; the same one
+        host->device transfer jnp.asarray pays, now with a declared
+        placement instead of a GSPMD guess)."""
+        if self._input_sharding is None:
+            return jnp.asarray(arr)
+        return jax.device_put(arr, self._input_sharding)
+
+    def _dev_tree(self, tree):
+        """Commit every leaf of a pytree (sampler knob tensors)."""
+        if self._input_sharding is None:
+            return tree
+        return jax.device_put(tree, self._input_sharding)
+
+    def _mesh_ctx(self):
+        """Context every jitted dispatch runs under: the mesh (so the
+        layer annotations' bare PartitionSpecs resolve at trace time),
+        or a no-op for single-chip."""
+        return self.mesh if self.mesh is not None else \
+            contextlib.nullcontext()
 
     # ---- jitted bodies ----
 
@@ -299,7 +342,7 @@ class ModelRunner:
                 idx[row:row + n_rows] = slot
             row += n_rows
         from aphrodite_tpu.lora.layers import LORA_IDX
-        arr = jnp.asarray(idx)
+        arr = self._dev(idx)
         params = dict(self.params)
         for key in self.lora_buckets:
             params[key] = {**self.params[key], LORA_IDX: arr}
@@ -439,16 +482,17 @@ class ModelRunner:
                             f"prefill cell layout not identity: "
                             f"{sblk[cell]} != {cell}")
                     vld[cell] = min(n - p * ps, ps)
-            prefill_cells = (jnp.asarray(pid), jnp.asarray(sblk),
-                             jnp.asarray(vld))
+            prefill_cells = (self._dev(pid), self._dev(sblk),
+                             self._dev(vld))
 
         metadata = InputMetadata(
-            slot_mapping=jnp.asarray(slots),
-            block_tables=jnp.asarray(tables),
-            context_lens=jnp.asarray(ctx_lens),
-            prompt_lens=jnp.asarray(plens),
+            slot_mapping=self._dev(slots),
+            block_tables=self._dev(tables),
+            context_lens=self._dev(ctx_lens),
+            prompt_lens=self._dev(plens),
             kv_scale=self.kv_scale,
             sp=self.sp,
+            tp=self._tp,
             prefill_cells=prefill_cells,
         )
         prompt_offsets = [int(c) for c in ctx_lens[:batch]]
@@ -466,8 +510,8 @@ class ModelRunner:
         padded_rows = -(-num_rows // _PAGES_BUCKET) * _PAGES_BUCKET
         sel = np.zeros((padded_rows,), dtype=np.int32)
         sel[:num_rows] = selected
-        inputs = dict(input_ids=jnp.asarray(ids), positions=jnp.asarray(pos),
-                      metadata=metadata, sel=jnp.asarray(sel),
+        inputs = dict(input_ids=self._dev(ids), positions=self._dev(pos),
+                      metadata=metadata, sel=self._dev(sel),
                       num_rows=num_rows,
                       is_prompt=True, use_prefix=use_prefix,
                       newly_computed=newly_computed)
@@ -576,11 +620,12 @@ class ModelRunner:
             pad_to=padded_batch * min(mix, chunks_cap))
 
         metadata = InputMetadata(
-            slot_mapping=jnp.asarray(slots),
-            block_tables=jnp.asarray(tables),
-            context_lens=jnp.asarray(ctx_lens),
+            slot_mapping=self._dev(slots),
+            block_tables=self._dev(tables),
+            context_lens=self._dev(ctx_lens),
             kv_scale=self.kv_scale,
-            decode_work=(jnp.asarray(wi_seq), jnp.asarray(wi_chunk)),
+            tp=self._tp,
+            decode_work=(self._dev(wi_seq), self._dev(wi_chunk)),
             decode_ppc=ppc,
         )
         sampling = SamplingMetadata(
@@ -593,9 +638,10 @@ class ModelRunner:
         )
         # sel covers the whole padded batch (stable shape per bucket);
         # pad rows are sliced off before sampling.
-        inputs = dict(input_ids=jnp.asarray(ids),
-                      positions=jnp.asarray(pos_arr), metadata=metadata,
-                      sel=jnp.arange(padded_batch, dtype=jnp.int32),
+        inputs = dict(input_ids=self._dev(ids),
+                      positions=self._dev(pos_arr), metadata=metadata,
+                      sel=self._dev(np.arange(padded_batch,
+                                              dtype=np.int32)),
                       num_rows=batch,
                       is_prompt=False, use_prefix=False)
         return inputs, sampling
@@ -624,8 +670,9 @@ class ModelRunner:
         dst_arr = np.full((padded,), oob, dtype=np.int32)
         src_arr[:len(src)] = src
         dst_arr[:len(dst)] = dst
-        return self._copy_fn(kv_caches, jnp.asarray(src_arr),
-                             jnp.asarray(dst_arr))
+        with self._mesh_ctx():
+            return self._copy_fn(kv_caches, self._dev(src_arr),
+                                 self._dev(dst_arr))
 
     def execute_model(
         self,
@@ -671,21 +718,26 @@ class ModelRunner:
             # Raw-logits routes: host logits processors need the
             # logits mid-pipeline; logprob requests need the full
             # log-softmax rows. Two device programs.
-            logits, kv_caches = self._step_fn(
-                params, inputs["input_ids"], inputs["positions"],
-                kv_caches, inputs["metadata"], inputs["sel"],
-                is_prompt=inputs["is_prompt"],
-                use_prefix=inputs["use_prefix"])
+            with self._mesh_ctx():
+                logits, kv_caches = self._step_fn(
+                    params, inputs["input_ids"], inputs["positions"],
+                    kv_caches, inputs["metadata"], inputs["sel"],
+                    is_prompt=inputs["is_prompt"],
+                    use_prefix=inputs["use_prefix"])
             self._mark_prefixes(inputs)
             if has_processors:
                 output = self.sampler(logits[:inputs["num_rows"]],
                                       sampling)
                 return output, kv_caches
-            packed, logprobs_dev = _fused_sample_jit(
-                logits, plan.tensors, jnp.asarray(plan.bases),
-                jnp.asarray(plan.salt1), jnp.asarray(plan.salt2),
-                max_best_of=plan.max_best_of, num_topk=plan.num_topk,
-                need_logprobs=plan.need_logprobs)
+            with self._mesh_ctx():
+                packed, logprobs_dev = _fused_sample_jit(
+                    logits, self._dev_tree(plan.tensors),
+                    self._dev(np.asarray(plan.bases)),
+                    self._dev(np.asarray(plan.salt1)),
+                    self._dev(np.asarray(plan.salt2)),
+                    max_best_of=plan.max_best_of,
+                    num_topk=plan.num_topk,
+                    need_logprobs=plan.need_logprobs)
             packed_np = np.asarray(packed)
             t4 = _time.perf_counter() if timing else 0.0
             output = self.sampler.finalize(sampling, plan, packed_np,
@@ -701,14 +753,17 @@ class ModelRunner:
 
         # Fast path: model + fused sampler as ONE device program; the
         # only blocking transfer per round is the packed result pull.
-        packed, kv_caches = self._step_sample_fn(
-            params, inputs["input_ids"], inputs["positions"],
-            kv_caches, inputs["metadata"], inputs["sel"],
-            plan.tensors, jnp.asarray(plan.bases),
-            jnp.asarray(plan.salt1), jnp.asarray(plan.salt2),
-            is_prompt=inputs["is_prompt"],
-            use_prefix=inputs["use_prefix"],
-            max_best_of=plan.max_best_of, num_topk=plan.num_topk)
+        with self._mesh_ctx():
+            packed, kv_caches = self._step_sample_fn(
+                params, inputs["input_ids"], inputs["positions"],
+                kv_caches, inputs["metadata"], inputs["sel"],
+                self._dev_tree(plan.tensors),
+                self._dev(np.asarray(plan.bases)),
+                self._dev(np.asarray(plan.salt1)),
+                self._dev(np.asarray(plan.salt2)),
+                is_prompt=inputs["is_prompt"],
+                use_prefix=inputs["use_prefix"],
+                max_best_of=plan.max_best_of, num_topk=plan.num_topk)
         self._mark_prefixes(inputs)
         t2 = _time.perf_counter() if timing else 0.0
         packed_np = np.asarray(packed)                     # ONE sync
@@ -743,13 +798,16 @@ class ModelRunner:
         params = self._params_with_lora(
             seq_group_metadata_list, inputs["input_ids"].shape[0],
             [1] * len(seq_group_metadata_list))
-        packed, kv_caches = self._step_sample_fn(
-            params, inputs["input_ids"], inputs["positions"], kv_caches,
-            inputs["metadata"], inputs["sel"], plan.tensors,
-            jnp.asarray(plan.bases), jnp.asarray(plan.salt1),
-            jnp.asarray(plan.salt2), is_prompt=True,
-            use_prefix=inputs["use_prefix"],
-            max_best_of=plan.max_best_of, num_topk=plan.num_topk)
+        with self._mesh_ctx():
+            packed, kv_caches = self._step_sample_fn(
+                params, inputs["input_ids"], inputs["positions"],
+                kv_caches, inputs["metadata"], inputs["sel"],
+                self._dev_tree(plan.tensors),
+                self._dev(np.asarray(plan.bases)),
+                self._dev(np.asarray(plan.salt1)),
+                self._dev(np.asarray(plan.salt2)), is_prompt=True,
+                use_prefix=inputs["use_prefix"],
+                max_best_of=plan.max_best_of, num_topk=plan.num_topk)
         self._mark_prefixes(inputs)
         return StepHandle(packed, sampling, plan), kv_caches
 
@@ -832,18 +890,19 @@ class ModelRunner:
                 r = min(cap_of.get(seq_id, num_steps), num_steps)
                 pos_cap[row, 0] = data.get_len() - 1 + r
                 row += 1
-        greedy_mask = jnp.asarray(greedy)
-        tensors = plan.tensors
-        bases = jnp.asarray(plan.bases)
-        salt1 = jnp.asarray(plan.salt1)
-        salt2 = jnp.asarray(plan.salt2)
+        greedy_mask = self._dev(greedy)
+        tensors = self._dev_tree(plan.tensors)
+        bases = self._dev(np.asarray(plan.bases))
+        salt1 = self._dev(np.asarray(plan.salt1))
+        salt2 = self._dev(np.asarray(plan.salt2))
 
         ids, pos, meta = (inputs["input_ids"], inputs["positions"],
                           inputs["metadata"])
-        packed, kv_caches = self._burst_scan_fn(
-            params, ids, pos, kv_caches, meta, tensors, bases, salt1,
-            salt2, greedy_mask, jnp.asarray(pos_cap),
-            num_steps=num_steps, max_best_of=plan.max_best_of,
-            num_topk=plan.num_topk)
+        with self._mesh_ctx():
+            packed, kv_caches = self._burst_scan_fn(
+                params, ids, pos, kv_caches, meta, tensors, bases,
+                salt1, salt2, greedy_mask, self._dev(pos_cap),
+                num_steps=num_steps, max_best_of=plan.max_best_of,
+                num_topk=plan.num_topk)
         return StepHandle(packed, sampling, plan,
                           num_steps=num_steps), kv_caches

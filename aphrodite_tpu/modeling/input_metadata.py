@@ -46,6 +46,13 @@ class InputMetadata:
     decode_work: Optional[tuple] = None
 
     is_prompt: bool = struct.field(pytree_node=False, default=False)
+    # Tensor-parallel degree of the mesh the step runs on (1 = single
+    # device). Static: it routes kernel selection — the Pallas paged
+    # attention / KV-writer kernels are single-device programs, so a
+    # tp-sharded KV cache must take the GSPMD-partitionable jnp paths
+    # until they are shard_map-wrapped (the TPLA prefill/decode split
+    # seam). Constant per engine, so it adds no compiles.
+    tp: int = struct.field(pytree_node=False, default=1)
     # Prefill against a non-empty cached prefix (prefix caching / chunked
     # prefill); selects the gather-from-pages prefill path.
     use_prefix: bool = struct.field(pytree_node=False, default=False)

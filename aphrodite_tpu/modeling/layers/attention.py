@@ -89,7 +89,11 @@ class PagedAttention:
             from aphrodite_tpu.ops.pallas.kv_write import (
                 can_use_pallas_writer, write_kv_pages_prefill)
             hd = k_pages.shape[2]
+            # Single-device meshes only: the Pallas writer is a
+            # per-chip program — under tp-sharded pages it would force
+            # GSPMD to replicate the cache around the custom call.
             pallas_write = (jax.default_backend() == "tpu" and
+                            metadata.tp == 1 and
                             can_use_pallas_writer(k_pages.dtype,
                                                   k_pages.shape[1], hd))
             if (pallas_write and metadata.is_prompt and
@@ -147,14 +151,18 @@ class PagedAttention:
         return (k_pages is not None and
                 not metadata.is_prompt and
                 self.sliding_window is None and
-                self._pallas_decode_ok(k_pages))
+                self._pallas_decode_ok(k_pages, metadata))
 
-    def _pallas_decode_ok(self, k_pages) -> bool:
+    def _pallas_decode_ok(self, k_pages, metadata) -> bool:
         quant_ok = k_pages.dtype in (jnp.bfloat16, jnp.float32) or (
             k_pages.dtype in (jnp.int8, jnp.float8_e5m2) and
             k_pages.shape[1] % 32 == 0)     # 8-bit sublane tile
+        # metadata.tp > 1: KV pages are lane-sharded over the mesh and
+        # the Pallas kernel is a single-device program; take the
+        # GSPMD-partitionable jnp reference path instead (the
+        # shard_map wrap is the disaggregated-prefill follow-on seam).
         return (self.use_pallas and jax.default_backend() == "tpu"
-                and quant_ok)
+                and metadata.tp == 1 and quant_ok)
 
     def _prefill(self, q, k, v, k_pages, v_pages,
                  metadata: InputMetadata) -> jax.Array:
@@ -242,7 +250,7 @@ class PagedAttention:
         # Quantized pages (int8/fp8) run in-kernel: the int8 scale folds
         # into the score scale and output epilogue (see ops/kv_quant.py).
         from aphrodite_tpu.ops.kv_quant import dequant_scale
-        if self._pallas_decode_ok(k_pages):
+        if self._pallas_decode_ok(k_pages, metadata):
             from aphrodite_tpu.ops.pallas.paged_attention import (
                 paged_decode_attention)
             slopes = None if self.alibi_slopes is None else \

@@ -12,6 +12,18 @@ for row-parallel). Under `jit` over a Mesh, GSPMD partitions the matmuls and
 inserts the all-reduce that the reference performs manually in
 `RowParallelLinear.forward` (`linear.py:562-565`).
 
+Activation shardings are EXPLICIT, not inferred: when the step traces
+under a mesh context (`ModelRunner` enters `with mesh:` around every
+jitted dispatch), each layer pins its output with
+`with_sharding_constraint` — column-parallel outputs sharded "tp" on
+the feature dim, row-parallel outputs replicated (which is exactly
+where GSPMD must place the per-layer all-reduce the MULTICHIP ICI
+cost model priced: o_proj + down_proj, ~2/layer). Without the pins
+GSPMD solves a global layout problem whose answer can drift between
+compiler versions and batch shapes; with them the collective schedule
+is part of the source. Outside a mesh the annotations vanish
+(`shard_along` is a no-op), so single-chip programs are unchanged.
+
 Weight layout is [in_features, out_features] (x @ W) — transposed from the
 HF/torch [out, in] layout at load time — so the contraction dim is the
 leading dim XLA prefers for MXU tiling.
@@ -33,6 +45,21 @@ from jax.sharding import PartitionSpec as P
 
 ParamDict = Dict[str, jax.Array]
 SpecDict = Dict[str, P]
+
+
+def shard_along(x: jax.Array, axis: Optional[str]) -> jax.Array:
+    """Pin x's LAST dim to mesh axis `axis` (None = fully replicated)
+    when tracing under a mesh that actually partitions that axis;
+    identity otherwise (single-chip jit, or a trivial 1-sized axis)."""
+    from aphrodite_tpu.common.compat import get_context_mesh
+    mesh = get_context_mesh()
+    if mesh is None:
+        return x
+    if axis is not None and mesh.shape.get(axis, 1) <= 1:
+        return x
+    spec = P() if axis is None else \
+        P(*([None] * (x.ndim - 1) + [axis]))
+    return jax.lax.with_sharding_constraint(x, spec)
 
 
 class LinearMethod:
@@ -89,6 +116,12 @@ class LinearBase:
 
     out_axis: Optional[str] = None
     in_axis: Optional[str] = None
+    # Activation pin applied to the layer OUTPUT under a mesh context:
+    # False = leave GSPMD free (replicated weights put no constraint
+    # on the output), else the `shard_along` axis ("tp" for
+    # column-parallel, None = replicate-here for row-parallel, which
+    # is the explicit all-reduce point).
+    out_activation: object = False
 
     # Number of stacked sub-projections sharing this layer's matmul
     # (qkv = 3, gate_up = 2); LoRA sizes its merged rank by this.
@@ -114,7 +147,10 @@ class LinearBase:
                                                self.in_axis)
 
     def __call__(self, params: ParamDict, x: jax.Array) -> jax.Array:
-        return self.linear_method.apply(params, x)
+        y = self.linear_method.apply(params, x)
+        if self.out_activation is not False:
+            y = shard_along(y, self.out_activation)
+        return y
 
     def weight_loader(self, params: Dict[str, np.ndarray], name: str,
                       hf_tensor: np.ndarray,
@@ -139,14 +175,19 @@ class ReplicatedLinear(LinearBase):
 
 
 class ColumnParallelLinear(LinearBase):
-    """Output dim sharded over the tp axis (reference `linear.py:132`)."""
+    """Output dim sharded over the tp axis (reference `linear.py:132`).
+    Output activations stay feature-sharded — the following row-parallel
+    matmul contracts over that same dim, so no collective lands here."""
     out_axis = "tp"
+    out_activation = "tp"
 
 
 class RowParallelLinear(LinearBase):
     """Input dim sharded over tp; GSPMD inserts the psum the reference
-    calls explicitly (`linear.py:562-565`)."""
+    calls explicitly (`linear.py:562-565`). The output pin to
+    replicated is the explicit placement of that all-reduce."""
     in_axis = "tp"
+    out_activation = None
 
 
 class _ShardedLoadMixin(LinearBase):

@@ -195,7 +195,32 @@ class GGUFLinearMethod(LinearMethod):
         # default) and the group shape allows it, else Q4_K-at-rest.
         # (Real loads build buckets from scratch per tensor format —
         # Q8_0/Q6_K keep exact int8 forms even under turbo — so these
-        # shapes only ever serve dummy weights.)
+        # shapes only ever serve dummy weights.) BENCH_GGUF_FMT picks
+        # the at-rest form instead, so the per-format scoreboard rows
+        # (Q8_0 / Q6_K exact paths vs the turbo requant) each have a
+        # runnable dummy-weight bench command.
+        import os as _os
+        fmt = _os.environ.get("BENCH_GGUF_FMT", "")
+        if fmt == "q8_0" and in_features % 32 == 0:
+            params = {
+                "qs": jnp.zeros((in_features, out_features),
+                                dtype=jnp.int8),
+                "d": jnp.zeros((in_features // 32, out_features),
+                               dtype=jnp.float32),
+            }
+            if bias:
+                params["bias"] = jnp.zeros((out_features,), dtype=dtype)
+            return params
+        if fmt == "q6_k" and in_features % 16 == 0:
+            params = {
+                "qs": jnp.zeros((in_features, out_features),
+                                dtype=jnp.int8),
+                "d16": jnp.zeros((in_features // 16, out_features),
+                                 dtype=jnp.float32),
+            }
+            if bias:
+                params["bias"] = jnp.zeros((out_features,), dtype=dtype)
+            return params
         if gguf_turbo() and in_features % 128 == 0:
             params = {
                 "qs8": jnp.zeros((in_features, out_features),

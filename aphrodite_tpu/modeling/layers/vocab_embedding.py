@@ -54,7 +54,12 @@ class VocabParallelEmbedding:
 
     def __call__(self, params: Dict[str, jax.Array],
                  input_ids: jax.Array) -> jax.Array:
-        return jnp.take(params["weight"], input_ids, axis=0)
+        from aphrodite_tpu.modeling.layers.linear import shard_along
+        # Vocab-sharded table -> GSPMD masked-lookup + all-reduce; the
+        # hidden states entering the layer stack are pinned replicated
+        # (the residual stream's declared layout under tp).
+        return shard_along(
+            jnp.take(params["weight"], input_ids, axis=0), None)
 
     def weight_loader(self, params: Dict[str, np.ndarray], name: str,
                       hf_tensor: np.ndarray, shard_id=None) -> None:
@@ -75,6 +80,12 @@ class ParallelLMHead(VocabParallelEmbedding):
     def compute_logits(self, params: Dict[str, jax.Array],
                        hidden: jax.Array) -> jax.Array:
         """hidden [..., hidden_dim] -> logits [..., org_vocab] (padding
-        columns sliced off so host-side sampling sees the true vocab)."""
-        logits = hidden @ params["weight"].T
+        columns sliced off so host-side sampling sees the true vocab).
+        Under a mesh the full-width logits are pinned vocab-sharded —
+        each chip computes its vocab shard's columns locally and the
+        sampler's reductions (argmax/softmax) gather via compiler-
+        inserted collectives, the reference's explicit gather
+        (`sampler.py:47-60`) expressed as a spec."""
+        from aphrodite_tpu.modeling.layers.linear import shard_along
+        logits = shard_along(hidden @ params["weight"].T, "tp")
         return logits[..., :self.org_vocab_size]

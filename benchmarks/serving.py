@@ -102,6 +102,7 @@ async def run(args) -> dict:
         dtype=args.dtype, max_num_seqs=args.max_num_seqs,
         max_model_len=args.max_model_len, quantization=args.quantization,
         kv_cache_dtype=args.kv_cache_dtype,
+        tensor_parallel_size=int(getattr(args, "tp", 1) or 1),
         skip_tokenizer_init=True, disable_log_stats=True,
         multi_step=args.multi_step))
     vocab = engine.engine.model_config.get_vocab_size()
@@ -300,9 +301,16 @@ async def run(args) -> dict:
         # 0.0 (not None) for empty series: round() downstream.
         return float(np.percentile(np.asarray(xs), p)) if xs else 0.0
 
+    mesh_shape = engine.engine.executor.mesh_shape
+    import jax as _jax
     detail = {
         "request_rate": args.request_rate,
         "num_requests": args.num_requests,
+        # Topology of record: (dp, pp, sp, tp) of the serving mesh
+        # (null = one device) and the backend it ran on, so a
+        # virtual-mesh capture is never mistaken for hardware.
+        "mesh": list(mesh_shape) if mesh_shape else None,
+        "backend": _jax.default_backend(),
         "throughput_out_tok_s": round(
             outcomes["survived"] * args.output_len / wall, 1),
         "ttft_p50": round(pct(ttfts, 50), 4),
@@ -400,6 +408,13 @@ def main() -> None:
     parser.add_argument("--max-num-seqs", type=int, default=256)
     parser.add_argument("--max-model-len", type=int, default=2048)
     parser.add_argument("--multi-step", type=int, default=8)
+    parser.add_argument("--tp", "--tensor-parallel-size", type=int,
+                        default=1, dest="tp",
+                        help="tensor-parallel degree: shard the "
+                             "persistent step over a (1,1,1,tp) mesh "
+                             "(requires >= tp visible devices; use "
+                             "XLA_FLAGS=--xla_force_host_platform_"
+                             "device_count=N for a virtual CPU mesh)")
     parser.add_argument("--request-rate", type=float, default=4.0,
                         help="poisson requests/s (inf = all at once)")
     parser.add_argument("--num-requests", type=int, default=128)

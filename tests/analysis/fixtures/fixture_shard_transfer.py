@@ -1,0 +1,19 @@
+"""Seeded SHARD004 violation: a host transfer of a mesh-sharded array
+inside an executor-scope hot-path function — fires EXACTLY once.
+
+The second transfer pulls a small per-step RESULT (`packed`), which is
+the engine's one-sync-per-round contract and must stay quiet; the
+third sits in a non-hot helper (prepare_*), also quiet.
+"""
+import numpy as np
+
+
+class FixtureRunner:
+
+    def execute_model(self, kv_caches, handle):
+        pulled = np.asarray(kv_caches[0])          # SHARD004: KV plane
+        packed = np.asarray(handle.packed)         # quiet: step result
+        return pulled, packed
+
+    def prepare_inputs(self, kv_caches):
+        return np.asarray(kv_caches[0])            # quiet: not hot-path

@@ -155,6 +155,7 @@ def test_scan_covers_benches():
     (shard_pass.run, "fixture_shard_axis.py", "SHARD001"),
     (shard_pass.run, "fixture_shard_rank.py", "SHARD002"),
     (shard_pass.run, "fixture_shard_import.py", "SHARD003"),
+    (shard_pass.run, "fixture_shard_transfer.py", "SHARD004"),
     (recomp_pass.run, "fixture_recomp_if.py", "RECOMP001"),
     (recomp_pass.run, "fixture_recomp_shape.py", "RECOMP002"),
     (recomp_pass.run, "fixture_recomp_fstring.py", "RECOMP003"),
@@ -282,6 +283,27 @@ def test_shard_fixtures_stay_precise():
     assert [f.rule for f in a] == ["SHARD001"]
     r = _pass_findings(shard_pass.run, [_fixture("fixture_shard_rank.py")])
     assert [f.rule for f in r] == ["SHARD002"]
+    t = _pass_findings(shard_pass.run,
+                       [_fixture("fixture_shard_transfer.py")])
+    assert [f.rule for f in t] == ["SHARD004"], [f.render() for f in t]
+    # ... and SYNC stays quiet on it: the seeded transfer is not in a
+    # loop, so the two passes' contracts do not overlap.
+    s = _pass_findings(sync_pass.run,
+                       [_fixture("fixture_shard_transfer.py")])
+    assert not s, [f.render() for f in s]
+
+
+def test_shard004_scope_exempts_non_executor():
+    """SHARD004 is executor-scope: the engine's step loop and the
+    cache engine's cold swap path (np.asarray of whole KV planes in
+    swap_out — a deliberate, scheduler-paced transfer) stay quiet;
+    the gate proves the hot side on the real executor files."""
+    findings = _pass_findings(
+        shard_pass.run,
+        ["aphrodite_tpu/engine/aphrodite_engine.py",
+         "aphrodite_tpu/executor/cache_engine.py"])
+    assert not [f for f in findings if f.rule == "SHARD004"], \
+        [f.render() for f in findings]
 
 
 # ------------------------------------------------------------------
@@ -418,7 +440,7 @@ def test_cli_rules_md_and_readme_drift():
     assert proc.returncode == 0, proc.stderr
     table = proc.stdout.strip()
     for rule in ("FLAG001", "FLAG006", "VMEM001", "DMA003", "GRID002",
-                 "SYNC003", "REF001", "REF004", "SHARD003",
+                 "SYNC003", "REF001", "REF004", "SHARD003", "SHARD004",
                  "RECOMP003", "EXC001", "EXC002", "BP001"):
         assert f"| {rule} |" in table, f"{rule} missing from rules-md"
     with open(os.path.join(REPO_ROOT, "README.md"),

@@ -326,7 +326,7 @@ def _routing_layer(sliding_window):
                            sliding_window=sliding_window)
     # Pretend the kernel path is available (CPU test hosts report
     # backend != tpu); the ROUTING predicate is what's under test.
-    layer._pallas_decode_ok = lambda k_pages: True
+    layer._pallas_decode_ok = lambda k_pages, metadata: True
     return layer
 
 
@@ -364,7 +364,7 @@ def test_layer_passes_work_list_to_kernel(monkeypatch):
         return jnp.zeros_like(q3)
     monkeypatch.setattr(pa, "paged_decode_attention", fake_kernel)
     layer = PagedAttention(8, 128, 0.1, num_kv_heads=2)
-    layer._pallas_decode_ok = lambda k_pages: True
+    layer._pallas_decode_ok = lambda k_pages, metadata: True
     pages = jnp.zeros((64, 8, 2 * 128), jnp.float32)
     work = build_decode_work_list([2, 1], 2)
     meta = InputMetadata(
@@ -402,6 +402,8 @@ def test_model_runner_builds_consistent_work_list():
     runner.num_slots = 16 * 1024
     runner.kv_scale = 1.0
     runner.pages_bucket = 8
+    runner._input_sharding = None      # single-device placement plan
+    runner._tp = 1
     runner.model_config = SimpleNamespace(
         get_sliding_window=lambda: None)
 

@@ -25,7 +25,23 @@ def test_serving_harness(tiny_model_dir):
     assert d["ttft_p50"] > 0 and d["ttft_p99"] >= d["ttft_p50"]
     assert d["e2e_p50"] >= d["ttft_p50"]
     assert d["throughput_out_tok_s"] > 0
+    assert d["mesh"] is None        # single device: topology recorded
     assert "chaos" not in d
+
+
+def test_serving_harness_tp_mesh(tiny_model_dir):
+    """--tp 2 serves through the async engine on the virtual mesh and
+    records the (dp, pp, sp, tp) topology + backend in the JSON, so a
+    capture can never silently drop its mesh provenance."""
+    sys.path.insert(0, "benchmarks")
+    from serving import run
+
+    result = asyncio.run(run(_args(tiny_model_dir, tp=2,
+                                   num_requests=4, output_len=4)))
+    d = result["detail"]
+    assert d["mesh"] == [1, 1, 1, 2]
+    assert d["backend"] == "cpu"
+    assert d["throughput_out_tok_s"] > 0
 
 
 def test_serving_harness_chaos_mode(tiny_model_dir, monkeypatch):

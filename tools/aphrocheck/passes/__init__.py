@@ -11,7 +11,9 @@ Rule ID families:
 - GRID001..GRID002     — grid arity vs index-map/scalar-prefetch arity
 - SYNC001..SYNC003     — execute_model hot-path host-sync/retrace hazards
 - REF001..REF004       — in-kernel ref bounds/dtype abstract interpretation
-- SHARD001..SHARD003   — PartitionSpec/mesh consistency, deprecated imports
+- SHARD001..SHARD004   — PartitionSpec/mesh consistency, deprecated
+                         imports, host transfers of mesh-sharded
+                         arrays on the executor hot path
 - RECOMP001..RECOMP003 — jit recompile/trace-time hazards
 - EXC001..EXC002       — exception-handling hygiene on the supervised
                          step path (silent swallows, discarded

@@ -22,10 +22,24 @@ error that names neither the spec nor the layer that owns it.
   `jax.shard_map` (VERDICT r5 item #9). The version-bridge module
   (aphrodite_tpu/common/compat.py) is exempt: it probes the current
   API first and is the ONE place the legacy path may live.
+- SHARD004: a host transfer (`.item()`, `np.asarray`/`np.array`,
+  `jax.device_get`) of a MESH-SHARDED array inside an executor-scope
+  (`aphrodite_tpu/executor/`) hot-path (`execute_*`/`dispatch_*`/
+  `finalize_*`) function. Pulling a tp-sharded KV plane or parameter
+  is a cross-device all-gather plus a multi-GB device->host copy per
+  call — the exact class of silent step-time cliff the multichip
+  sharding plan exists to avoid. "Mesh-sharded" is the repo's naming
+  convention for the committed-sharded set (the same contract by
+  which HOT_NAME defines the hot path): identifiers `kv_caches`,
+  `new_caches`, `caches`, `kv`, `k_pages`, `v_pages`, `params`, and
+  `.kv_caches` attribute reads. Small per-step RESULTS (`packed`,
+  logits rows) transfer freely — one pull per round is the engine's
+  sync contract, policed by SYNC001/002.
 """
 from __future__ import annotations
 
 import ast
+import re
 from typing import List, Optional, Set, Tuple
 
 from tools.aphrocheck.core import (COMPAT_MODULE, Finding, Module,
@@ -35,6 +49,27 @@ from tools.aphrocheck.core import (COMPAT_MODULE, Finding, Module,
 _SPEC_NAMES = ("PartitionSpec", "P")
 _MESH_NAMES = ("Mesh", "make_mesh")
 _ARRAY_CTORS = ("zeros", "ones", "full", "empty")
+
+#: SHARD004 hot-path shape (shared contract with sync_pass.HOT_NAME).
+_HOT_NAME = re.compile(r"^(execute_|dispatch_|finalize_)")
+
+#: SHARD004 scope: the executor layer, where the committed-sharded
+#: arrays (weights pytree, KV planes) live.
+_EXECUTOR_PREFIXES = ("aphrodite_tpu/executor/",)
+
+#: Everything the CLI normally scans; explicitly-passed files outside
+#: these roots (the seeded fixtures) are treated as executor scope.
+_SCAN_PREFIXES = ("aphrodite_tpu/", "benchmarks/", "bench.py")
+
+#: Identifiers that name the committed mesh-sharded set by repo
+#: convention (cache_engine KV planes, the loader's params pytree).
+_SHARDED_NAMES = frozenset((
+    "kv_caches", "new_caches", "caches", "kv", "k_pages", "v_pages",
+    "params",
+))
+
+_TRANSFER_CALLS = {"np.asarray", "np.array", "numpy.asarray",
+                   "numpy.array"}
 
 
 def _declared_axes(modules: List[Module]) -> Tuple[Set[str], bool]:
@@ -192,6 +227,57 @@ def _check_imports(module: Module, findings: List[Finding]) -> None:
                         "for jax<0.6 compatibility)"))
 
 
+def _executor_scope(rel: str) -> bool:
+    rel = rel.replace("\\", "/")
+    if any(rel.startswith(p) for p in _EXECUTOR_PREFIXES):
+        return True
+    return not any(rel == p.rstrip("/") or rel.startswith(p)
+                   for p in _SCAN_PREFIXES)
+
+
+def _sharded_operand(node: ast.AST) -> bool:
+    """True when the expression references the mesh-sharded set: a
+    convention name, or a `.kv_caches` attribute read."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and n.id in _SHARDED_NAMES:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in ("kv_caches",):
+            return True
+    return False
+
+
+def _check_host_transfers(module: Module,
+                          findings: List[Finding]) -> None:
+    if not _executor_scope(module.rel):
+        return
+    hot = [n for n in module.nodes
+           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+           and _HOT_NAME.match(n.name)]
+    for fn in hot:
+        for call in iter_calls(fn):
+            if isinstance(call.func, ast.Attribute) and \
+                    call.func.attr == "item" and not call.args:
+                if _sharded_operand(call.func.value):
+                    findings.append(module.finding(
+                        "SHARD004", call,
+                        f".item() on a mesh-sharded array in hot-path "
+                        f"function {fn.name}: a cross-device gather + "
+                        "host sync per element"))
+                continue
+            callee = dotted_name(call.func) or ""
+            is_transfer = callee in _TRANSFER_CALLS or \
+                tail_name(call.func) == "device_get"
+            if is_transfer and call.args and \
+                    _sharded_operand(call.args[0]):
+                findings.append(module.finding(
+                    "SHARD004", call,
+                    f"{callee or 'device_get'} of a mesh-sharded "
+                    f"array in hot-path function {fn.name}: pulls the "
+                    "whole sharded buffer (all-gather + device->host "
+                    "copy) every step; keep KV/params device-resident "
+                    "and transfer only the packed step results"))
+
+
 def run(ctx) -> List[Finding]:
     findings: List[Finding] = []
     axes, have_mesh = _declared_axes(ctx.modules)
@@ -208,6 +294,7 @@ def run(ctx) -> List[Finding]:
                             "rejects the spec at dispatch"))
         _check_rank(module, findings)
         _check_imports(module, findings)
+        _check_host_transfers(module, findings)
     return findings
 
 
@@ -222,4 +309,8 @@ RULES = (
     ("SHARD003", "deprecated `jax.experimental.shard_map` import "
      "outside the compat module",
      "`from jax.experimental.shard_map import shard_map`"),
+    ("SHARD004", "host transfer (`.item()`/`np.asarray`/`device_get`) "
+     "of a mesh-sharded array (KV planes, params) in an "
+     "executor-scope hot-path function",
+     "`np.asarray(kv_caches[0])` in `execute_model`"),
 )
