@@ -329,6 +329,10 @@ class QuipLinearMethod(LinearMethod):
                          transpose=True)
         # Wscale stays a traced multiply — float(tracer) would fail
         # under jit.
+        # perf-known: FOLD001 the Wscale multiply + cast feed the LUT
+        # kernel straight from HBM; folding it into the kernel's x
+        # prologue would drop one activation round trip (QuiP is not
+        # a headline path — fold when the kernel is next touched).
         xr = xr * params["Wscale"].astype(jnp.float32)
         if "qweight" in params:
             # 4-bit LUT codes at rest (see create_weights).

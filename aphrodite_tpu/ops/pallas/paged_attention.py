@@ -439,6 +439,11 @@ def _decode_kernel_tm(
         corr = jnp.exp(m_prev - m_new)
         p_exp = jnp.where(live, jnp.exp(s - m_new), 0.0)
         l_prev = l_scr[:, :1]
+        # perf-known: FOLD002 the online-softmax rescale multiplies
+        # (l and the [rows, d] accumulator below) are the VPU work
+        # AMLA's mul-by-add rewrite (arxiv 2509.25224) eliminates —
+        # ROADMAP item 2's attention follow-up, targets pre-identified
+        # here by the linter.
         l_new = l_prev * corr + jnp.sum(p_exp, axis=1, keepdims=True)
 
         v = v_buf[slot]                              # [chunk, hb*d]
@@ -706,6 +711,9 @@ def _decode_kernel_ragged(
         corr = jnp.exp(m_prev - m_new)
         p_exp = jnp.where(live, jnp.exp(s - m_new), 0.0)
         l_prev = l_scr[:, :1]
+        # perf-known: FOLD002 same AMLA mul-by-add candidate as the
+        # classic kernel (arxiv 2509.25224; ROADMAP item 2) — the
+        # ragged grid is where the rewrite will actually land.
         l_new = l_prev * corr + jnp.sum(p_exp, axis=1, keepdims=True)
 
         v = v_buf[slot]                              # [chunk, hb*d]

@@ -416,10 +416,18 @@ def _stream_kernel(*refs, layout: str, bits: int, k_tiles: int,
     @pl.when(k == k_tiles - 1)
     def _flush():
         if a8:
+            # perf-known: ROOF003 the LATENCY_r06 bs=1 residual — at
+            # k-run boundaries this single-plane flush + output write
+            # serialize with the next column block's first ring wait
+            # (parity needs ~620 GB/s effective vs the measured ~560);
+            # the fix is double-buffering the accumulator/output
+            # planes, tracked as ROADMAP item 2.
             o_ref[...] = (acc_ref[...] *
                           xs_ref[...].astype(jnp.float32)
                           ).astype(o_ref.dtype)
         else:
+            # perf-known: ROOF003 same k-run flush serialization as
+            # the a8 arm above (ROADMAP item 2).
             o_ref[...] = acc_ref[...].astype(o_ref.dtype)
 
 
@@ -703,6 +711,11 @@ def _quantize_activations_int8(x):
     xs = jnp.maximum(absmax, 1e-8) / 127.0
     x8 = jnp.clip(jnp.round(x.astype(jnp.float32) / xs), -127,
                   127).astype(jnp.int8)
+    # perf-known: FOLD001 this div/round/clip/cast chain costs one
+    # HBM round trip of the full activation block before every W4A8
+    # launch; the streamed grid keeps x VMEM-resident for the whole
+    # call, so the quantization belongs in the kernel prologue
+    # (Zen-Attention-style fold; ROADMAP item 2).
     return x8, xs
 
 

@@ -74,11 +74,42 @@ def _parse_args():
         "--gguf-fmt", choices=sorted(BASELINE_BY_GGUF_FMT), default=None,
         help="GGUF at-rest source format for BENCH_QUANT=gguf runs "
              "(per-format scoreboard rows). Overrides BENCH_GGUF_FMT.")
+    parser.add_argument(
+        "--no-roofline-gate", action="store_true",
+        help="skip the pre-run aphrocheck ROOF/FOLD gate (use when "
+             "deliberately benching a known regression)")
     return parser.parse_args()
+
+
+def _roofline_gate() -> None:
+    """Pre-run static perf gate: the aphrocheck ROOF/FOLD sweep (~2 s)
+    catches a kernel whose roofline estimate regressed vs ROOFLINE.json
+    BEFORE a 30-minute TPU run is spent measuring the regression.
+    Raises SystemExit with the findings; --no-roofline-gate skips."""
+    try:
+        from tools.aphrocheck import run as aphrocheck_run
+    except ImportError:
+        _log("roofline gate skipped: tools.aphrocheck not importable")
+        return
+    report = aphrocheck_run(rule_prefixes=["ROOF", "FOLD"])
+    if report.findings:
+        for f in report.findings:
+            _log(f"roofline gate: {f.render()}")
+        raise SystemExit(
+            "bench: aphrocheck ROOF/FOLD gate failed — fix the "
+            "regression, regenerate ROOFLINE.json (`python -m "
+            "tools.aphrocheck --roofline --json > ROOFLINE.json`), or "
+            "rerun with --no-roofline-gate")
+    _log("roofline gate: clean")
 
 
 def main() -> None:
     args = _parse_args()
+    if not args.no_roofline_gate and \
+            os.environ.get("BENCH_VIRTUAL") != "1":
+        # (the virtual-mesh re-exec child skips the re-check: the
+        # parent already gated this exact tree)
+        _roofline_gate()
     # CLI -> env so the virtual-mesh re-exec child (and gguf.py's
     # dummy-weight shaping) see one consistent configuration.
     if args.tp is not None:

@@ -37,6 +37,85 @@ GROUP = 128
 PAGE = 16
 
 
+def stream_roofline_static(m: int, K: int, N: int, gs: int = GROUP):
+    """Static per-layer-GEMM roofline estimate for the streamed W4A8
+    grid at a bench shape: the aphrocheck estimator runs over the real
+    `_stream_call` AST with the ACTUAL tile geometry bound (the same
+    sizing calls the wrapper makes), so this is the lint-time bound
+    evaluated at concrete numbers — printable next to the measured
+    us/layer to make estimate-vs-reality drift visible.
+
+    Returns dict(bytes_cell_lo, bytes_cell_hi, cells, bytes_total_lo,
+    bytes_total_hi, flops, floor_us) — flops is the analytic
+    2*m*K*N (the kernel body is a *refs kernel the static binder
+    cannot see into), floor_us the static byte floor at the v5e
+    ~820 GB/s spec."""
+    import jax.numpy as jnp
+    from aphrodite_tpu.ops.pallas.quant_matmul import (_STREAM_K_CAP,
+                                                       _stream_pf,
+                                                       _tile_k,
+                                                       _tile_mn)
+    from tools.aphrocheck import build_context
+    from tools.aphrocheck.passes import roofline_pass
+
+    block_m, block_n, padded_m = _tile_mn(m, N, jnp.bfloat16)
+    block_k = _tile_k(K, gs, cap=_STREAM_K_CAP)
+    n_slots = _stream_pf()
+    k_tiles, n_tiles = K // block_k, N // block_n
+    bindings = dict(
+        block_m=block_m, block_n=block_n, block_k=block_k,
+        padded_m=padded_m, n_slots=n_slots, k_tiles=k_tiles,
+        n_tiles=n_tiles, gpt=block_k // gs, gs=gs, bits=4,
+        qw_rows=block_k // 8, qw_cols=block_n, N=N)
+    ctx, _ = build_context(
+        rels=["aphrodite_tpu/ops/pallas/quant_matmul.py"])
+    est = next(e for e in roofline_pass.kernel_estimates(
+        ctx, bindings=bindings) if e.key.endswith("::_stream_call"))
+    cells = n_tiles * k_tiles
+    lo = est.per_cell_bytes.lo * cells
+    hi = est.per_cell_bytes.hi * cells
+    return {
+        "bytes_cell_lo": int(est.per_cell_bytes.lo),
+        "bytes_cell_hi": int(est.per_cell_bytes.hi),
+        "cells": cells,
+        "bytes_total_lo": int(lo),
+        "bytes_total_hi": int(hi),
+        "flops": 2 * m * K * N,
+        "floor_us": lo / (roofline_pass.HBM_GBPS * 1e9) * 1e6,
+    }
+
+
+def ragged_roofline_static(pages_per_chunk: int, page_size: int,
+                           hb: int, head_dim: int, kv_bytes_elt: int,
+                           num_items: int, group: int = 4):
+    """Static per-work-item estimate for the ragged decode attention
+    kernel: the estimator over `_paged_decode_impl` with the chunk
+    geometry bound. K+V ring traffic per item is the quantity of
+    record (the PROFILE_r05 decode attribution's GB/s column)."""
+    from tools.aphrocheck import build_context
+    from tools.aphrocheck.passes import roofline_pass
+
+    chunk_tokens = pages_per_chunk * page_size
+    bindings = dict(
+        chunk_tokens=chunk_tokens, hb=hb, head_dim=head_dim,
+        page_size=page_size, pages_per_chunk=pages_per_chunk,
+        lane_bytes=hb * head_dim * kv_bytes_elt, nw=num_items,
+        group=group, rows=group * hb)
+    ctx, _ = build_context(
+        rels=["aphrodite_tpu/ops/pallas/paged_attention.py"])
+    est = next(e for e in roofline_pass.kernel_estimates(
+        ctx, bindings=bindings)
+        if e.key.endswith("::_paged_decode_impl"))
+    return {
+        "bytes_cell_lo": int(est.per_cell_bytes.lo),
+        "bytes_cell_hi": int(est.per_cell_bytes.hi),
+        "items": num_items,
+        "bytes_total_lo": int(est.per_cell_bytes.lo) * num_items,
+        "floor_us": int(est.per_cell_bytes.lo) * num_items /
+        (roofline_pass.HBM_GBPS * 1e9) * 1e6,
+    }
+
+
 def device_bench(step, init, iters: int = 0, reps: int = 3,
                  slow: bool = False, donate: bool = False):
     """step: (carry, i) -> carry, pure device. Returns (s/iter, rtt)
@@ -103,9 +182,18 @@ def main() -> None:
     ap.add_argument("--iters", type=int, default=100)
     ap.add_argument("--only", type=str, default="",
                     help="comma list: qmm,a8,ab,dense,attn,kv,head,"
-                         "prefill,pglue,layer,burst,pstep,glue")
+                         "prefill,pglue,layer,burst,pstep,glue,"
+                         "roofline")
+    ap.add_argument("--no-roofline-gate", action="store_true",
+                    help="skip the pre-run aphrocheck ROOF/FOLD gate")
     args = ap.parse_args()
     only = set(args.only.split(",")) if args.only else None
+
+    if not args.no_roofline_gate:
+        # Pre-run static perf gate (~2 s): a roofline regression is
+        # cheaper to catch here than after a 30-minute TPU session.
+        from bench import _roofline_gate
+        _roofline_gate()
 
     def want(tag):
         return only is None or tag in only
@@ -211,6 +299,92 @@ def main() -> None:
             print(f"{M:4d} {c_us:7.1f}us {c_gbs:4.0f}GB/s "
                   f"{s_us:7.1f}us {s_gbs:4.0f}GB/s "
                   f"{c_us / s_us:7.2f}x")
+
+    # --- roofline calibration: the aphrocheck static estimates next
+    # to measured us/layer + effective GB/s, so estimate-vs-reality
+    # drift is visible in ONE table (streamed W4A8 matmul + ragged
+    # decode attention — the two kernels the ROOF/FOLD motivating
+    # findings live in). Static bytes come from the SAME AST walk the
+    # lint gate runs, evaluated at the real tile geometry. ---
+    if want("roofline"):
+        from aphrodite_tpu.ops.pallas.quant_matmul import gptq_matmul_a8
+        cal_rows = []
+        for M in (1, 16, 64):
+            meas_us = 0.0
+            static = {"bytes_total_lo": 0, "bytes_total_hi": 0,
+                      "flops": 0, "floor_us": 0.0}
+            for name, K, N in shapes:
+                st = stream_roofline_static(M, K, N)
+                for k in static:
+                    static[k] += st[k]
+                x = jax.random.normal(key, (M, K), dtype=jnp.bfloat16)
+                qw = jax.random.randint(key, (K // 8, N), 0, 2**31 - 1,
+                                        dtype=jnp.int32)
+                qz = jax.random.randint(key, (K // GROUP, N // 8), 0,
+                                        2**31 - 1, dtype=jnp.int32)
+                sc = jnp.ones((K // GROUP, N), dtype=jnp.bfloat16) * 0.01
+
+                def rstep(c, i, qw=qw, qz=qz, sc=sc):
+                    xx = c
+                    o = gptq_matmul_a8(xx, qw, qz, sc, bits=4,
+                                       group_size=GROUP, stream=True)
+                    return xx + o[:, :1] * jnp.bfloat16(1e-30)
+                s, rtt = device_bench(rstep, x)
+                rtts.append(rtt)
+                meas_us += s * 1e6
+            cal_rows.append((M, static, meas_us))
+        print(f"\n=== roofline calibration: streamed W4A8 matmul "
+              f"(4 GEMMs/layer; static = aphrocheck estimate at the "
+              f"real tile geometry) ===")
+        print(f"{'m':>4s} {'static MB/layer':>18s} {'floor us':>9s} "
+              f"{'meas us':>9s} {'eff GB/s':>9s} {'floor/meas':>10s}")
+        for M, st, meas_us in cal_rows:
+            eff = st["bytes_total_lo"] / (meas_us * 1e-6) / 1e9
+            print(f"{M:4d} {st['bytes_total_lo'] / 1e6:8.1f}"
+                  f"..{st['bytes_total_hi'] / 1e6:<8.1f} "
+                  f"{st['floor_us']:9.1f} {meas_us:9.1f} {eff:9.0f} "
+                  f"{st['floor_us'] / meas_us:10.2f}")
+
+        # ragged decode attention at the bench geometry
+        from aphrodite_tpu.ops.pallas.paged_attention import (
+            build_decode_work_list, choose_pages_per_chunk, head_block)
+        r_pps = -(-max(8, -(-ctx // PAGE)) // 8) * 8
+        r_npg = B * r_pps + 1
+        rkp = jax.random.normal(
+            key, (r_npg, PAGE, KV_HEADS * HEAD_DIM), dtype=jnp.bfloat16)
+        rvp = jax.random.normal(
+            key, (r_npg, PAGE, KV_HEADS * HEAD_DIM), dtype=jnp.bfloat16)
+        rtb = jnp.asarray(
+            np.random.randint(0, r_npg, (B, r_pps)), jnp.int32)
+        rcl = jnp.full((B,), ctx, dtype=jnp.int32)
+        rq = jax.random.normal(key, (B, HEADS, HEAD_DIM),
+                               dtype=jnp.bfloat16)
+        r_ppc = choose_pages_per_chunk(r_pps, PAGE, B)
+        r_work = build_decode_work_list([-(-ctx // PAGE)] * B, r_ppc)
+        hb = head_block(KV_HEADS)
+        n_items = int(r_work[1].shape[0]) * (KV_HEADS // hb)
+        ast_static = ragged_roofline_static(
+            r_ppc, PAGE, hb, HEAD_DIM, 2, n_items)
+
+        def rastep(c, i):
+            qq = c
+            o = paged_decode_attention(
+                qq, rkp, rvp, rtb, rcl, None, scale=0.0884,
+                pages_per_chunk=r_ppc, work_items=r_work)
+            return qq + o * jnp.bfloat16(1e-30)
+        s, rtt = device_bench(rastep, rq)
+        rtts.append(rtt)
+        meas_us = s * 1e6
+        akv = 2 * B * KV_HEADS * ctx * HEAD_DIM * 2
+        print(f"\n=== roofline calibration: ragged decode attention "
+              f"(b={B} ctx={ctx}; {n_items} work cells) ===")
+        print(f"  static ring bytes/cell "
+              f"{ast_static['bytes_cell_lo']:,}.."
+              f"{ast_static['bytes_cell_hi']:,}  "
+              f"analytic KV bytes {akv:,}")
+        print(f"  static floor {ast_static['floor_us']:.1f} us   "
+              f"measured {meas_us:.1f} us   "
+              f"KV eff {akv / (meas_us * 1e-6) / 1e9:.0f} GB/s")
 
     # --- W4A8 quantized matmuls (int8 MXU path), same shapes ---
     if want("a8"):

@@ -1,0 +1,21 @@
+"""Seeds FOLD001: a div/round/clip/cast elementwise chain quantizes
+the activation right before the kernel launch — one HBM round trip a
+kernel prologue could absorb."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _copy_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def launch(x, s):
+    xq = jnp.clip(jnp.round(x / s), -127, 127).astype(jnp.int8)
+    return pl.pallas_call(
+        _copy_kernel,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((32, 128), jnp.int8),
+    )(xq)

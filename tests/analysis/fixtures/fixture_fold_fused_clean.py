@@ -1,0 +1,26 @@
+"""Clean construct for FOLD001 precision: the scale + activation
+epilogue is ALREADY FUSED into the kernel body — the launcher passes
+raw operands and consumes the result untouched, so there is no
+kernel-adjacent chain and the pass must stay quiet."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _fused_kernel(x_ref, s_ref, o_ref):
+    acc = x_ref[...] * s_ref[...]
+    o_ref[...] = jnp.maximum(acc, 0.0).astype(o_ref.dtype)
+
+
+def launch(x, s):
+    out = pl.pallas_call(
+        _fused_kernel,
+        grid=(4,),
+        in_specs=[
+            pl.BlockSpec((8, 128), lambda i: (i, 0)),
+            pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32),
+    )(x, s)
+    return out.reshape(-1)

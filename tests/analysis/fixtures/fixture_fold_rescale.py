@@ -1,0 +1,32 @@
+"""Seeds FOLD002: the online-softmax rescale multiply — the
+accumulator is scaled by `exp(m_prev - m_new)` every chunk, the VPU
+work AMLA's mul-by-add rewrite eliminates."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _softmax_kernel(x_ref, o_ref, acc_ref, m_ref):
+    s = x_ref[...]
+    m_prev = m_ref[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    corr = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    acc_ref[...] = acc_ref[...] * corr + p
+    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+    o_ref[...] = acc_ref[...]
+
+
+def launch(x):
+    return pl.pallas_call(
+        _softmax_kernel,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((8, 128), jnp.float32),
+            pltpu.VMEM((8, 128), jnp.float32),
+        ],
+    )(x)

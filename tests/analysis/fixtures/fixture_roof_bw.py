@@ -1,0 +1,25 @@
+"""Seeds ROOF002: every dim resolves statically, and the cell's byte
+lower bound over its flop upper bound demands far more than the
+~820 GB/s v5e HBM spec — the MXU provably idles on DMA."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _starved_kernel(x_ref, w_ref, o_ref):
+    # 2*8*2048*128 flops against a ~280 KiB cell: ~13 TB/s demanded.
+    o_ref[...] = jnp.dot(x_ref[...], w_ref[...],
+                         preferred_element_type=jnp.float32)
+
+
+def launch(x, w):
+    return pl.pallas_call(
+        _starved_kernel,
+        grid=(4,),
+        in_specs=[
+            pl.BlockSpec((8, 2048), lambda i: (i, 0)),
+            pl.BlockSpec((2048, 128), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, i)),
+        out_shape=jax.ShapeDtypeStruct((32, 512), jnp.float32),
+    )(x, w)

@@ -1,0 +1,47 @@
+"""Seeds ROOF003: an explicit weight-stream ring whose SINGLE-PLANE
+accumulator is reset at k == 0 and flushed to the output ref at the
+run-final cell — the k-run boundary flush serializes with the next
+run's first ring wait (the LATENCY_r06 streamed-matmul residual)."""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_SLOTS = 2
+
+
+def _flush_kernel(x_hbm, o_ref, ring, sems, acc_ref, *, k_tiles):
+    w = pl.program_id(0)
+    k = jax.lax.rem(w, k_tiles)
+    slot = jax.lax.rem(w, _SLOTS)
+    cp = pltpu.make_async_copy(x_hbm.at[w], ring.at[slot],
+                               sems.at[slot])
+    cp.start()
+    cp.wait()
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    acc_ref[...] += ring[slot]
+
+    @pl.when(k == k_tiles - 1)
+    def _flush():
+        o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+
+def launch(x):
+    return pl.pallas_call(
+        functools.partial(_flush_kernel, k_tiles=4),
+        grid=(8,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((8, 128), lambda w: (0, w // 4)),
+        out_shape=jax.ShapeDtypeStruct((8, 256), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((_SLOTS, 8, 128), jnp.float32),
+            pltpu.SemaphoreType.DMA((_SLOTS,)),
+            pltpu.VMEM((8, 128), jnp.float32),
+        ],
+    )(x)

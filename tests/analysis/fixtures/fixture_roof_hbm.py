@@ -1,0 +1,23 @@
+"""Seeds ROOF001: the kernel reads its `memory_space=ANY` operand by
+direct subscript — synchronous HBM traffic no ring or compiler double
+buffer overlaps — instead of staging it through make_async_copy."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _hbm_kernel(w_hbm, x_ref, o_ref):
+    o_ref[...] = x_ref[...] + w_hbm[...]     # direct HBM read
+
+
+def launch(x, w):
+    return pl.pallas_call(
+        _hbm_kernel,
+        grid=(4,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32),
+    )(w, x)

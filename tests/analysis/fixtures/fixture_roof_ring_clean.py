@@ -1,0 +1,49 @@
+"""Clean construct for ROOF003 precision: the same depth-2 weight
+ring as fixture_roof_flush, but with the accumulator/output planes
+DOUBLE-BUFFERED (slot-indexed stores) — the fix ROOF003 prescribes.
+Must produce ZERO ROOF findings (and stay quiet under DMA/REF)."""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_SLOTS = 2
+
+
+def _ring_kernel(x_hbm, o_ref, ring, sems, acc_ref, *, k_tiles):
+    w = pl.program_id(0)
+    k = jax.lax.rem(w, k_tiles)
+    slot = jax.lax.rem(w, _SLOTS)
+    cp = pltpu.make_async_copy(x_hbm.at[w], ring.at[slot],
+                               sems.at[slot])
+    cp.start()
+    cp.wait()
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[slot] = jnp.zeros_like(acc_ref[slot])
+
+    acc_ref[slot] += ring[slot]
+
+    @pl.when(k == k_tiles - 1)
+    def _flush():
+        # the flush reads its own slot's plane: column n+1's ring can
+        # start filling while column n's plane drains
+        o_ref[...] = acc_ref[slot].astype(o_ref.dtype)
+
+
+def launch(x):
+    return pl.pallas_call(
+        functools.partial(_ring_kernel, k_tiles=4),
+        grid=(8,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((8, 128), lambda w: (0, w // 4)),
+        out_shape=jax.ShapeDtypeStruct((8, 256), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((_SLOTS, 8, 128), jnp.float32),
+            pltpu.SemaphoreType.DMA((_SLOTS,)),
+            pltpu.VMEM((_SLOTS, 8, 128), jnp.float32),
+        ],
+    )(x)

@@ -2,12 +2,12 @@
 
 Three layers:
 
-1. THE GATE: every pass (all 8 families) over the real tree
-   (`aphrodite_tpu/`, `bench.py`, `benchmarks/`) must produce zero
-   findings even with NO allowlist, the checked-in allowlist must
-   hold at most 5 entries (currently zero), none may be stale, the
-   checker itself must never import jax, and the full sweep must
-   finish under 2 s.
+1. THE GATE: every pass (all 12 families, the ROOF/FOLD perf rules
+   included) over the real tree (`aphrodite_tpu/`, `bench.py`,
+   `benchmarks/`) must produce zero findings even with NO allowlist,
+   the checked-in allowlist must hold at most 5 entries (currently
+   zero), none may be stale, the checker itself must never import
+   jax, and the full sweep must finish under 2 s.
 2. Seeded-violation fixtures: each rule fires EXACTLY ONCE on its
    fixture module in tests/analysis/fixtures/ (proving the pass
    detects what it claims — a checker that never fires is worse than
@@ -32,9 +32,10 @@ from tools.aphrocheck import DEFAULT_ALLOWLIST, build_context, run
 from tools.aphrocheck.core import (FLAGS_MODULE, REPO_ROOT, Allowlist,
                                    collect_files)
 from tools.aphrocheck.passes import (bound_pass, dma_pass, exc_pass,
-                                     flag_pass, grid_pass, recomp_pass,
-                                     ref_pass, shard_pass, sync_pass,
-                                     vmem_pass)
+                                     flag_pass, fold_pass, grid_pass,
+                                     recomp_pass, ref_pass,
+                                     roofline_pass, shard_pass,
+                                     sync_pass, vmem_pass)
 from tools.aphrocheck.registry import parse_registry
 
 FIXDIR = os.path.join("tests", "analysis", "fixtures")
@@ -73,10 +74,11 @@ def test_repo_is_clean():
 
 
 def test_repo_clean_without_allowlist():
-    """The stronger form of the gate: all 8 pass families produce
+    """The stronger form of the gate: all 12 pass families produce
     ZERO findings with no allowlist at all — every real finding the
-    new passes surfaced was fixed in-tree, so the allowlist ships
-    empty."""
+    new passes surfaced was fixed in-tree or registered in source
+    (perf-known pragmas for the ROOF/FOLD motivating findings), so
+    the allowlist ships empty."""
     report = run(allowlist_path=None)
     assert not report.findings, \
         "aphrocheck findings without allowlist:\n" + \
@@ -112,6 +114,8 @@ def test_checker_never_imports_jax():
          "import tools.aphrocheck.core; "
          "import tools.aphrocheck.sites; "
          "import tools.aphrocheck.registry; "
+         "import tools.aphrocheck.passes.roofline_pass; "
+         "import tools.aphrocheck.passes.fold_pass; "
          "assert 'jax' not in sys.modules, 'checker imports jax'; "
          "assert 'numpy' not in sys.modules, 'checker imports numpy'"],
         cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
@@ -162,6 +166,11 @@ def test_scan_covers_benches():
     (exc_pass.run, "fixture_exc_swallow.py", "EXC001"),
     (exc_pass.run, "fixture_exc_cancelled.py", "EXC002"),
     (bound_pass.run, "fixture_bp_unbounded.py", "BP001"),
+    (roofline_pass.run, "fixture_roof_hbm.py", "ROOF001"),
+    (roofline_pass.run, "fixture_roof_bw.py", "ROOF002"),
+    (roofline_pass.run, "fixture_roof_flush.py", "ROOF003"),
+    (fold_pass.run, "fixture_fold_chain.py", "FOLD001"),
+    (fold_pass.run, "fixture_fold_rescale.py", "FOLD002"),
 ])
 def test_rule_fires_exactly_once(pass_fn, fixture, rule):
     findings = _pass_findings(pass_fn, [_fixture(fixture)])
@@ -441,7 +450,9 @@ def test_cli_rules_md_and_readme_drift():
     table = proc.stdout.strip()
     for rule in ("FLAG001", "FLAG006", "VMEM001", "DMA003", "GRID002",
                  "SYNC003", "REF001", "REF004", "SHARD003", "SHARD004",
-                 "RECOMP003", "EXC001", "EXC002", "BP001"):
+                 "RECOMP003", "EXC001", "EXC002", "BP001", "ROOF001",
+                 "ROOF002", "ROOF003", "ROOF004", "FOLD001",
+                 "FOLD002"):
         assert f"| {rule} |" in table, f"{rule} missing from rules-md"
     with open(os.path.join(REPO_ROOT, "README.md"),
               encoding="utf-8") as f:

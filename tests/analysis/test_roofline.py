@@ -1,0 +1,333 @@
+"""aphrotune: roofline + fold-candidate pass tests.
+
+Four layers:
+
+1. Rule precision on the seeded fixtures (each ROOF/FOLD rule fires
+   exactly once and ONLY its rule; the depth-2 double-buffered ring
+   and the already-fused epilogue stay quiet).
+2. The ROOF004 baseline drift gate: missing-entry and regression
+   forms against crafted baselines, plus the tier-1 assertion that
+   the checked-in ROOFLINE.json byte-matches the current estimates.
+3. The motivating hand findings reproduce in-tree with pragmas
+   ignored — the streamed-matmul k-run flush serialization
+   (LATENCY_r06 residual) and the ragged-attention rescale multiply
+   (AMLA fold candidate) — while the gate stays green (pragmas
+   honored, allowlist EMPTY).
+4. The CLI surfaces (--roofline human/JSON, bare --rules lister) and
+   the bench-harness gate + profile_step calibration hooks.
+
+Pure AST except the calibration-hook tests, which import the kernel
+module's sizing helpers (CPU-only jnp dtype math, no device work).
+"""
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from tools.aphrocheck import build_context, run
+from tools.aphrocheck.core import REPO_ROOT
+from tools.aphrocheck.passes import fold_pass, roofline_pass
+
+FIXDIR = os.path.join("tests", "analysis", "fixtures")
+
+
+def _fixture(name: str) -> str:
+    return os.path.join(FIXDIR, name)
+
+
+def _findings(pass_mod, rels, full_scan=False, honor_pragmas=True):
+    ctx, parse_findings = build_context(REPO_ROOT, rels,
+                                        full_scan=full_scan)
+    assert not parse_findings, parse_findings
+    return pass_mod.findings(ctx, honor_pragmas=honor_pragmas)
+
+
+# ------------------------------------------------------------------
+# 1. fixture precision
+# ------------------------------------------------------------------
+
+@pytest.mark.parametrize("pass_mod,fixture,rule", [
+    (roofline_pass, "fixture_roof_hbm.py", "ROOF001"),
+    (roofline_pass, "fixture_roof_bw.py", "ROOF002"),
+    (roofline_pass, "fixture_roof_flush.py", "ROOF003"),
+    (fold_pass, "fixture_fold_chain.py", "FOLD001"),
+    (fold_pass, "fixture_fold_rescale.py", "FOLD002"),
+])
+def test_rule_fires_exactly_once_and_alone(pass_mod, fixture, rule):
+    """Each seeded fixture trips exactly its one rule (recall AND
+    precision — the other rules of the family stay quiet on it)."""
+    findings = _findings(pass_mod, [_fixture(fixture)])
+    assert [f.rule for f in findings] == [rule], \
+        f"{fixture}: {[f.render() for f in findings]}"
+
+
+def test_ring_clean_idiom_stays_quiet():
+    """The double-buffered (slot-indexed accumulator) depth-2 ring —
+    the fix ROOF003 prescribes — produces ZERO ROOF findings, and the
+    DMA/REF families agree the ring itself is sound."""
+    from tools.aphrocheck.passes import dma_pass, ref_pass
+    rels = [_fixture("fixture_roof_ring_clean.py")]
+    assert _findings(roofline_pass, rels) == []
+    ctx, _ = build_context(REPO_ROOT, rels, full_scan=False)
+    assert dma_pass.run(ctx) == []
+    assert ref_pass.run(ctx) == []
+
+
+def test_fused_epilogue_stays_quiet():
+    """A scale+activation epilogue already fused INTO the kernel body
+    is what FOLD001 asks for — it must not fire on it."""
+    assert _findings(fold_pass,
+                     [_fixture("fixture_fold_fused_clean.py")]) == []
+
+
+def test_seeded_fixtures_clean_under_other_families():
+    """The ROOF/FOLD fixtures seed ONLY their own families: the
+    kernel-contract passes (VMEM/DMA/GRID/REF) stay quiet on them."""
+    from tools.aphrocheck.passes import (dma_pass, grid_pass, ref_pass,
+                                         vmem_pass)
+    rels = [_fixture(f) for f in (
+        "fixture_roof_hbm.py", "fixture_roof_bw.py",
+        "fixture_roof_flush.py", "fixture_roof_drift.py",
+        "fixture_fold_chain.py", "fixture_fold_rescale.py",
+        "fixture_fold_fused_clean.py")]
+    ctx, parse_findings = build_context(REPO_ROOT, rels,
+                                        full_scan=False)
+    assert not parse_findings
+    for p in (vmem_pass, dma_pass, grid_pass, ref_pass):
+        assert p.run(ctx) == [], \
+            [f.render() for f in p.run(ctx)]
+
+
+# ------------------------------------------------------------------
+# 2. the ROOF004 baseline drift gate
+# ------------------------------------------------------------------
+
+def test_roof004_missing_entry_fires_once():
+    """A kernel the checked-in baseline does not know fires ROOF004
+    (full scans only) so new kernels force a baseline regeneration."""
+    ctx, _ = build_context(REPO_ROOT,
+                           [_fixture("fixture_roof_drift.py")],
+                           full_scan=True)
+    findings = [f for f in roofline_pass.run(ctx)
+                if f.rule == "ROOF004"]
+    assert len(findings) == 1, [f.render() for f in findings]
+    assert "ROOFLINE.json" in findings[0].message
+    # subset scans skip the sweep entirely
+    ctx2, _ = build_context(REPO_ROOT,
+                            [_fixture("fixture_roof_drift.py")],
+                            full_scan=False)
+    assert [f for f in roofline_pass.run(ctx2)
+            if f.rule == "ROOF004"] == []
+
+
+def _tmp_repo_with_drift_fixture(tmp_path):
+    root = tmp_path / "repo"
+    root.mkdir()
+    shutil.copy(os.path.join(REPO_ROOT,
+                             _fixture("fixture_roof_drift.py")),
+                root / "kern.py")
+    return root
+
+
+def test_roof004_regression_and_clean_baseline(tmp_path):
+    root = _tmp_repo_with_drift_fixture(tmp_path)
+    ctx, _ = build_context(str(root), ["kern.py"], full_scan=True)
+    payload = roofline_pass.report_payload(ctx)
+    (root / "ROOFLINE.json").write_text(json.dumps(payload))
+
+    # exact baseline -> clean
+    ctx2, _ = build_context(str(root), ["kern.py"], full_scan=True)
+    assert [f for f in roofline_pass.run(ctx2)
+            if f.rule == "ROOF004"] == []
+
+    # shrink the recorded bytes -> the current estimate "grew" ->
+    # regression fires
+    key = next(iter(payload["kernels"]))
+    payload["kernels"][key]["per_cell_bytes_lo"] -= 1
+    (root / "ROOFLINE.json").write_text(json.dumps(payload))
+    ctx3, _ = build_context(str(root), ["kern.py"], full_scan=True)
+    hits = [f for f in roofline_pass.run(ctx3) if f.rule == "ROOF004"]
+    assert len(hits) == 1 and "regression" in hits[0].message
+
+
+def test_checked_in_baseline_in_sync():
+    """The drift gate of record: ROOFLINE.json must equal the current
+    full-tree estimates exactly — regenerate with
+    `python -m tools.aphrocheck --roofline --json > ROOFLINE.json`."""
+    ctx, _ = build_context()
+    payload = roofline_pass.report_payload(ctx)
+    with open(os.path.join(REPO_ROOT, "ROOFLINE.json"),
+              encoding="utf-8") as f:
+        baseline = json.load(f)
+    assert payload == baseline, \
+        "ROOFLINE.json out of date: regenerate with `python -m " \
+        "tools.aphrocheck --roofline --json > ROOFLINE.json`"
+
+
+def test_baseline_covers_every_kernel():
+    """Every pallas_call site in the tree has a baseline entry, keyed
+    path::scope (line numbers deliberately excluded so code motion
+    does not churn the baseline)."""
+    with open(os.path.join(REPO_ROOT, "ROOFLINE.json"),
+              encoding="utf-8") as f:
+        baseline = json.load(f)
+    keys = set(baseline["kernels"])
+    for expect in ("aphrodite_tpu/ops/pallas/quant_matmul.py::"
+                   "_stream_call",
+                   "aphrodite_tpu/ops/pallas/paged_attention.py::"
+                   "_paged_decode_impl",
+                   "aphrodite_tpu/ops/pallas/kv_write.py::"
+                   "write_kv_pages"):
+        assert expect in keys, f"{expect} missing from ROOFLINE.json"
+    for rec in baseline["kernels"].values():
+        assert "line" not in rec
+
+
+# ------------------------------------------------------------------
+# 3. the motivating hand findings reproduce in-tree
+# ------------------------------------------------------------------
+
+def test_known_findings_reproduce_hand_results():
+    """With pragmas ignored, the passes reproduce the PROFILE_r05/r06
+    hand findings: ROOF003 on the streamed-matmul k-run flush (the
+    LATENCY_r06 0.80x bs=1 residual) and FOLD002 on BOTH decode
+    attention kernels' rescale multiplies (the AMLA candidates) plus
+    FOLD001 on the W4A8 activation-quantization chain."""
+    ctx, _ = build_context()
+    roof = roofline_pass.findings(ctx, honor_pragmas=False)
+    fold = fold_pass.findings(ctx, honor_pragmas=False)
+    roof3 = [f for f in roof if f.rule == "ROOF003"]
+    assert len(roof3) == 1 and \
+        roof3[0].path.endswith("quant_matmul.py"), \
+        [f.render() for f in roof3]
+    fold2 = sorted(f.path for f in fold if f.rule == "FOLD002")
+    assert fold2 == ["aphrodite_tpu/ops/pallas/paged_attention.py"] * 2
+    fold1 = [f for f in fold if f.rule == "FOLD001" and
+             f.path.endswith("quant_matmul.py")]
+    assert len(fold1) == 1, [f.render() for f in fold]
+
+
+def test_pragmas_keep_gate_green_with_empty_allowlist():
+    """With pragmas honored the full ROOF/FOLD sweep is clean — the
+    known findings are registered IN SOURCE (perf-known pragmas), the
+    allowlist stays EMPTY, and the --roofline report still lists the
+    sites as known candidates."""
+    report = run(allowlist_path=None,
+                 rule_prefixes=["ROOF", "FOLD"])
+    assert not report.findings, \
+        [f.render() for f in report.findings]
+    ctx, _ = build_context()
+    by_key = {e.key: e for e in roofline_pass.kernel_estimates(ctx)}
+    stream = by_key["aphrodite_tpu/ops/pallas/quant_matmul.py::"
+                    "_stream_call"]
+    assert "ROOF003" in stream.known
+    attn = by_key["aphrodite_tpu/ops/pallas/paged_attention.py::"
+                  "_paged_decode_impl"]
+    assert "FOLD002" in attn.known
+
+
+def test_estimator_reports_every_site():
+    """Every pallas_call in the tree gets an estimate with the report
+    fields populated (intervals may be wide — dims are runtime shapes
+    — but never negative, and the ring kernels are recognized)."""
+    ctx, _ = build_context()
+    ests = roofline_pass.kernel_estimates(ctx)
+    assert len(ests) >= 14
+    for e in ests:
+        assert e.per_cell_bytes.lo >= 0
+        assert e.vmem_bytes.lo >= 0
+    ringed = {e.key for e in ests if e.has_ring}
+    assert any("_stream_call" in k for k in ringed)
+    assert any("_paged_decode_impl" in k for k in ringed)
+
+
+# ------------------------------------------------------------------
+# 4. CLI + bench wiring + calibration hooks
+# ------------------------------------------------------------------
+
+def test_cli_roofline_human_and_json():
+    human = subprocess.run(
+        [sys.executable, "-m", "tools.aphrocheck", "--roofline"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert human.returncode == 0, human.stderr
+    assert "_stream_call" in human.stdout
+    assert "known: ROOF003" in human.stdout
+    as_json = subprocess.run(
+        [sys.executable, "-m", "tools.aphrocheck", "--roofline",
+         "--json"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert as_json.returncode == 0, as_json.stderr
+    payload = json.loads(as_json.stdout)
+    with open(os.path.join(REPO_ROOT, "ROOFLINE.json"),
+              encoding="utf-8") as f:
+        assert payload == json.load(f), \
+            "--roofline --json drifted from ROOFLINE.json"
+
+
+def test_cli_bare_rules_lists_families():
+    """The satellite fix: bare `--rules` is a rule LISTER (it used to
+    argparse-error with 'expected one argument'); the filtering form
+    still runs a subset."""
+    bare = subprocess.run(
+        [sys.executable, "-m", "tools.aphrocheck", "--rules"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert bare.returncode == 0, bare.stderr
+    for token in ("FLAG001", "ROOF003", "FOLD002", "roofline_pass"):
+        assert token in bare.stdout, f"{token} missing from listing"
+    subset = subprocess.run(
+        [sys.executable, "-m", "tools.aphrocheck", "--rules",
+         "ROOF,FOLD"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert subset.returncode == 0, subset.stdout + subset.stderr
+
+
+def test_bench_gate_clean_on_tree():
+    """bench.py's pre-run gate runs the ROOF/FOLD sweep in-process and
+    passes on the clean tree (a regression would SystemExit before
+    a 30-minute TPU run is wasted)."""
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        import bench
+        bench._roofline_gate()      # raises SystemExit on findings
+    finally:
+        sys.path.remove(REPO_ROOT)
+
+
+def test_stream_calibration_static_estimate():
+    """profile_step's `--only roofline` static column: the aphrocheck
+    estimator with the REAL tile geometry bound resolves the streamed
+    kernel's ring traffic exactly (qw int32 slot + zeros + scales
+    interval) — the numbers printed next to measured us/layer."""
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        from benchmarks.profile_step import (ragged_roofline_static,
+                                             stream_roofline_static)
+    finally:
+        sys.path.remove(REPO_ROOT)
+    st = stream_roofline_static(64, 4096, 28672)
+    # gate_up geometry: block_k=4096, block_n=2048 -> qw slot
+    # (512, 2048) int32 + z (32, 1, 2048) int32 + s at >=1 byte
+    assert st["bytes_cell_lo"] == 512 * 2048 * 4 + 32 * 2048 * 4 + \
+        32 * 2048 * 1
+    assert st["bytes_cell_hi"] == 512 * 2048 * 4 + 32 * 2048 * 4 + \
+        32 * 2048 * 8
+    assert st["cells"] == 14          # n_tiles * k_tiles at m<=64
+    assert st["flops"] == 2 * 64 * 4096 * 28672
+    assert 0 < st["floor_us"] < 1000
+    ra = ragged_roofline_static(8, 16, 8, 128, 2, 1024)
+    # K+V chunk slots dominate: 2 * chunk_tokens(128) * lanes(1024)
+    assert ra["bytes_cell_lo"] >= 2 * 128 * 1024
+    assert ra["items"] == 1024
+
+
+def test_profile_step_has_roofline_gate_and_mode():
+    """The harness wiring is present: profile_step exposes the
+    roofline calibration mode and the pre-run gate flag."""
+    with open(os.path.join(REPO_ROOT, "benchmarks",
+                           "profile_step.py"), encoding="utf-8") as f:
+        src = f.read()
+    assert "--no-roofline-gate" in src
+    assert 'want("roofline")' in src

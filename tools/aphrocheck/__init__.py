@@ -25,6 +25,16 @@ Rule families (see each pass module's docstring for the contract):
                  re-raising in engine//executor//processing hot
                  paths, and except clauses that discard
                  asyncio.CancelledError
+  ROOF001-004    static roofline: per-pallas_call bytes-moved /
+                 MXU-flops / VMEM-residency estimates (the
+                 `--roofline` report), un-staged HBM operands,
+                 provably bandwidth-starved cells, the k-run flush
+                 serialization class, and drift vs the checked-in
+                 ROOFLINE.json baseline
+  FOLD001-002    fold candidates: elementwise chains adjacent to
+                 kernel launches still paying an HBM round trip
+                 (Zen-Attention) and online-softmax rescale
+                 multiplies AMLA's mul-by-add rewrite eliminates
 
 Name resolution is interprocedural: a same-package call graph
 (core.CallGraph) lets helper parameters resolve through their call
@@ -50,7 +60,7 @@ DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(
     os.path.abspath(__file__)), "allowlist.json")
 
 _RULE_ORDER = ("PARSE", "FLAG", "VMEM", "DMA", "GRID", "SYNC", "REF",
-               "SHARD", "RECOMP", "EXC", "BP")
+               "SHARD", "RECOMP", "EXC", "BP", "ROOF", "FOLD")
 
 
 @dataclasses.dataclass
@@ -61,8 +71,13 @@ class Context:
     call_graph: Optional[CallGraph] = None
     #: False for subset scans (--changed, explicit paths): rules that
     #: sweep the whole flag registry (FLAG004) need the full
-    #: read-site picture and are skipped.
+    #: read-site picture and are skipped, as is the roofline baseline
+    #: sweep (ROOF004), whose missing-entry contract only makes sense
+    #: against the full kernel set.
     full_scan: bool = True
+    #: Repository root the modules were loaded from — the ROOF004
+    #: baseline (ROOFLINE.json) lives at its top level.
+    root: str = REPO_ROOT
 
     def __post_init__(self) -> None:
         if self.call_graph is None:
@@ -100,7 +115,7 @@ def build_context(root: str = REPO_ROOT,
             if err is not None:
                 parse_findings.append(err)
     return Context(list(modules), flags_module, vmem_budget,
-                   full_scan=full_scan), parse_findings
+                   full_scan=full_scan, root=root), parse_findings
 
 
 def run(root: str = REPO_ROOT,

@@ -188,6 +188,23 @@ class Module:
         return tuple(path)
 
 
+def has_pragma(module: "Module", lineno: int, pragma: str) -> bool:
+    """Whether `pragma` appears on the given line or in the contiguous
+    comment block directly above it — the registration idiom shared by
+    BP001's `# bounded-by:` and the perf passes' `# perf-known:`."""
+    if pragma in module.line_text(lineno):
+        return True
+    line = lineno - 1
+    while line >= 1:
+        text = module.line_text(line).strip()
+        if not text.startswith("#"):
+            return False
+        if pragma in text:
+            return True
+        line -= 1
+    return False
+
+
 def paths_conflict(a: Sequence[Tuple[int, str]],
                    b: Sequence[Tuple[int, str]]) -> bool:
     """Two branch paths conflict when they take different arms of the
@@ -459,6 +476,14 @@ class IntervalEvaluator:
     site default — the analysis states its assumption as "flags at
     defaults" rather than treating every knob as unbounded.
 
+    `bindings` pins additional names to exact values BEFORE any source
+    resolution (they shadow locals and parameters alike). The roofline
+    calibration hook uses this: `profile_step.py --only roofline`
+    computes the real tile geometry at a bench shape and asks the
+    static estimator for bytes/flops at those concrete values, so the
+    same AST walk serves both the lint-time bound and the
+    measured-vs-estimated drift table.
+
     With a `call_graph`, a name that is a PARAMETER of the scope
     function joins the intervals of every caller-site binding
     (including functools.partial keywords), each evaluated in its own
@@ -471,10 +496,13 @@ class IntervalEvaluator:
     def __init__(self, module: Module, scope: Optional[ast.AST],
                  flag_defaults: Optional[Dict[str, int]] = None,
                  call_graph: Optional[CallGraph] = None,
-                 _depth: int = 0) -> None:
+                 _depth: int = 0,
+                 bindings: Optional[Dict[str, int]] = None) -> None:
         self.module = module
         self.scope = scope
-        self.flag_defaults = flag_defaults or {}
+        self.flag_defaults = dict(flag_defaults or {})
+        if bindings:
+            self.flag_defaults.update(bindings)
         self.call_graph = call_graph
         self._depth = _depth
         self._mutated = self._collect_mutated()
@@ -529,11 +557,15 @@ class IntervalEvaluator:
     def _eval_name(self, name: str, at: ast.AST) -> Interval:
         if name in self._stack:
             return UNKNOWN
-        if name in self._mutated:
-            return UNKNOWN
+        # explicit bindings / flag defaults win over the mutated-name
+        # bailout: a caller pinning `block_n` (the roofline
+        # calibration hook) means THAT value, even though the sizing
+        # helper reassigns the same name in a loop somewhere.
         if name in self.flag_defaults:
             v = self.flag_defaults[name]
             return Interval(v, v)
+        if name in self._mutated:
+            return UNKNOWN
         sources: List[ast.AST] = []
         if self.scope is not None:
             sources.extend(assignments_of(self.scope, name,

@@ -21,11 +21,19 @@ Rule ID families:
 - BP001                — bounded-queue hygiene: unbounded
                          asyncio.Queue/deque construction on the
                          serving path without a registered bound
+- ROOF001..ROOF004     — static roofline: un-staged HBM operands,
+                         provably bandwidth-starved cells, the k-run
+                         flush serialization class, drift vs the
+                         checked-in ROOFLINE.json baseline
+- FOLD001..FOLD002     — kernel-adjacent elementwise chains paying an
+                         HBM round trip (Zen-Attention) and online-
+                         softmax rescale multiplies (AMLA mul-by-add)
 """
 from tools.aphrocheck.passes import (bound_pass, dma_pass, exc_pass,
-                                     flag_pass, grid_pass, recomp_pass,
-                                     ref_pass, shard_pass, sync_pass,
-                                     vmem_pass)
+                                     flag_pass, fold_pass, grid_pass,
+                                     recomp_pass, ref_pass,
+                                     roofline_pass, shard_pass,
+                                     sync_pass, vmem_pass)
 
 ALL_PASSES = (
     ("FLAG", flag_pass.run),
@@ -38,4 +46,6 @@ ALL_PASSES = (
     ("RECOMP", recomp_pass.run),
     ("EXC", exc_pass.run),
     ("BP", bound_pass.run),
+    ("ROOF", roofline_pass.run),
+    ("FOLD", fold_pass.run),
 )

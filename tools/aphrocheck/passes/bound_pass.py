@@ -27,8 +27,8 @@ from __future__ import annotations
 import ast
 from typing import List, Optional
 
-from tools.aphrocheck.core import (Finding, dotted_name, int_const,
-                                   keyword_arg)
+from tools.aphrocheck.core import (Finding, dotted_name, has_pragma,
+                                   int_const, keyword_arg)
 
 #: BP001 scope: the layers between a client connection and the
 #: scheduler, where an unbounded queue defeats admission control.
@@ -84,20 +84,6 @@ def _is_bounded(call: ast.Call, kind: str) -> bool:
     return True                           # literal or config expression
 
 
-def _has_pragma(module, lineno: int) -> bool:
-    if _PRAGMA in module.line_text(lineno):
-        return True
-    line = lineno - 1
-    while line >= 1:
-        text = module.line_text(line).strip()
-        if not text.startswith("#"):
-            return False
-        if _PRAGMA in text:
-            return True
-        line -= 1
-    return False
-
-
 def run(ctx) -> List[Finding]:
     findings: List[Finding] = []
     for module in ctx.modules:
@@ -107,7 +93,7 @@ def run(ctx) -> List[Finding]:
             kind = _queue_kind(call)
             if kind is None or _is_bounded(call, kind):
                 continue
-            if _has_pragma(module, call.lineno):
+            if has_pragma(module, call.lineno, _PRAGMA):
                 continue
             findings.append(module.finding(
                 "BP001", call,

@@ -1,0 +1,958 @@
+"""ROOF pass: static roofline estimates + ring-epilogue coverage.
+
+The interprocedural core already binds every `pallas_call` in the repo
+to its BlockSpecs, scratch shapes, and DMA rings; this pass turns that
+binding into the roofline reasoning PROFILE_r05/r06 did by hand. For
+each site it derives, per grid cell:
+
+- HBM bytes moved: non-ANY BlockSpec blocks (product of block dims x
+  dtype width), classified by FETCH CADENCE from the index map —
+  `per-cell` (the map uses the innermost grid coordinate directly),
+  `per-run` (the innermost coordinate appears only under a floor
+  division, the k-run revisit idiom), `resident` (a constant map:
+  fetched once per launch) — plus explicit `make_async_copy` ring
+  traffic, sized from the ring-buffer scratch entries (a VMEM scratch
+  whose leading dim matches a `SemaphoreType.DMA` leading dim at the
+  same site contributes one slot's bytes per cell).
+- MXU flops: `jnp.dot`/`jax.lax.dot_general` calls in the kernel body,
+  operand shapes inferred from the bound refs (subscript-consumed
+  dims), multiplied by enclosing static `range()` trip counts.
+- VMEM residency: the VMEM001 footprint (scratch + blocks), as an
+  interval.
+
+All quantities are [lo, hi] intervals — dims the evaluator cannot
+bound contribute 1 / inf, so "provably" below always means the LOWER
+bound already violates the budget. The `--roofline` report (human +
+`--json`) renders every site's estimate, arithmetic intensity, and
+the bandwidth each cell needs against the v5e ~820 GB/s HBM spec; the
+JSON form IS the checked-in `ROOFLINE.json` baseline schema
+(regenerate with `python -m tools.aphrocheck --roofline --json >
+ROOFLINE.json`).
+
+Rules:
+
+- ROOF001: a `memory_space=ANY` operand (stays in HBM) that the
+  kernel reads by DIRECT subscript instead of staging through
+  `make_async_copy` — traffic neither the compiler's double buffering
+  nor the explicit ring can overlap; every element is a synchronous
+  HBM access at VPU pace. (Sites whose kernels take `*refs` are
+  unresolvable and stay silent.)
+- ROOF002: a cell whose PROVABLE bandwidth demand exceeds the HBM
+  spec: bytes lower bound over compute-time upper bound (flops upper
+  bound at MXU peak) > ~820 GB/s — the MXU provably idles on DMA.
+  Fires only when both sides resolve to finite bounds.
+- ROOF003: the k-run flush serialization class (the LATENCY_r06
+  bs=1 residual): an explicit-DMA-ring kernel that resets a
+  SINGLE-PLANE accumulator under a run-initial `pl.when(k == 0)` and
+  flushes it to a different ref under a run-final `pl.when(k == last)`
+  — the boundary cell's flush + output write serialize with the next
+  run's first ring wait, a bubble NO ring depth covers. The fix is
+  double-buffering the accumulator/output planes (slot-indexed
+  stores), which this rule recognizes as clean.
+- ROOF004: drift vs the checked-in `ROOFLINE.json` baseline (full
+  scans only): a kernel whose per-cell bytes or VMEM lower bound GREW
+  vs the baseline, or a kernel the baseline does not know — both mean
+  the estimate of record is stale; regenerate (and let the diff show
+  the perf delta) or fix the regression.
+
+Known, deliberate findings are registered IN THE SOURCE with a
+`# perf-known: <RULE> <reason>` comment on the flagged line or the
+contiguous comment block above (the BP001 `# bounded-by:` idiom) —
+the gate stays green and the allowlist stays empty, while the
+`--roofline` report still lists the site as a known fold/serialization
+candidate. `findings(ctx, honor_pragmas=False)` surfaces them, which
+is how the tier-1 suite proves the passes reproduce the hand-found
+PROFILE_r05/r06 results in-tree.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tools.aphrocheck.core import (INF, Finding, Interval,
+                                   IntervalEvaluator, Module,
+                                   dotted_name, dtype_bytes, has_pragma,
+                                   int_const, iter_calls, tail_name)
+from tools.aphrocheck.passes.vmem_pass import (_blockspec_bytes,
+                                               _entry_bytes)
+from tools.aphrocheck.sites import (PallasSite, bind_kernel_refs,
+                                    find_sites, list_elements,
+                                    resolve_kernel_functions)
+
+#: v5e chip spec the report and ROOF002/003 reason against.
+HBM_GBPS = 820.0
+MXU_BF16_TFLOPS = 197.0
+#: flops/byte above which a cell is compute-bound on v5e.
+RIDGE_FLOPS_PER_BYTE = MXU_BF16_TFLOPS * 1e12 / (HBM_GBPS * 1e9)
+
+#: The in-source registration for known, deliberate perf findings.
+PRAGMA = "perf-known:"
+
+BASELINE_FILE = "ROOFLINE.json"
+
+_ONE = Interval(1, 1)
+_ZERO = Interval(0, 0)
+
+
+def _mul(a: Interval, b: Interval) -> Interval:
+    return Interval(a.lo * b.lo, a.hi * b.hi)
+
+
+def _add(a: Interval, b: Interval) -> Interval:
+    return Interval(a.lo + b.lo, a.hi + b.hi)
+
+
+def _dims_bytes(ev: IntervalEvaluator, dims: Sequence[ast.AST],
+                width: Interval, at: Optional[ast.AST] = None
+                ) -> Interval:
+    lo, hi = 1.0, 1.0
+    for dim in dims:
+        iv = ev.eval(dim, at if at is not None else dim)
+        lo *= max(iv.lo, 1)
+        hi *= iv.hi
+    return Interval(lo * width.lo, hi * width.hi)
+
+
+# ------------------------------------------------------------------
+# index-map cadence classification
+# ------------------------------------------------------------------
+
+def _index_map_cadence(module: Module, scope, spec: ast.AST,
+                       n_grid: int) -> str:
+    """'per-cell' | 'per-run' | 'resident' for a BlockSpec's index
+    map: which grid coordinates the map's result actually varies with.
+    The innermost coordinate appearing only under a floor division is
+    the k-run revisit idiom (`lambda w: (0, w // k_tiles)`) — the
+    block is re-fetched once per RUN, not per cell."""
+    from tools.aphrocheck.sites import resolve
+    if not isinstance(spec, ast.Call) or len(spec.args) < 2:
+        return "per-cell"          # unknown map: assume worst
+    fns = []
+    for cand in resolve(module, scope, spec.args[1]):
+        if isinstance(cand.node, (ast.Lambda, ast.FunctionDef)):
+            fns.append(cand.node)
+    if not fns:
+        return "per-cell"
+    cadence = "resident"
+    for fn in fns:
+        params = [a.arg for a in fn.args.args]
+        grid_params = set(params[:n_grid]) if n_grid else set(params)
+        inner = params[n_grid - 1] if n_grid and \
+            len(params) >= n_grid else None
+        body = fn.body if isinstance(fn, ast.Lambda) else fn
+        parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(body):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        uses_inner_direct = uses_inner_div = uses_outer = False
+        for node in ast.walk(body):
+            if not isinstance(node, ast.Name) or \
+                    node.id not in grid_params:
+                continue
+            if node.id == inner:
+                parent = parents.get(node)
+                if isinstance(parent, ast.BinOp) and \
+                        isinstance(parent.op, ast.FloorDiv) and \
+                        parent.left is node:
+                    uses_inner_div = True
+                else:
+                    uses_inner_direct = True
+            else:
+                uses_outer = True
+        if uses_inner_direct:
+            return "per-cell"
+        if uses_inner_div or uses_outer:
+            cadence = "per-run"
+    return cadence
+
+
+# ------------------------------------------------------------------
+# per-site estimation
+# ------------------------------------------------------------------
+
+@dataclasses.dataclass
+class KernelEstimate:
+    key: str                     # "<rel>::<scope name>"
+    module: Module
+    site: PallasSite
+    line: int
+    grid: List[str]              # rendered grid dims
+    cells: Interval
+    per_cell_bytes: Interval     # per-cell blocks + ring-slot DMAs
+    per_run_bytes: Interval      # k-run revisit blocks
+    resident_bytes: Interval     # constant-map blocks (one fetch)
+    ring_bytes: Interval         # explicit-DMA share of per_cell
+    flops_per_cell: Interval
+    vmem_bytes: Interval
+    has_ring: bool               # explicit make_async_copy DMA ring
+    ring_depth: Optional[int]    # resolved SemaphoreType.DMA lead dim
+    known: List[str]             # pragma-registered rules at the site
+
+    @property
+    def intensity(self) -> Tuple[float, float]:
+        """flops/byte [lo, hi] from the opposing bounds."""
+        b, f = self.per_cell_bytes, self.flops_per_cell
+        lo = f.lo / b.hi if b.hi not in (0, INF) else 0.0
+        hi = f.hi / b.lo if b.lo else INF
+        return lo, hi
+
+    @property
+    def required_gbps_lo(self) -> float:
+        """Provable lower bound on the bandwidth the cell demands:
+        bytes lower bound over the LONGEST compute time the flops
+        upper bound allows at MXU peak."""
+        if self.flops_per_cell.hi == INF or self.flops_per_cell.hi <= 0:
+            return 0.0
+        t_hi = self.flops_per_cell.hi / (MXU_BF16_TFLOPS * 1e12)
+        return self.per_cell_bytes.lo / t_hi / 1e9
+
+
+def _grid_dims(module: Module, scope, variant) -> List[ast.AST]:
+    """Grid dim expressions, resolving `grid=grid` Name indirection
+    through the site scope's assignments."""
+    from tools.aphrocheck.sites import resolve
+    g = variant.grid
+    if g is None:
+        return []
+    if isinstance(g, ast.Name):
+        for cand in resolve(module, scope, g):
+            if isinstance(cand.node, ast.Tuple):
+                return list(cand.node.elts)
+    if isinstance(g, ast.Tuple):
+        return list(g.elts)
+    return [g]
+
+
+def _render(ev: IntervalEvaluator, node: ast.AST) -> str:
+    iv = ev.eval(node)
+    if iv.exact is not None:
+        return str(iv.exact)
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return "?"
+
+
+def _sem_lead_dims(module: Module, ev: IntervalEvaluator,
+                   entries: Sequence[ast.AST]) -> List[Tuple[
+                       ast.AST, Optional[int]]]:
+    """(entry, resolved leading dim) for SemaphoreType.DMA entries."""
+    out = []
+    for entry in entries:
+        if isinstance(entry, ast.Call) and \
+                (dotted_name(entry.func) or "").endswith(
+                    "SemaphoreType.DMA") and entry.args:
+            shape = entry.args[0]
+            lead = shape.elts[0] if isinstance(shape, ast.Tuple) and \
+                shape.elts else shape
+            out.append((entry, ev.eval(lead, entry).exact))
+    return out
+
+
+def _scratch_entries(module: Module, site: PallasSite, variant
+                     ) -> List[ast.AST]:
+    base, appended, _ = list_elements(module, site.scope,
+                                      variant.scratch_shapes)
+    return base + appended
+
+
+def _ring_slot_bytes(module: Module, ev: IntervalEvaluator,
+                     site: PallasSite, variant) -> Tuple[
+                         Interval, bool, Optional[int]]:
+    """Explicit-ring traffic per cell: for every VMEM scratch whose
+    leading dim matches a SemaphoreType.DMA leading dim (the ring
+    idiom every kernel in this repo uses), one SLOT's bytes move per
+    cell. Dim matching is by resolved value OR by expression identity
+    (`n_slots` as a helper parameter resolves to no exact int, but a
+    VMEM lead spelled with the same expression IS the same ring).
+    Returns (bytes, has_ring, deepest resolved depth or None)."""
+    entries = _scratch_entries(module, site, variant)
+    sem_entries = []
+    for entry in entries:
+        if isinstance(entry, ast.Call) and \
+                (dotted_name(entry.func) or "").endswith(
+                    "SemaphoreType.DMA") and entry.args:
+            shape = entry.args[0]
+            lead = shape.elts[0] if isinstance(shape, ast.Tuple) and \
+                shape.elts else shape
+            sem_entries.append(lead)
+    if not sem_entries:
+        return _ZERO, False, None
+    sem_dumps = {ast.dump(lead) for lead in sem_entries}
+    sem_exacts = {ev.eval(lead, lead).exact for lead in sem_entries}
+    sem_exacts.discard(None)
+    depth = max(sem_exacts) if sem_exacts else None
+    total = _ZERO
+    for entry in entries:
+        if not isinstance(entry, ast.Call) or \
+                tail_name(entry.func) != "VMEM":
+            continue
+        if not entry.args or not isinstance(entry.args[0], ast.Tuple) \
+                or len(entry.args[0].elts) < 2:
+            continue
+        lead_node = entry.args[0].elts[0]
+        lead_exact = ev.eval(lead_node, entry).exact
+        if ast.dump(lead_node) not in sem_dumps and \
+                (lead_exact is None or lead_exact not in sem_exacts):
+            continue
+        width = dtype_bytes(entry.args[1]) if len(entry.args) > 1 \
+            else Interval(1, 8)
+        total = _add(total, _dims_bytes(ev, entry.args[0].elts[1:],
+                                        width, at=entry))
+    return total, True, depth
+
+
+# -- kernel-body flops ------------------------------------------------
+
+def _subscript_chain(node: ast.AST) -> Tuple[Optional[str],
+                                             List[ast.AST]]:
+    """(base name, flattened index elements) of possibly-nested
+    subscripts over `name` or `name.at`."""
+    idx: List[ast.AST] = []
+    while isinstance(node, ast.Subscript):
+        s = node.slice
+        if isinstance(s, ast.Tuple):
+            idx = list(s.elts) + idx
+        else:
+            idx = [s] + idx
+        node = node.value
+    if isinstance(node, ast.Attribute) and node.attr == "at":
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id, idx
+    return None, idx
+
+
+def _consume_dims(dims: List[Interval], idx: List[ast.AST],
+                  ev: IntervalEvaluator) -> List[Interval]:
+    """Apply subscript elements to shape dims: a plain expression
+    drops the dim, `pl.ds(_, size)` replaces it with `size`, a slice /
+    Ellipsis keeps it (Ellipsis keeps the rest)."""
+    out: List[Interval] = []
+    di = 0
+    for el in idx:
+        if di >= len(dims):
+            break
+        if isinstance(el, ast.Constant) and el.value is Ellipsis:
+            out.extend(dims[di:])
+            di = len(dims)
+            break
+        if isinstance(el, ast.Slice):
+            lo = ev.eval(el.lower, el).exact if el.lower is not None \
+                else 0
+            hi_node = el.upper
+            if el.lower is None and el.upper is None:
+                out.append(dims[di])
+            elif hi_node is not None and lo is not None:
+                hi = ev.eval(hi_node, el)
+                full = dims[di]
+                out.append(Interval(max(hi.lo - lo, 1),
+                                    min(hi.hi - lo, full.hi)
+                                    if full.hi != INF else hi.hi - lo))
+            else:
+                out.append(dims[di])
+            di += 1
+            continue
+        if isinstance(el, ast.Call) and tail_name(el.func) == "ds" and \
+                len(el.args) >= 2:
+            out.append(ev.eval(el.args[1], el))
+            di += 1
+            continue
+        di += 1                      # integer index: dim dropped
+    out.extend(dims[di:])
+    return out
+
+
+class _ShapeInfer:
+    """Best-effort shapes of kernel-body expressions from the bound
+    refs. Unresolvable -> None (callers treat as unbounded)."""
+
+    def __init__(self, module: Module, kernel_fn: ast.AST,
+                 refs: Optional[Dict], ev: IntervalEvaluator) -> None:
+        self.module = module
+        self.fn = kernel_fn
+        self.refs = refs or {}
+        self.ev = ev
+        self._ref_dims: Dict[str, Optional[List[Interval]]] = {}
+
+    def ref_dims(self, name: str) -> Optional[List[Interval]]:
+        if name not in self._ref_dims:
+            info = self.refs.get(name)
+            if info is None or info.dims is None:
+                self._ref_dims[name] = None
+            else:
+                self._ref_dims[name] = [self.ev.eval(d, d)
+                                        for d in info.dims]
+        return self._ref_dims[name]
+
+    def shape(self, node: ast.AST, depth: int = 0
+              ) -> Optional[List[Interval]]:
+        if depth > 6 or node is None:
+            return None
+        if isinstance(node, ast.Subscript):
+            base, idx = _subscript_chain(node)
+            if base is not None:
+                dims = self.ref_dims(base)
+                if dims is not None:
+                    return _consume_dims(dims, idx, self.ev)
+            return None
+        if isinstance(node, ast.Name):
+            for value in self.module.assign_index(self.fn).get(
+                    node.id, ()):
+                s = self.shape(value, depth + 1)
+                if s is not None:
+                    return s
+            dims = self.ref_dims(node.id)
+            return dims
+        if isinstance(node, ast.Call):
+            fn = tail_name(node.func)
+            if fn == "astype" and isinstance(node.func, ast.Attribute):
+                return self.shape(node.func.value, depth + 1)
+            if fn == "where" and len(node.args) >= 2:
+                return self.shape(node.args[1], depth + 1)
+            if fn in ("zeros", "ones", "full", "broadcasted_iota"):
+                shape_arg = node.args[1] if fn == "broadcasted_iota" \
+                    and len(node.args) > 1 else (
+                        node.args[0] if node.args else None)
+                if isinstance(shape_arg, ast.Tuple):
+                    return [self.ev.eval(e, e) for e in shape_arg.elts]
+                return None
+            return None
+        if isinstance(node, ast.BinOp):
+            return self.shape(node.left, depth + 1) or \
+                self.shape(node.right, depth + 1)
+        return None
+
+
+def _static_trip(module: Module, fn: ast.AST, node: ast.AST,
+                 ev: IntervalEvaluator) -> Interval:
+    """Product of enclosing `for _ in range(n)` trip counts between
+    `node` and the kernel function (the static-unroll loops)."""
+    total = _ONE
+    cur = module.parents.get(node)
+    while cur is not None and cur is not fn:
+        if isinstance(cur, ast.For) and isinstance(cur.iter, ast.Call) \
+                and tail_name(cur.iter.func) == "range":
+            args = cur.iter.args
+            if len(args) == 1:
+                total = _mul(total, ev.eval(args[0], cur))
+            elif len(args) >= 2:
+                lo_iv = ev.eval(args[0], cur)
+                hi_iv = ev.eval(args[1], cur)
+                total = _mul(total, Interval(
+                    max(hi_iv.lo - lo_iv.hi, 1), hi_iv.hi - lo_iv.lo))
+        cur = module.parents.get(cur)
+    return total
+
+
+def _kernel_flops(module: Module, kernel_fn: ast.AST,
+                  refs: Optional[Dict], ev: IntervalEvaluator
+                  ) -> Interval:
+    """MXU flops one grid cell executes: 2*M*K*N per dot, operand
+    shapes inferred from the bound refs, times static-range trips."""
+    infer = _ShapeInfer(module, kernel_fn, refs, ev)
+    total = _ZERO
+    for call in iter_calls(kernel_fn):
+        fn = tail_name(call.func)
+        if fn not in ("dot", "dot_general") or len(call.args) < 2:
+            continue
+        a = infer.shape(call.args[0])
+        b = infer.shape(call.args[1])
+        if a is None or b is None or len(a) < 2 or len(b) < 2:
+            flops = Interval(1, INF)
+        else:
+            m, k = a[-2], a[-1]
+            if fn == "dot_general":
+                # contraction dims from the literal dimension_numbers;
+                # default to (lhs -1, rhs 0) when unreadable.
+                rdim = 0
+                if len(call.args) >= 3:
+                    try:
+                        dn = ast.literal_eval(call.args[2])
+                        lhs_c, rhs_c = dn[0]
+                        if lhs_c == (0,):
+                            m, k = a[-1], a[-2]
+                        rdim = rhs_c[0] if rhs_c else 0
+                    except Exception:
+                        pass
+                n = b[-2] if rdim in (1, -1) else b[-1]
+            else:
+                n = b[-1]
+            flops = Interval(2 * m.lo * k.lo * n.lo,
+                             2 * m.hi * k.hi * n.hi)
+        total = _add(total, _mul(flops,
+                                 _static_trip(module, kernel_fn, call,
+                                              ev)))
+    return total
+
+
+def _estimate_site(module: Module, site: PallasSite, call_graph,
+                   bindings: Optional[Dict[str, int]] = None
+                   ) -> KernelEstimate:
+    ev = IntervalEvaluator(module, site.scope, call_graph=call_graph,
+                           bindings=bindings)
+    scope_name = site.scope.name if site.scope is not None and \
+        hasattr(site.scope, "name") else "<module>"
+    key = f"{module.rel.replace(os.sep, '/')}::{scope_name}"
+
+    per_cell = _ZERO
+    per_run = _ZERO
+    resident = _ZERO
+    ring = _ZERO
+    vmem = _ZERO
+    cells = _ONE
+    grid_repr: List[str] = []
+    has_ring = False
+    ring_depth: Optional[int] = None
+
+    variant = site.variants[0] if site.variants else None
+    if variant is not None:
+        dims = _grid_dims(module, site.scope, variant)
+        n_grid = len(dims)
+        for dim in dims:
+            cells = _mul(cells, ev.eval(dim, dim))
+            grid_repr.append(_render(ev, dim))
+        for specs, is_out in ((variant.in_specs, False),
+                              (variant.out_specs, True)):
+            elems, _, resolved = list_elements(module, site.scope,
+                                               specs)
+            if not resolved and specs is not None and \
+                    isinstance(specs, ast.Call):
+                elems = [specs]
+            for entry in elems:
+                bs = _blockspec_bytes(module, ev, entry)
+                if bs is None:
+                    continue
+                vmem = _add(vmem, bs)
+                cad = _index_map_cadence(module, site.scope, entry,
+                                         n_grid)
+                if cad == "per-cell":
+                    per_cell = _add(per_cell, bs)
+                elif cad == "per-run":
+                    per_run = _add(per_run, bs)
+                else:
+                    resident = _add(resident, bs)
+        for entry in _scratch_entries(module, site, variant):
+            eb = _entry_bytes(module, ev, entry)
+            if eb is not None:
+                vmem = _add(vmem, eb)
+        ring, has_ring, ring_depth = _ring_slot_bytes(module, ev, site,
+                                                      variant)
+        per_cell = _add(per_cell, ring)
+
+    flops = _ZERO
+    refs = None
+    kernel_fns = resolve_kernel_functions(module, site.scope,
+                                          site.kernel_arg)
+    for fn in kernel_fns:
+        if variant is not None:
+            refs = bind_kernel_refs(module, site, variant, fn)
+        kev = IntervalEvaluator(module, fn, call_graph=call_graph,
+                                bindings=bindings)
+        flops = _add(flops, _kernel_flops(module, fn, refs, kev))
+
+    known = _known_rules(module, site, kernel_fns)
+    return KernelEstimate(
+        key=key, module=module, site=site, line=site.call.lineno,
+        grid=grid_repr, cells=cells, per_cell_bytes=per_cell,
+        per_run_bytes=per_run, resident_bytes=resident,
+        ring_bytes=ring, flops_per_cell=flops, vmem_bytes=vmem,
+        has_ring=has_ring, ring_depth=ring_depth, known=known)
+
+
+def _pragma_lines(module: Module) -> List[Tuple[int, str]]:
+    """(lineno, rule id) for every perf-known pragma in the module,
+    scanned once and cached."""
+    cached = getattr(module, "_perf_known_lines", None)
+    if cached is not None:
+        return cached
+    out: List[Tuple[int, str]] = []
+    for i, text in enumerate(module.lines, start=1):
+        if PRAGMA not in text:
+            continue
+        tail = text.split(PRAGMA, 1)[1].strip()
+        if tail:
+            out.append((i, tail.split()[0]))
+    module._perf_known_lines = out
+    return out
+
+
+def _known_rules(module: Module, site: PallasSite,
+                 kernel_fns: Sequence[ast.AST]) -> List[str]:
+    """Pragma-registered rule IDs within the site's scope or any of
+    its kernel functions — the report's 'known' annotations."""
+    spans: List[Tuple[int, int]] = []
+    for node in [site.scope] + list(kernel_fns):
+        if node is None:
+            continue
+        end = getattr(node, "end_lineno", node.lineno)
+        spans.append((node.lineno, end))
+    rules = []
+    for lineno, rule in _pragma_lines(module):
+        if any(lo <= lineno <= hi or lineno == lo - 1
+               for lo, hi in spans):
+            rules.append(rule)
+    return sorted(set(rules))
+
+
+def kernel_estimates(ctx, bindings: Optional[Dict[str, int]] = None
+                     ) -> List[KernelEstimate]:
+    """Every pallas_call site's estimate. With `bindings`, names pin
+    to concrete values (the profile_step calibration hook). Estimates
+    are memoized per context for the default (no-bindings) sweep —
+    the rules, the report, and the tier-1 drift gate all reuse one
+    walk (the runtime-budget memoization, like `_top_level_kernel_fns`
+    in the DMA pass)."""
+    if bindings is None:
+        cached = getattr(ctx, "_roofline_estimates", None)
+        if cached is not None:
+            return cached
+    out: List[KernelEstimate] = []
+    seen: Dict[str, int] = {}
+    for module in ctx.modules:
+        for site in find_sites(module):
+            est = _estimate_site(module, site, ctx.call_graph, bindings)
+            n = seen.get(est.key, 0)
+            seen[est.key] = n + 1
+            if n:
+                est.key = f"{est.key}#{n}"
+            out.append(est)
+    out.sort(key=lambda e: e.key)
+    if bindings is None:
+        ctx._roofline_estimates = out
+    return out
+
+
+# ------------------------------------------------------------------
+# rules
+# ------------------------------------------------------------------
+
+def _any_space_params(refs: Dict) -> List[str]:
+    from tools.aphrocheck.core import keyword_arg
+    out = []
+    for name, info in refs.items():
+        if info.kind not in ("input", "output") or info.spec is None:
+            continue
+        if isinstance(info.spec, ast.Call) and \
+                keyword_arg(info.spec, "memory_space") is not None:
+            out.append(name)
+    return out
+
+
+def _roof001(module: Module, site: PallasSite, findings,
+             honor_pragmas: bool) -> None:
+    variant = site.variants[0] if site.variants else None
+    if variant is None:
+        return
+    for fn in resolve_kernel_functions(module, site.scope,
+                                       site.kernel_arg):
+        refs = bind_kernel_refs(module, site, variant, fn)
+        if refs is None:
+            continue
+        hbm = set(_any_space_params(refs))
+        if not hbm:
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Subscript):
+                continue
+            # `ref.at[...]` builds a DMA address (the staged path);
+            # only a DIRECT subscript of the ref name is synchronous
+            # HBM traffic.
+            inner = node
+            while isinstance(inner.value, ast.Subscript):
+                inner = inner.value
+            if not isinstance(inner.value, ast.Name) or \
+                    inner.value.id not in hbm:
+                continue
+            base = inner.value.id
+            if honor_pragmas and has_pragma(module, node.lineno,
+                                            PRAGMA):
+                continue
+            findings.append(module.finding(
+                "ROOF001", node,
+                f"direct subscript of HBM-resident operand '{base}' "
+                f"(memory_space=ANY) in {fn.name}: un-overlapped "
+                "synchronous HBM traffic — stage it through "
+                "make_async_copy (or give it a BlockSpec block)"))
+            return          # one finding per site
+
+
+def _roof002(est: KernelEstimate, findings, honor_pragmas: bool
+             ) -> None:
+    req = est.required_gbps_lo
+    if req <= HBM_GBPS:
+        return
+    module, site = est.module, est.site
+    if honor_pragmas and has_pragma(module, site.call.lineno, PRAGMA):
+        return
+    findings.append(module.finding(
+        "ROOF002", site.call,
+        f"cell provably demands {req:,.0f} GB/s "
+        f"(>= {int(est.per_cell_bytes.lo):,} B over at most "
+        f"{int(est.flops_per_cell.hi):,} flops) against the "
+        f"~{HBM_GBPS:.0f} GB/s v5e HBM spec: the MXU idles on DMA — "
+        "raise arithmetic intensity (deeper tiles, fused epilogue) "
+        "or accept the documented floor with a perf-known pragma"))
+
+
+def _when_condition(module: Module, fn_node: ast.AST
+                    ) -> Optional[ast.AST]:
+    """The pl.when(...) condition decorating a FunctionDef, if any."""
+    for dec in getattr(fn_node, "decorator_list", ()):
+        if isinstance(dec, ast.Call) and \
+                tail_name(dec.func) == "when" and dec.args:
+            return dec.args[0]
+    return None
+
+
+def _eq_compares(cond: ast.AST) -> List[Tuple[str, ast.AST]]:
+    """(name, rhs) for every direct `name == expr` comparison in the
+    condition expression tree (names referenced THROUGH other names
+    are deliberately not resolved — see ROOF003's precision notes)."""
+    out = []
+    for node in ast.walk(cond):
+        if isinstance(node, ast.Compare) and len(node.ops) == 1 and \
+                isinstance(node.ops[0], ast.Eq) and \
+                isinstance(node.left, ast.Name):
+            out.append((node.left.id, node.comparators[0]))
+    return out
+
+
+def _full_stores(fn_node: ast.AST) -> List[Tuple[str, ast.Assign]]:
+    """(base name, assign) for whole-plane subscript stores
+    (`x[...] = v` / `x[:] = v`) — slot-indexed stores (`x[s] = v`)
+    are EXCLUDED: a slot-indexed accumulator is the double-buffered
+    fix ROOF003 asks for."""
+    out = []
+    for node in ast.walk(fn_node):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not isinstance(tgt, ast.Subscript) or \
+                not isinstance(tgt.value, ast.Name):
+            continue
+        s = tgt.slice
+        whole = (isinstance(s, ast.Constant) and s.value is Ellipsis) \
+            or (isinstance(s, ast.Slice) and s.lower is None and
+                s.upper is None)
+        if whole:
+            out.append((tgt.value.id, node))
+    return out
+
+
+def _roof003(module: Module, site: PallasSite, est: KernelEstimate,
+             findings, honor_pragmas: bool) -> None:
+    """Run-boundary flush serialization (see module docstring)."""
+    if not est.has_ring:
+        return                     # no explicit ring at this site
+    for fn in resolve_kernel_functions(module, site.scope,
+                                       site.kernel_arg):
+        if not any(tail_name(c.func) == "make_async_copy"
+                   for c in iter_calls(fn)):
+            continue
+        # accumulators: whole-plane stores under pl.when(<k> == 0)
+        init_names: Dict[str, set] = {}
+        flushes: List[Tuple[str, ast.Assign]] = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            cond = _when_condition(module, node)
+            if cond is None:
+                continue
+            for name, rhs in _eq_compares(cond):
+                zero = int_const(rhs) == 0
+                for base, assign in _full_stores(node):
+                    if zero:
+                        init_names.setdefault(name, set()).add(base)
+                    else:
+                        flushes.append((name, assign))
+        for name, assign in flushes:
+            accs = init_names.get(name, set())
+            if not accs:
+                continue
+            tgt = assign.targets[0].value.id
+            reads = {n.id for n in ast.walk(assign.value)
+                     if isinstance(n, ast.Name)}
+            if tgt in accs or not (reads & accs):
+                continue
+            if honor_pragmas and has_pragma(module, assign.lineno,
+                                            PRAGMA):
+                return
+            ring = f"depth-{est.ring_depth} DMA ring" \
+                if est.ring_depth is not None else "DMA ring"
+            findings.append(module.finding(
+                "ROOF003", assign,
+                f"run-boundary flush in {fn.name}: the single-plane "
+                f"accumulator ({', '.join(sorted(reads & accs))}) is "
+                f"reset at {name} == 0 and flushed to '{tgt}' at the "
+                f"run-final cell, serializing with the next run's "
+                f"first ring wait — a bubble the {ring} cannot "
+                "cover at any depth; double-buffer the accumulator/"
+                "output planes (the PR-2 fused-write-counter trick "
+                "applied to the epilogue)"))
+            return
+
+
+def _load_baseline(root: str) -> Optional[dict]:
+    path = os.path.join(root, BASELINE_FILE)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _roof004(ctx, estimates: List[KernelEstimate], findings) -> None:
+    baseline = _load_baseline(getattr(ctx, "root", ""))
+    if baseline is None:
+        return
+    kernels = baseline.get("kernels", {})
+    for est in estimates:
+        base = kernels.get(est.key)
+        if base is None:
+            findings.append(est.module.finding(
+                "ROOF004", est.site.call,
+                f"kernel '{est.key}' has no entry in {BASELINE_FILE} "
+                "— regenerate the baseline (`python -m "
+                "tools.aphrocheck --roofline --json > ROOFLINE.json`) "
+                "so the next regression is caught against it"))
+            continue
+        cur_b = int(est.per_cell_bytes.lo)
+        cur_v = int(est.vmem_bytes.lo)
+        if cur_b > base.get("per_cell_bytes_lo", cur_b) or \
+                cur_v > base.get("vmem_bytes_lo", cur_v):
+            findings.append(est.module.finding(
+                "ROOF004", est.site.call,
+                f"roofline regression vs {BASELINE_FILE} for "
+                f"'{est.key}': per-cell bytes "
+                f"{base.get('per_cell_bytes_lo')} -> {cur_b}, VMEM "
+                f"{base.get('vmem_bytes_lo')} -> {cur_v}; fix the "
+                "regression or regenerate the baseline to record the "
+                "new floor"))
+
+
+def findings(ctx, honor_pragmas: bool = True) -> List[Finding]:
+    out: List[Finding] = []
+    estimates = kernel_estimates(ctx)
+    for est in estimates:
+        _roof001(est.module, est.site, out, honor_pragmas)
+        _roof002(est, out, honor_pragmas)
+        _roof003(est.module, est.site, est, out, honor_pragmas)
+    if getattr(ctx, "full_scan", True):
+        _roof004(ctx, estimates, out)
+    return out
+
+
+def run(ctx) -> List[Finding]:
+    return findings(ctx, honor_pragmas=True)
+
+
+# ------------------------------------------------------------------
+# the --roofline report
+# ------------------------------------------------------------------
+
+def _fmt_bytes(iv: Interval) -> str:
+    if iv.lo == iv.hi:
+        return f"{int(iv.lo):,}"
+    if iv.hi == INF:
+        return f">={int(iv.lo):,}"
+    return f"{int(iv.lo):,}..{int(iv.hi):,}"
+
+
+def _num(v: float) -> Optional[float]:
+    return None if v == INF else v
+
+
+def report_payload(ctx) -> dict:
+    """The --roofline --json payload — also the ROOFLINE.json baseline
+    schema (line numbers deliberately excluded so the baseline only
+    drifts when an ESTIMATE changes, not when code moves)."""
+    kernels = {}
+    for est in kernel_estimates(ctx):
+        kernels[est.key] = {
+            "grid": est.grid,
+            "per_cell_bytes_lo": int(est.per_cell_bytes.lo),
+            "per_cell_bytes_hi": _num(est.per_cell_bytes.hi),
+            "per_run_bytes_lo": int(est.per_run_bytes.lo),
+            "resident_bytes_lo": int(est.resident_bytes.lo),
+            "ring_bytes_lo": int(est.ring_bytes.lo),
+            "flops_lo": int(est.flops_per_cell.lo),
+            "flops_hi": _num(est.flops_per_cell.hi),
+            "vmem_bytes_lo": int(est.vmem_bytes.lo),
+            "has_ring": est.has_ring,
+            "ring_depth": est.ring_depth,
+            "known": sorted(est.known),
+        }
+    return {
+        "spec": {"hbm_gbps": HBM_GBPS,
+                 "mxu_bf16_tflops": MXU_BF16_TFLOPS,
+                 "ridge_flops_per_byte": round(RIDGE_FLOPS_PER_BYTE,
+                                               1)},
+        "kernels": kernels,
+    }
+
+
+def render_report(ctx) -> str:
+    lines = [
+        f"roofline: per-grid-cell estimates vs v5e "
+        f"(~{HBM_GBPS:.0f} GB/s HBM, {MXU_BF16_TFLOPS:.0f} TFLOP/s "
+        f"bf16 MXU, ridge ~{RIDGE_FLOPS_PER_BYTE:.0f} flops/byte)",
+        "",
+    ]
+    for est in kernel_estimates(ctx):
+        grid = "(" + ", ".join(est.grid) + ")" if est.grid else "?"
+        lines.append(f"{est.key}  grid={grid}")
+        lines.append(
+            f"  bytes/cell {_fmt_bytes(est.per_cell_bytes)} "
+            f"(ring {_fmt_bytes(est.ring_bytes)})  "
+            f"bytes/run {_fmt_bytes(est.per_run_bytes)}  "
+            f"resident {_fmt_bytes(est.resident_bytes)}")
+        ilo, ihi = est.intensity
+        ihi_s = "inf" if ihi == INF else f"{ihi:.1f}"
+        lines.append(
+            f"  flops/cell {_fmt_bytes(est.flops_per_cell)}  "
+            f"vmem {_fmt_bytes(est.vmem_bytes)}  "
+            f"intensity {ilo:.1f}..{ihi_s} flops/B")
+        extras = []
+        if est.has_ring:
+            extras.append(f"ring depth "
+                          f"{est.ring_depth if est.ring_depth is not None else '?'}")
+        if est.required_gbps_lo > 0:
+            extras.append(
+                f"needs >= {est.required_gbps_lo:,.0f} GB/s/cell")
+        if est.known:
+            extras.append("known: " + ", ".join(sorted(est.known)))
+        if extras:
+            lines.append("  " + "; ".join(extras))
+        lines.append("")
+    return "\n".join(lines)
+
+
+#: (rule, one-line contract, example) — rendered by `--rules-md`.
+RULES = (
+    ("ROOF001", "HBM-resident (`memory_space=ANY`) operand read by "
+     "direct subscript in the kernel instead of staged through "
+     "`make_async_copy` — synchronous per-element HBM traffic no "
+     "ring or double buffer overlaps",
+     "`w = hbm_ref[...]` on an ANY-space input"),
+    ("ROOF002", "grid cell whose provable bandwidth demand (bytes "
+     "lower bound over flops upper bound at MXU peak) exceeds the "
+     "~820 GB/s v5e HBM spec: the MXU idles on DMA",
+     "a 4 MiB/cell stream against a 16-flop/byte cell"),
+    ("ROOF003", "explicit-DMA-ring kernel whose single-plane "
+     "accumulator is reset at `k == 0` and flushed to the output at "
+     "the run-final cell: the flush serializes with the next run's "
+     "first ring wait — a bubble no ring depth covers (the "
+     "LATENCY_r06 k-run residual)",
+     "`pl.when(k == k_tiles - 1)` flushing `acc_ref[...]` next to a "
+     "weight-stream ring"),
+    ("ROOF004", "kernel whose per-cell bytes / VMEM estimate grew vs "
+     "the checked-in `ROOFLINE.json` baseline, or is missing from it "
+     "(full scans only; regenerate with `--roofline --json`)",
+     "a BlockSpec block doubled without the baseline moving"),
+)

@@ -102,6 +102,9 @@ def _variant_from_grid_spec(module: Module, scope, cand: Candidate
 
 
 def find_sites(module: Module) -> List[PallasSite]:
+    cached = getattr(module, "_pallas_sites", None)
+    if cached is not None:
+        return cached
     sites: List[PallasSite] = []
     for call in module.calls:
         if tail_name(call.func) != "pallas_call":
@@ -132,6 +135,7 @@ def find_sites(module: Module) -> List[PallasSite]:
             scope=scope,
             kernel_arg=call.args[0] if call.args else None,
             variants=variants))
+    module._pallas_sites = sites
     return sites
 
 
@@ -174,6 +178,10 @@ class RefInfo:
                                  # 'scratch' | 'sem'
     dims: Optional[List[ast.AST]]   # shape dim exprs (site scope)
     dtype: Optional[str]         # dtype tail name when static
+    #: the BlockSpec / scratch entry node this param binds to (None
+    #: for out_shape-only outputs) — the roofline pass reads
+    #: memory_space markers off it.
+    spec: Optional[ast.AST] = None
 
 
 def _spec_dims(spec: ast.AST) -> Optional[List[ast.AST]]:
@@ -280,18 +288,21 @@ def bind_kernel_refs(module: Module, site: "PallasSite",
                     refs[params[idx]] = RefInfo(
                         params[idx], "input",
                         _spec_dims(spec) if spec is not None else None,
-                        None)
+                        None, spec)
                     idx += 1
                 for spec in outs:
                     refs[params[idx]] = RefInfo(
                         params[idx], "output",
                         _spec_dims(spec) if spec is not None else None,
-                        None)
+                        None, spec)
                     idx += 1
                 for entry in scrs:
                     info = _scratch_ref(params[idx], entry)
+                    if info is not None:
+                        info.spec = entry
                     refs[params[idx]] = info if info is not None else \
-                        RefInfo(params[idx], "scratch", None, None)
+                        RefInfo(params[idx], "scratch", None, None,
+                                entry)
                     idx += 1
                 return refs
     return None
