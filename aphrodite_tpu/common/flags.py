@@ -329,6 +329,31 @@ _register(Flag(
     minimum=0))
 
 _register(Flag(
+    "APHRODITE_REINCARNATIONS", "int", 1,
+    "Max automatic engine rebuilds (reincarnations) after FATAL step "
+    "faults before the terminal DEAD state: the executor/model-runner/"
+    "KV pool are torn down and rebuilt, restorable requests return to "
+    "the waiting queue with their streams intact. 0 disables recovery "
+    "(every FATAL fault is immediately terminal, the pre-lifecycle "
+    "behavior).",
+    minimum=0))
+
+_register(Flag(
+    "APHRODITE_REINCARNATION_BACKOFF_S", "float", 0.5,
+    "Base delay (seconds) before an engine reincarnation: rebuild n "
+    "waits base * 2^(n-1), so a crash-looping replica backs off "
+    "instead of thrashing device init.",
+    minimum=0))
+
+_register(Flag(
+    "APHRODITE_DRAIN_DEADLINE_S", "float", 30,
+    "Default graceful-drain deadline (seconds): after SIGTERM or an "
+    "admin drain request, in-flight requests get this long to finish "
+    "before being aborted with a typed error so the process can exit. "
+    "0 = wait for in-flight work indefinitely.",
+    minimum=0))
+
+_register(Flag(
     "APHRODITE_FAULT", "str", "",
     "Fault-injection spec `point:kind:prob:count[,...]` (points: "
     "engine.step, scheduler.schedule, block_manager.allocate, "

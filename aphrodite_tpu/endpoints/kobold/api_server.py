@@ -20,10 +20,13 @@ from aphrodite_tpu.common.logger import init_logger
 from aphrodite_tpu.common.sampling_params import SamplingParams
 from aphrodite_tpu.common.utils import random_uuid
 from aphrodite_tpu.endpoints.kobold.protocol import KAIGenerationInputSchema
-from aphrodite_tpu.endpoints.utils import request_disconnected
+from aphrodite_tpu.endpoints.utils import (install_lifecycle,
+                                           request_disconnected,
+                                           retry_after_headers)
 from aphrodite_tpu.engine.args_tools import AsyncEngineArgs
 from aphrodite_tpu.engine.async_aphrodite import AsyncAphrodite
-from aphrodite_tpu.processing.admission import (RequestRejectedError,
+from aphrodite_tpu.processing.admission import (EngineDrainingError,
+                                                RequestRejectedError,
                                                 RequestTimeoutError)
 
 logger = init_logger(__name__)
@@ -38,6 +41,14 @@ def _overloaded(e: RequestRejectedError) -> web.Response:
         {"detail": str(e)}, status=429,
         headers={"Retry-After": str(max(1, int(math.ceil(
             e.retry_after_s))))})
+
+
+def _draining(e: EngineDrainingError) -> web.Response:
+    """HTTP 503 + Retry-After: the replica is draining for shutdown
+    (distinct from overload's 429 — clients should go elsewhere)."""
+    return web.json_response({"detail": str(e)}, status=503,
+                             headers=retry_after_headers(
+                                 e.retry_after_s))
 
 
 def _set_badwords(tokenizer, hf_config) -> List[int]:
@@ -61,9 +72,11 @@ def _set_badwords(tokenizer, hf_config) -> List[int]:
 
 class KoboldServer:
 
-    def __init__(self, engine: AsyncAphrodite, served_model: str) -> None:
+    def __init__(self, engine: AsyncAphrodite, served_model: str,
+                 admin_keys: Optional[List[str]] = None) -> None:
         self.engine = engine
         self.served_model = served_model
+        self.admin_keys = admin_keys
         self.max_model_len = engine.engine.model_config.max_model_len
         self.tokenizer = engine.engine.tokenizer.tokenizer
         self.badwordsids = _set_badwords(
@@ -96,7 +109,9 @@ class KoboldServer:
         app.router.add_get("/api/extra/true_max_context_length",
                            self.get_max_context_length)
         app.router.add_get("/api/extra/version", self.get_extra_version)
-        app.router.add_get("/health", self.health)
+        # Shared lifecycle surface: /health (HealthReport JSON, 503
+        # once DRAINING/DEAD), authed /admin/drain, SIGTERM drain.
+        install_lifecycle(app, self.engine, admin_keys=self.admin_keys)
         return app
 
     # -- payload prep (reference prepare_engine_payload :84-140) --
@@ -178,6 +193,8 @@ class KoboldServer:
             return _overloaded(e)
         except RequestTimeoutError as e:
             return web.json_response({"detail": str(e)}, status=408)
+        except EngineDrainingError as e:
+            return _draining(e)
         finally:
             # Cancellation/abort must not leak the polling cache entry.
             self.gen_cache.pop(payload.genkey, None)
@@ -204,6 +221,8 @@ class KoboldServer:
                 prompt_token_ids=input_tokens)
         except RequestRejectedError as e:
             return _overloaded(e)
+        except EngineDrainingError as e:
+            return _draining(e)
         response = web.StreamResponse(headers={
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
@@ -222,7 +241,7 @@ class KoboldServer:
                 await response.write(
                     f"data: "
                     f"{json.dumps({'token': new_chunk})}\n\n".encode())
-        except RequestTimeoutError as e:
+        except (RequestTimeoutError, EngineDrainingError) as e:
             await response.write(
                 f"data: {json.dumps({'error': str(e)})}\n\n".encode())
         except BaseException:
@@ -282,13 +301,11 @@ class KoboldServer:
     async def get_max_context_length(self, request) -> web.Response:
         return web.json_response({"value": self.max_model_len})
 
-    async def health(self, request) -> web.Response:
-        await self.engine.check_health()
-        return web.Response(status=200)
 
-
-def build_app(engine: AsyncAphrodite, served_model: str) -> web.Application:
-    return KoboldServer(engine, served_model).build_app()
+def build_app(engine: AsyncAphrodite, served_model: str,
+              admin_keys: Optional[List[str]] = None) -> web.Application:
+    return KoboldServer(engine, served_model,
+                        admin_keys=admin_keys).build_app()
 
 
 def main() -> None:
@@ -297,11 +314,18 @@ def main() -> None:
     parser.add_argument("--host", type=str, default=None)
     parser.add_argument("--port", type=int, default=5000)
     parser.add_argument("--served-model-name", type=str, default=None)
+    parser.add_argument("--admin-key", type=str, default=None,
+                        help="comma-separated keys accepted by the "
+                             "POST /admin/drain lifecycle endpoint "
+                             "(unset = endpoint disabled; SIGTERM "
+                             "drain works regardless)")
     parser = AsyncEngineArgs.add_cli_args(parser)
     args = parser.parse_args()
     engine = AsyncAphrodite.from_engine_args(
         AsyncEngineArgs.from_cli_args(args))
-    app = build_app(engine, args.served_model_name or args.model)
+    app = build_app(engine, args.served_model_name or args.model,
+                    admin_keys=args.admin_key.split(",")
+                    if args.admin_key else None)
     web.run_app(app, host=args.host, port=args.port)
 
 

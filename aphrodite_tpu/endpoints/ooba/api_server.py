@@ -11,6 +11,7 @@ import argparse
 import json
 import math
 from dataclasses import fields as dataclass_fields
+from typing import List, Optional
 
 from aiohttp import web
 
@@ -18,10 +19,13 @@ from aphrodite_tpu.common.logger import init_logger
 from aphrodite_tpu.common.logits_processor import BanEOSUntil
 from aphrodite_tpu.common.sampling_params import SamplingParams
 from aphrodite_tpu.common.utils import random_uuid
-from aphrodite_tpu.endpoints.utils import request_disconnected
+from aphrodite_tpu.endpoints.utils import (install_lifecycle,
+                                           request_disconnected,
+                                           retry_after_headers)
 from aphrodite_tpu.engine.args_tools import AsyncEngineArgs
 from aphrodite_tpu.engine.async_aphrodite import AsyncAphrodite
-from aphrodite_tpu.processing.admission import (RequestRejectedError,
+from aphrodite_tpu.processing.admission import (EngineDrainingError,
+                                                RequestRejectedError,
                                                 RequestTimeoutError)
 
 logger = init_logger(__name__)
@@ -29,18 +33,30 @@ logger = init_logger(__name__)
 _PARAM_NAMES = {f.name for f in dataclass_fields(SamplingParams)}
 
 
+def _draining(e: EngineDrainingError) -> web.Response:
+    """HTTP 503 + Retry-After: the replica is draining for shutdown
+    (distinct from overload's 429 — clients should go elsewhere)."""
+    return web.json_response({"detail": str(e)}, status=503,
+                             headers=retry_after_headers(
+                                 e.retry_after_s))
+
+
 class OobaServer:
 
-    def __init__(self, engine: AsyncAphrodite, served_model: str) -> None:
+    def __init__(self, engine: AsyncAphrodite, served_model: str,
+                 admin_keys: Optional[List[str]] = None) -> None:
         self.engine = engine
         self.served_model = served_model
+        self.admin_keys = admin_keys
         self.tokenizer = engine.engine.tokenizer.tokenizer
 
     def build_app(self) -> web.Application:
         app = web.Application()
         app.router.add_post("/api/v1/generate", self.generate)
         app.router.add_get("/api/v1/model", self.get_model)
-        app.router.add_get("/health", self.health)
+        # Shared lifecycle surface: /health (HealthReport JSON, 503
+        # once DRAINING/DEAD), authed /admin/drain, SIGTERM drain.
+        install_lifecycle(app, self.engine, admin_keys=self.admin_keys)
         return app
 
     async def generate(self, request: web.Request) -> web.Response:
@@ -92,6 +108,8 @@ class OobaServer:
                     {"detail": str(e)}, status=429,
                     headers={"Retry-After": str(max(1, int(math.ceil(
                         e.retry_after_s))))})
+            except EngineDrainingError as e:
+                return _draining(e)
             response = web.StreamResponse()
             await response.prepare(request)
             try:
@@ -104,7 +122,7 @@ class OobaServer:
                                        request_output.outputs]}
                     await response.write(
                         (json.dumps(ret) + "\n\n").encode())
-            except RequestTimeoutError as e:
+            except (RequestTimeoutError, EngineDrainingError) as e:
                 await response.write(
                     (json.dumps({"detail": str(e)}) + "\n\n").encode())
             except BaseException:
@@ -128,6 +146,8 @@ class OobaServer:
                     e.retry_after_s))))})
         except RequestTimeoutError as e:
             return web.json_response({"detail": str(e)}, status=408)
+        except EngineDrainingError as e:
+            return _draining(e)
         assert final is not None
         return web.json_response(
             {"results": [{"text": out.text} for out in final.outputs]})
@@ -136,13 +156,11 @@ class OobaServer:
         return web.json_response(
             {"result": f"aphrodite-tpu/{self.served_model}"})
 
-    async def health(self, request) -> web.Response:
-        await self.engine.check_health()
-        return web.Response(status=200)
 
-
-def build_app(engine: AsyncAphrodite, served_model: str) -> web.Application:
-    return OobaServer(engine, served_model).build_app()
+def build_app(engine: AsyncAphrodite, served_model: str,
+              admin_keys: Optional[List[str]] = None) -> web.Application:
+    return OobaServer(engine, served_model,
+                      admin_keys=admin_keys).build_app()
 
 
 def main() -> None:
@@ -151,11 +169,18 @@ def main() -> None:
     parser.add_argument("--host", type=str, default=None)
     parser.add_argument("--port", type=int, default=5000)
     parser.add_argument("--served-model-name", type=str, default=None)
+    parser.add_argument("--admin-key", type=str, default=None,
+                        help="comma-separated keys accepted by the "
+                             "POST /admin/drain lifecycle endpoint "
+                             "(unset = endpoint disabled; SIGTERM "
+                             "drain works regardless)")
     parser = AsyncEngineArgs.add_cli_args(parser)
     args = parser.parse_args()
     engine = AsyncAphrodite.from_engine_args(
         AsyncEngineArgs.from_cli_args(args))
-    app = build_app(engine, args.served_model_name or args.model)
+    app = build_app(engine, args.served_model_name or args.model,
+                    admin_keys=args.admin_key.split(",")
+                    if args.admin_key else None)
     web.run_app(app, host=args.host, port=args.port)
 
 

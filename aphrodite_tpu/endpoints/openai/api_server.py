@@ -8,6 +8,15 @@ routes, same wire format:
 
   GET  /health            GET  /v1/models        POST /v1/tokenize
   POST /v1/completions    POST /v1/chat/completions   GET /metrics
+  POST /admin/drain  (authed; also: SIGTERM = drain-then-exit)
+
+Lifecycle (endpoints/utils.install_lifecycle, shared with the Kobold
+and Ooba frontends): /health serializes the supervisor's report (503
+once DRAINING/DEAD so load balancers eject the replica), /admin/drain
+and SIGTERM start a graceful drain — new requests get 503 +
+Retry-After (distinct from overload's 429), in-flight requests run to
+completion under APHRODITE_DRAIN_DEADLINE_S, then the process exits
+clean.
 """
 from __future__ import annotations
 
@@ -33,10 +42,13 @@ from aphrodite_tpu.endpoints.openai.protocol import (
     CompletionResponseStreamChoice, CompletionStreamResponse,
     DeltaMessage, ErrorResponse, LogProbs, ModelCard, ModelList,
     ModelPermission, TokenizeRequest, TokenizeResponse, UsageInfo)
-from aphrodite_tpu.endpoints.utils import request_disconnected
+from aphrodite_tpu.endpoints.utils import (install_lifecycle,
+                                           request_disconnected,
+                                           retry_after_headers)
 from aphrodite_tpu.engine.args_tools import AsyncEngineArgs
 from aphrodite_tpu.engine.async_aphrodite import AsyncAphrodite
-from aphrodite_tpu.processing.admission import (RequestRejectedError,
+from aphrodite_tpu.processing.admission import (EngineDrainingError,
+                                                RequestRejectedError,
                                                 RequestTimeoutError)
 
 logger = init_logger(__name__)
@@ -58,6 +70,18 @@ def _overloaded(e: RequestRejectedError) -> web.Response:
     retry_after = max(1, int(math.ceil(e.retry_after_s)))
     return web.json_response(body, status=429,
                              headers={"Retry-After": str(retry_after)})
+
+
+def _draining(e: EngineDrainingError) -> web.Response:
+    """HTTP 503 for a request rejected (or force-aborted) because the
+    replica is draining for shutdown — deliberately distinct from
+    overload's 429: 503 means "go to another replica", 429 means
+    "back off and retry here"."""
+    body = ErrorResponse(message=str(e), type="draining_error",
+                         code="503").model_dump()
+    return web.json_response(body, status=503,
+                             headers=retry_after_headers(
+                                 e.retry_after_s))
 
 
 def _timed_out(e: RequestTimeoutError) -> web.Response:
@@ -101,11 +125,13 @@ class OpenAIServer:
     def __init__(self, engine: AsyncAphrodite, served_model: str,
                  response_role: str = "assistant",
                  chat_template: Optional[str] = None,
-                 api_keys: Optional[List[str]] = None) -> None:
+                 api_keys: Optional[List[str]] = None,
+                 admin_keys: Optional[List[str]] = None) -> None:
         self.engine = engine
         self.served_model = served_model
         self.response_role = response_role
         self.api_keys = api_keys
+        self.admin_keys = admin_keys
         self.max_model_len = \
             engine.engine.model_config.max_model_len
         self.vocab_size = engine.engine.model_config.get_vocab_size()
@@ -118,7 +144,9 @@ class OpenAIServer:
     def build_app(self) -> web.Application:
         app = web.Application(middlewares=[self._auth_middleware])
         app[ENGINE_KEY] = self.engine
-        app.router.add_get("/health", self.health)
+        # /health + authed /admin/drain + SIGTERM drain-then-exit,
+        # shared with the Kobold/Ooba frontends.
+        install_lifecycle(app, self.engine, admin_keys=self.admin_keys)
         app.router.add_post("/start_profile", self.start_profile)
         app.router.add_post("/stop_profile", self.stop_profile)
         app.router.add_get("/v1/models", self.show_models)
@@ -142,22 +170,6 @@ class OpenAIServer:
         return await handler(request)
 
     # ---- simple routes ----
-
-    async def health(self, request: web.Request) -> web.Response:
-        """Engine health as JSON: state (RUNNING/DEGRADED/DEAD), last-
-        step age, step/retry counters. 200 while the engine can serve
-        (DEGRADED included — it is still making progress), 503 once it
-        is DEAD so load balancers eject the replica."""
-        from aphrodite_tpu.engine.async_aphrodite import (
-            AsyncEngineDeadError)
-        try:
-            report = await self.engine.check_health()
-        except AsyncEngineDeadError as e:
-            body = self.engine.health.report().to_json()
-            body["state"] = "DEAD"
-            body["error"] = str(e)
-            return web.json_response(body, status=503)
-        return web.json_response(report.to_json())
 
     async def start_profile(self, request: web.Request) -> web.Response:
         """Begin a jax.profiler trace (xprof/tensorboard viewable);
@@ -295,11 +307,15 @@ class OpenAIServer:
         try:
             finals = await asyncio.gather(
                 *(consume(i, p) for i, p in enumerate(prompts)))
-        except (RequestRejectedError, RequestTimeoutError) as e:
-            # Shed at admission (429 + Retry-After) or expired in the
-            # queue (408); siblings of a batch are aborted with it.
+        except (RequestRejectedError, RequestTimeoutError,
+                EngineDrainingError) as e:
+            # Shed at admission (429 + Retry-After), expired in the
+            # queue (408), or rejected/aborted by a draining replica
+            # (503); siblings of a batch are aborted with it.
             for i in range(len(prompts)):
                 self.engine.abort_request(f"{request_id}-{i}")
+            if isinstance(e, EngineDrainingError):
+                return _draining(e)
             return _overloaded(e) \
                 if isinstance(e, RequestRejectedError) else _timed_out(e)
         if any(f is None for f in finals):
@@ -342,6 +358,8 @@ class OpenAIServer:
                 request_id, text, sampling_params, **kwargs)
         except RequestRejectedError as e:
             return _overloaded(e)
+        except EngineDrainingError as e:
+            return _draining(e)
         response = _sse_response()
         await response.prepare(request)
         previous_texts = {}
@@ -371,6 +389,12 @@ class OpenAIServer:
             # typed timeout in-band, then close.
             await _sse_send(response, {"error": {
                 "message": str(e), "type": "timeout_error"}})
+            await response.write_eof()
+        except EngineDrainingError as e:
+            # Drain deadline force-abort mid-stream: in-band typed
+            # error, then close (the 503 ship has sailed).
+            await _sse_send(response, {"error": {
+                "message": str(e), "type": "draining_error"}})
             await response.write_eof()
         except Exception:
             stream.cancel()
@@ -431,6 +455,8 @@ class OpenAIServer:
             return _overloaded(e)
         except RequestTimeoutError as e:
             return _timed_out(e)
+        except EngineDrainingError as e:
+            return _draining(e)
         assert final is not None
         choices = [
             ChatCompletionResponseChoice(
@@ -457,6 +483,8 @@ class OpenAIServer:
                 request_id, prompt, sampling_params)
         except RequestRejectedError as e:
             return _overloaded(e)
+        except EngineDrainingError as e:
+            return _draining(e)
         response = _sse_response()
         await response.prepare(request)
         first = ChatCompletionStreamResponse(
@@ -488,6 +516,10 @@ class OpenAIServer:
         except RequestTimeoutError as e:
             await _sse_send(response, {"error": {
                 "message": str(e), "type": "timeout_error"}})
+            await response.write_eof()
+        except EngineDrainingError as e:
+            await _sse_send(response, {"error": {
+                "message": str(e), "type": "draining_error"}})
             await response.write_eof()
         except Exception:
             stream.cancel()
@@ -532,6 +564,11 @@ def main() -> None:
     parser.add_argument("--response-role", type=str, default="assistant")
     parser.add_argument("--api-keys", type=str, default=None,
                         help="comma-separated accepted API keys")
+    parser.add_argument("--admin-key", type=str, default=None,
+                        help="comma-separated keys accepted by the "
+                             "POST /admin/drain lifecycle endpoint "
+                             "(unset = endpoint disabled; SIGTERM "
+                             "drain works regardless)")
     parser = AsyncEngineArgs.add_cli_args(parser)
     args = parser.parse_args()
 
@@ -546,7 +583,9 @@ def main() -> None:
         engine, served_model,
         response_role=args.response_role,
         chat_template=chat_template,
-        api_keys=args.api_keys.split(",") if args.api_keys else None)
+        api_keys=args.api_keys.split(",") if args.api_keys else None,
+        admin_keys=args.admin_key.split(",") if args.admin_key
+        else None)
     logger.info("Starting OpenAI-compatible server on %s:%d",
                 args.host or "0.0.0.0", args.port)
     web.run_app(app, host=args.host, port=args.port)

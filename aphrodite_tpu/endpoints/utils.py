@@ -1,9 +1,148 @@
-"""Shared helpers for the aiohttp frontends."""
+"""Shared helpers for the aiohttp frontends: disconnect detection and
+the engine lifecycle surface (health probe, graceful drain).
+
+Every frontend (OpenAI/Kobold/Ooba) wires the SAME lifecycle pieces via
+:func:`install_lifecycle`, so a load balancer can probe any of them for
+DRAINING/REBUILDING/DEAD and an operator can roll any of them the same
+way:
+
+- ``GET /health`` — the supervisor's :class:`HealthReport` as JSON.
+  200 while the replica serves (RUNNING/DEGRADED/REBUILDING included:
+  a rebuilding engine will serve again, queued work is kept), 503 once
+  it is DRAINING (with ``Retry-After``) or DEAD, so balancers eject it.
+- ``POST /admin/drain`` — authed (``--admin-key``) graceful drain:
+  moves the engine to DRAINING, new requests get 503 + Retry-After,
+  in-flight requests run to completion under the drain deadline.
+  Body: optional ``{"deadline_s": <float>}``.
+- ``SIGTERM`` — same drain, then a clean process exit once the replica
+  is idle (or the deadline force-aborts stragglers). A second SIGTERM
+  exits immediately. This is the rolling-restart contract: deploy
+  systems send SIGTERM and no accepted request is dropped.
+"""
 from __future__ import annotations
 
+import asyncio
+import math
+import signal
+from typing import List, Optional
+
 from aiohttp import web
+
+from aphrodite_tpu.common.logger import init_logger
+
+logger = init_logger(__name__)
+
+_SIGTERM_INSTALLED = web.AppKey("aphrodite_sigterm_installed", bool)
 
 
 async def request_disconnected(request: web.Request) -> bool:
     """True when the client hung up (abort-on-disconnect checks)."""
     return request.transport is None or request.transport.is_closing()
+
+
+def retry_after_headers(seconds: float) -> dict:
+    """`Retry-After` header dict (whole seconds, at least 1)."""
+    return {"Retry-After": str(max(1, int(math.ceil(seconds))))}
+
+
+async def health_response(engine) -> web.Response:
+    """Serialize the engine's HealthReport with load-balancer-ready
+    status codes (shared by all three frontends' /health routes)."""
+    from aphrodite_tpu.engine.async_aphrodite import AsyncEngineDeadError
+    try:
+        report = await engine.check_health()
+    except AsyncEngineDeadError as e:
+        body = engine.health.report().to_json()
+        body["state"] = "DEAD"
+        body["error"] = str(e)
+        return web.json_response(body, status=503)
+    body = report.to_json()
+    if report.state == "DRAINING":
+        # 503 turns balancers away; Retry-After says when a
+        # replacement replica should be taking the traffic.
+        rem = engine.health.drain_remaining_s
+        return web.json_response(
+            body, status=503,
+            headers=retry_after_headers(rem if rem is not None else 30))
+    return web.json_response(body)
+
+
+def _admin_drain_handler(engine, admin_keys: Optional[List[str]]):
+    async def admin_drain(request: web.Request) -> web.Response:
+        if not admin_keys:
+            return web.json_response(
+                {"detail": "admin drain is disabled: start the server "
+                           "with --admin-key"}, status=403)
+        token = request.headers.get("Authorization", "")\
+            .removeprefix("Bearer ").strip()
+        if token not in admin_keys:
+            return web.json_response({"detail": "invalid admin key"},
+                                     status=401)
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        deadline_s = body.get("deadline_s")
+        granted = engine.start_drain(
+            float(deadline_s) if deadline_s is not None else None,
+            reason="admin drain request")
+        return web.json_response({"state": "DRAINING",
+                                  "drain_deadline_s": granted})
+    return admin_drain
+
+
+def _raise_graceful_exit() -> None:
+    # SystemExit-derived: propagates out of run_forever and shuts
+    # web.run_app down through its normal cleanup path.
+    raise web.GracefulExit()
+
+
+async def _drain_then_exit(engine) -> None:
+    engine.start_drain(reason="SIGTERM")
+    clean = await engine.drained()
+    logger.info("Drain %s; exiting.",
+                "complete" if clean
+                else "deadline-forced (stragglers got typed errors)")
+    asyncio.get_running_loop().call_soon(_raise_graceful_exit)
+
+
+def install_lifecycle(app: web.Application, engine,
+                      admin_keys: Optional[List[str]] = None) -> None:
+    """Wire the shared lifecycle surface onto one frontend app:
+    GET /health, the authed POST /admin/drain, and a SIGTERM handler
+    that drains before exiting (see module docstring)."""
+
+    async def health(request: web.Request) -> web.Response:
+        return await health_response(engine)
+
+    app.router.add_get("/health", health)
+    app.router.add_post("/admin/drain",
+                        _admin_drain_handler(engine, admin_keys))
+
+    async def on_startup(started_app: web.Application) -> None:
+        loop = asyncio.get_running_loop()
+
+        def on_term() -> None:
+            if engine.is_draining:
+                logger.warning("Second SIGTERM: exiting immediately.")
+                _raise_graceful_exit()
+            logger.info("SIGTERM: draining before exit.")
+            loop.create_task(_drain_then_exit(engine))
+
+        try:
+            # Replaces aiohttp's default immediate-exit SIGTERM
+            # binding with drain-then-exit.
+            loop.add_signal_handler(signal.SIGTERM, on_term)
+            started_app[_SIGTERM_INSTALLED] = True
+        except (NotImplementedError, RuntimeError) as e:
+            # Non-unix platform or a non-main-thread loop: drains are
+            # still available via /admin/drain.
+            logger.warning("SIGTERM drain handler unavailable: %s", e)
+
+    async def on_cleanup(stopped_app: web.Application) -> None:
+        if stopped_app.get(_SIGTERM_INSTALLED):
+            asyncio.get_running_loop().remove_signal_handler(
+                signal.SIGTERM)
+
+    app.on_startup.append(on_startup)
+    app.on_cleanup.append(on_cleanup)

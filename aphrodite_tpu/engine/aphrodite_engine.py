@@ -13,8 +13,11 @@ prefix pool, metrics) keeps reference semantics.
 """
 from __future__ import annotations
 
+import dataclasses
+import threading
 import time
-from typing import Iterable, List, Optional, Tuple, Union
+from typing import (Callable, Dict, Iterable, List, Optional, Tuple,
+                    Union)
 
 from aphrodite_tpu.common import faultinject, flags
 from aphrodite_tpu.common.config import (CacheConfig, DeviceConfig,
@@ -29,7 +32,10 @@ from aphrodite_tpu.common.sequence import (SamplerOutput, Sequence,
                                            SequenceStatus)
 from aphrodite_tpu.engine.args_tools import EngineArgs
 from aphrodite_tpu.engine.metrics import StatLogger, Stats
-from aphrodite_tpu.engine.supervisor import FaultClass, classify_failure
+from aphrodite_tpu.engine.supervisor import (FaultClass,
+                                             RequestLostOnRebuild,
+                                             StaleEngineStepError,
+                                             classify_failure)
 from aphrodite_tpu.executor.executor import TPUExecutor
 from aphrodite_tpu.processing.admission import (AdmissionController,
                                                 AdmissionSnapshot,
@@ -41,6 +47,13 @@ from aphrodite_tpu.transformers_utils.tokenizer import (
 from aphrodite_tpu.common.utils import Counter
 
 logger = init_logger(__name__)
+
+
+@dataclasses.dataclass
+class ReincarnationOutcome:
+    """What one engine rebuild restored vs lost (health counters)."""
+    restored: int
+    lost: List[str]
 
 
 def _enable_compilation_cache() -> None:
@@ -149,6 +162,18 @@ class AphroditeEngine:
         # the step pipelines builder rounds) — the crash barrier's
         # rollback scope.
         self._inflight_rounds: List[SchedulerOutputs] = []
+        # Reincarnation epoch: bumped by reincarnate(). Each step
+        # thread stamps the epoch it started under in thread-local
+        # storage; a step that outlives a rebuild (a watchdog-
+        # abandoned thread waking up) sees the mismatch and raises
+        # StaleEngineStepError instead of committing tokens or
+        # rollbacks against the rebuilt scheduler.
+        self._epoch = 0
+        self._step_tls = threading.local()
+        # Optional lifecycle-stats provider (set by the async wrapper:
+        # health state code, reincarnation counters, drain remaining)
+        # merged into every Stats snapshot for Prometheus.
+        self.lifecycle_source: Optional[Callable[[], Dict]] = None
 
     # -- profiling (reference aux tracing; TPU-native: jax.profiler
     #    traces carry XLA/TPU timelines viewable in tensorboard/xprof) --
@@ -335,6 +360,7 @@ class AphroditeEngine:
         propagates, so a retried step neither leaks KV pages nor
         double-schedules. Requests the rollback could not restore are
         recorded in `_step_faults` (drained by `drain_step_faults`)."""
+        self._step_tls.epoch = self._epoch
         faultinject.fire("engine.step")
         self._inflight_rounds = []
         self._expire_deadlines()
@@ -345,6 +371,15 @@ class AphroditeEngine:
             return self._execute_round(seq_group_metadata_list,
                                        scheduler_outputs)
         except Exception as exc:
+            if self._step_tls.epoch != self._epoch:
+                # The engine reincarnated under this step (a watchdog-
+                # abandoned thread waking up): the rounds it holds
+                # belong to the torn-down scheduler — rolling them
+                # back against the rebuilt one would corrupt restored
+                # requests.
+                raise StaleEngineStepError(
+                    "engine step outlived a reincarnation; its "
+                    "rollback is discarded") from exc
             for rid in self.scheduler.crash_rollback(
                     self._inflight_rounds):
                 err: Exception = RuntimeError(
@@ -354,6 +389,68 @@ class AphroditeEngine:
                 err.__cause__ = exc
                 self._step_faults.append((rid, err))
             raise
+
+    # -- reincarnation (FATAL-fault recovery) --------------------------
+
+    def reincarnate(self) -> "ReincarnationOutcome":
+        """Tear down and rebuild the device half of the engine after a
+        FATAL step fault, restoring every restorable request.
+
+        The executor (model, runner, KV pool) and the scheduler (block
+        manager, prefix pool, queues) are rebuilt from the original
+        configs, so the free-page count returns exactly to its boot
+        value. Restorable requests — everything the crash barrier can
+        express as a recompute prompt, i.e. single-sequence groups plus
+        anything still waiting — re-enter the fresh waiting queue in
+        FCFS order with their prefixes re-keyed into the new prefix
+        pool (the old pool's KV pages are gone; a re-keyed prefix
+        simply recomputes). Un-restorable groups (forked beam KV,
+        swapped-out pages whose host copies die with the pool) get a
+        typed :class:`RequestLostOnRebuild` on the step-fault seam.
+
+        Bumps the reincarnation epoch so a step that was still wedged
+        in the OLD executor when the watchdog abandoned it can never
+        commit tokens or rollbacks against the rebuilt state
+        (:class:`StaleEngineStepError`). Blocking (model load + cache
+        init); the async wrapper runs it off-loop under REBUILDING.
+        """
+        self._epoch += 1
+        old_sched = self.scheduler
+        # Conservatively roll back anything mid-flight (idempotent —
+        # the step's own crash barrier usually already ran).
+        lost = list(old_sched.crash_rollback(None))
+        # Swapped-out groups: their KV lives in the host pool this
+        # rebuild discards, and recompute cannot reproduce it.
+        for group in list(old_sched.swapped):
+            lost.append(group.request_id)
+            old_sched.abort_seq_group(group.request_id)
+        restorable = [g for g in old_sched.waiting
+                      if not g.is_finished()]
+        logger.warning(
+            "Reincarnating engine: rebuilding executor + KV pool, "
+            "restoring %d request(s), %d unrestorable.",
+            len(restorable), len(lost))
+        # Device half first: if THIS throws the engine is beyond
+        # saving and the caller falls through to DEAD.
+        self.executor = TPUExecutor(self.model_config, self.cache_config,
+                                    self.parallel_config,
+                                    self.scheduler_config,
+                                    self.device_config, self.lora_config)
+        self.scheduler = Scheduler(self.scheduler_config,
+                                   self.cache_config, self.lora_config)
+        for group in restorable:
+            if group.prefix is not None:
+                group.prefix = self.scheduler.prefix_pool.\
+                    add_or_get_prefix(group.prefix.token_ids)
+            self.scheduler.add_seq_group(group)
+        self._inflight_rounds = []
+        for rid in lost:
+            self._step_faults.append((rid, RequestLostOnRebuild(
+                f"request {rid} could not be restored across an "
+                "engine rebuild (forked or swapped KV state is not "
+                "recomputable from tokens)")))
+        return ReincarnationOutcome(restored=len(restorable),
+                                    lost=lost)
 
     def drain_step_faults(self) -> List[Tuple[str, Exception]]:
         """(request_id, exception) pairs for requests this step aborted
@@ -564,6 +661,13 @@ class AphroditeEngine:
         """Apply one round's sampled tokens: final prompt chunks first
         (mid-prompt chunks wrote KV but sample nothing), then each decode
         step's outputs (a burst passes several)."""
+        if getattr(self._step_tls, "epoch", self._epoch) != self._epoch:
+            # This thread's step started before a reincarnation: its
+            # groups were already restored (or errored) by the rebuild
+            # — committing its tokens now would double-append.
+            raise StaleEngineStepError(
+                "engine step outlived a reincarnation; its outputs "
+                "are discarded")
         touched: List = []
         tokens_of = {}
         failed: set = set()
@@ -887,7 +991,16 @@ class AphroditeEngine:
         ttfts, self._ttft_samples = self._ttft_samples, []
         tpots, self._tpot_samples = self._tpot_samples, []
         e2es, self._e2e_samples = self._e2e_samples, []
+        lifecycle: Dict = {}
+        if self.lifecycle_source is not None:
+            try:
+                lifecycle = self.lifecycle_source() or {}
+            except Exception as e:
+                # Stats must never kill a step; the gauges just skip
+                # one tick.
+                logger.debug("lifecycle stats unavailable: %s", e)
         return Stats(
+            **lifecycle,
             now=now,
             num_running=(len(self.scheduler.running) +
                          len(self.scheduler.prefilling)),

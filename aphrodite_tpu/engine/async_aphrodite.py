@@ -15,12 +15,26 @@ Supervision (engine/supervisor.py): step failures are classified by
 blast radius — request-scoped failures error only the culprit stream,
 transient engine failures are crash-rolled-back and retried with
 bounded exponential backoff (`APHRODITE_STEP_RETRIES` /
-`APHRODITE_STEP_BACKOFF_S`), and unrecoverable failures move the
-engine to a terminal DEAD state where in-flight, pending, and new
-requests all fail fast with `AsyncEngineDeadError` instead of
-hanging. A watchdog (`APHRODITE_STEP_TIMEOUT_S`) bounds the off-loop
-step so a hung XLA compile is detected rather than wedging forever
-behind a healthy-looking `check_health`.
+`APHRODITE_STEP_BACKOFF_S`). FATAL failures trigger **reincarnation**
+(`_try_reincarnate`): up to `APHRODITE_REINCARNATIONS` times, the
+engine tears down and rebuilds its executor/model-runner/KV pool
+under the REBUILDING health state, restores every restorable request
+to the waiting queue with streams intact, and resumes the loop — only
+an exhausted budget (or a failed rebuild) moves the engine to the
+terminal DEAD state where in-flight, pending, and new requests all
+fail fast with `AsyncEngineDeadError` instead of hanging. A watchdog
+(`APHRODITE_STEP_TIMEOUT_S`) bounds the off-loop step so a hung XLA
+compile is detected rather than wedging forever behind a
+healthy-looking `check_health`.
+
+Lifecycle (graceful drain): `start_drain()` moves the replica to the
+DRAINING health state — new requests are rejected with a typed
+`EngineDrainingError` (HTTP 503 + Retry-After at the frontends, kept
+deliberately distinct from overload's 429) while in-flight requests
+run to completion under a drain deadline
+(`APHRODITE_DRAIN_DEADLINE_S`); `drained()` resolves when the replica
+is idle (or the deadline force-aborts the stragglers), letting
+SIGTERM handlers exit the process without dropping accepted work.
 
 Overload control (processing/admission.py): `add_request` consults
 the engine's admission controller BEFORE enqueueing — requests past
@@ -49,8 +63,10 @@ from aphrodite_tpu.engine.supervisor import (FaultClass, HealthMonitor,
                                              HealthReport,
                                              StepTimeoutError,
                                              classify_failure,
+                                             reincarnation_policy,
                                              retry_policy)
-from aphrodite_tpu.processing.admission import RequestRejectedError
+from aphrodite_tpu.processing.admission import (EngineDrainingError,
+                                                RequestRejectedError)
 
 logger = init_logger(__name__)
 
@@ -185,6 +201,10 @@ class RequestTracker:
         handed to the engine."""
         return self._pending_new, self._pending_tokens
 
+    def tracked_ids(self) -> List[str]:
+        """Request ids with a live stream (drain force-abort scope)."""
+        return list(self._request_streams)
+
     def __contains__(self, item) -> bool:
         return item in self._request_streams
 
@@ -294,6 +314,9 @@ class AsyncAphrodite:
         self.health = HealthMonitor()
         self.background_loop: Optional[asyncio.Future] = None
         self._background_loop_unshielded = None
+        # Lifecycle gauges (state code, reincarnation counters, drain
+        # remaining) ride the engine's per-round Stats into Prometheus.
+        self.engine.lifecycle_source = self._lifecycle_stats
 
     @classmethod
     def from_engine_args(cls, engine_args: AsyncEngineArgs,
@@ -357,6 +380,58 @@ class AsyncAphrodite:
             self._request_tracker.propagate_exception(exc, request_id)
             self._request_tracker.abort_request(request_id)
 
+    async def _try_reincarnate(self, exc: BaseException) -> bool:
+        """Attempt a bounded engine rebuild after a FATAL step fault.
+
+        Returns True when the engine was rebuilt and the loop should
+        resume stepping (restorable requests are back in `waiting`,
+        un-restorable streams got their typed errors); False when the
+        budget is exhausted or the rebuild itself failed — the caller
+        falls through to the terminal DEAD path.
+        """
+        max_rebuilds, base_backoff = reincarnation_policy()
+        n = self.health.reincarnations_total + 1
+        if n > max_rebuilds:
+            if max_rebuilds > 0:
+                logger.error(
+                    "Reincarnation budget exhausted "
+                    "(APHRODITE_REINCARNATIONS=%d); going DEAD.",
+                    max_rebuilds)
+            return False
+        delay = base_backoff * (2 ** (n - 1)) if base_backoff else 0.0
+        logger.warning(
+            "FATAL engine fault (%s: %s): reincarnation %d/%d in "
+            "%.2fs — rebuilding executor/KV pool and restoring the "
+            "waiting queue.", type(exc).__name__, exc, n, max_rebuilds,
+            delay)
+        self.health.begin_rebuild()
+        try:
+            if delay:
+                await asyncio.sleep(delay)
+            t0 = time.monotonic()
+            # Blocking (model load + cache init): off-loop, so the
+            # event loop keeps answering /health with REBUILDING and
+            # keeps queueing new arrivals for the rebuilt engine.
+            outcome = await asyncio.get_event_loop().run_in_executor(
+                None, self.engine.reincarnate)
+        except Exception as rebuild_exc:
+            logger.error("engine rebuild failed: %s: %s",
+                         type(rebuild_exc).__name__, rebuild_exc)
+            self.health.end_rebuild(success=False)
+            return False
+        self.health.end_rebuild(success=True, restored=outcome.restored,
+                                lost=len(outcome.lost),
+                                duration_s=time.monotonic() - t0)
+        # Typed RequestLostOnRebuild for the casualties, delivered to
+        # exactly those streams; restored streams just keep waiting.
+        self._propagate_step_faults()
+        logger.info(
+            "Engine reincarnated in %.2fs: %d request(s) restored, "
+            "%d lost (typed errors delivered).",
+            self.health.last_rebuild_s or 0.0, outcome.restored,
+            len(outcome.lost))
+        return True
+
     def _die(self, exc: Exception) -> None:
         """Terminal transition: record DEAD, fail every in-flight and
         queued stream fast, and stop the loop."""
@@ -377,7 +452,9 @@ class AsyncAphrodite:
 
         Supervision: transient step failures are retried (the engine's
         crash barrier already rolled the round back) with bounded
-        exponential backoff; anything else is terminal."""
+        exponential backoff; FATAL failures (and exhausted retries)
+        attempt a bounded reincarnation — executor/KV rebuild with the
+        waiting queue restored — before the terminal DEAD state."""
         new_requests, finished_requests = \
             self._request_tracker.get_new_and_finished_requests()
 
@@ -415,6 +492,11 @@ class AsyncAphrodite:
                         " retrying in %.3fs): %s: %s", attempt,
                         max_retries, delay, type(exc).__name__, exc)
                     await asyncio.sleep(delay)
+                    continue
+                # FATAL (or retries exhausted): the bigger hammer —
+                # rebuild the engine and resume, budget permitting.
+                if await self._try_reincarnate(exc):
+                    attempt = 0     # fresh engine, fresh retry budget
                     continue
                 self._die(exc)
 
@@ -473,20 +555,35 @@ class AsyncAphrodite:
                 "Engine is DEAD ("
                 + (self.health.dead_reason or "unknown failure")
                 + "); new requests fail fast. Restart the server.")
+        if self.health.is_draining:
+            # Drain gate, BEFORE the overload gate: a draining replica
+            # answers 503 (go elsewhere), never 429 (retry here) — the
+            # two must stay distinct for load balancers.
+            rem = self.health.drain_remaining_s
+            retry_after = 5.0 if rem is None else \
+                max(1.0, min(rem + 1.0, 60.0))
+            raise EngineDrainingError(
+                "server is draining for shutdown; retry against "
+                "another replica", retry_after_s=retry_after)
         # Overload gate: shed BEFORE enqueueing — a queue we cannot
         # drain in time is a promise we cannot keep. Rejected requests
         # never touch the tracker or the allocator; the frontends map
         # RequestRejectedError to HTTP 429 + Retry-After.
-        pending_depth, pending_tokens = \
-            self._request_tracker.pending_load()
-        try:
-            self.engine.try_admit(
-                self._estimate_prompt_tokens(prompt, prompt_token_ids),
-                sampling_params, extra_depth=pending_depth,
-                extra_tokens=pending_tokens)
-        except RequestRejectedError:
-            self.health.record_shed()
-            raise
+        if not self.health.is_rebuilding:
+            # (During a rebuild the scheduler object is being swapped
+            # off-loop; arrivals just queue in the tracker and face
+            # admission again post-rebuild via pending_load.)
+            pending_depth, pending_tokens = \
+                self._request_tracker.pending_load()
+            try:
+                self.engine.try_admit(
+                    self._estimate_prompt_tokens(prompt,
+                                                 prompt_token_ids),
+                    sampling_params, extra_depth=pending_depth,
+                    extra_tokens=pending_tokens)
+            except RequestRejectedError:
+                self.health.record_shed()
+                raise
         if not self.is_running:
             if self.start_engine_loop:
                 self.start_background_loop()
@@ -544,6 +641,80 @@ class AsyncAphrodite:
         self._request_tracker.abort_request(
             request_id, verbose=self.log_requests)
 
+    # -- graceful drain (rolling restarts, SIGTERM) --------------------
+
+    @property
+    def is_draining(self) -> bool:
+        return self.health.is_draining
+
+    def start_drain(self, deadline_s: Optional[float] = None,
+                    reason: str = "shutdown requested") -> float:
+        """Enter DRAINING: new requests are rejected with a typed
+        `EngineDrainingError` (HTTP 503 + Retry-After at the
+        frontends) while in-flight work runs to completion. Returns
+        the granted deadline in seconds (0 = unbounded). Idempotent —
+        the first caller's deadline wins."""
+        if self.health.is_draining:
+            rem = self.health.drain_remaining_s
+            return max(0.0, rem) if rem is not None else 0.0
+        if deadline_s is None:
+            deadline_s = flags.get_float("APHRODITE_DRAIN_DEADLINE_S")
+        deadline = (time.monotonic() + deadline_s
+                    if deadline_s and deadline_s > 0 else None)
+        self.health.mark_draining(deadline)
+        logger.info(
+            "Draining (%s): new requests now get 503 + Retry-After; "
+            "%s.", reason,
+            f"in-flight work has {deadline_s:g}s to finish"
+            if deadline is not None
+            else "waiting for in-flight work without a deadline")
+        return deadline_s if deadline is not None else 0.0
+
+    async def drained(self, poll_s: float = 0.05) -> bool:
+        """Resolve once the draining replica is idle. True = every
+        in-flight request ran to completion; False = the drain
+        deadline expired and the stragglers were aborted with a typed
+        `EngineDrainingError` (or the engine died mid-drain). Safe to
+        call from a SIGTERM handler task — the serving loop keeps
+        running underneath."""
+        while True:
+            if self.health.is_dead:
+                return False        # fail_all already errored streams
+            if not self.engine.has_unfinished_requests() and \
+                    self._request_tracker.pending_load()[0] == 0:
+                return True
+            rem = self.health.drain_remaining_s
+            if rem is not None and rem <= 0:
+                err = EngineDrainingError(
+                    "drain deadline exceeded; request aborted during "
+                    "shutdown", retry_after_s=1.0)
+                aborted = 0
+                for rid in self._request_tracker.tracked_ids():
+                    self._request_tracker.propagate_exception(err, rid)
+                    self._abort(rid)
+                    aborted += 1
+                logger.warning(
+                    "Drain deadline exceeded: aborted %d in-flight "
+                    "request(s) with typed errors.", aborted)
+                return False
+            await asyncio.sleep(poll_s)
+
+    def _lifecycle_stats(self) -> dict:
+        """Per-round lifecycle gauge values (merged into Stats by the
+        sync engine; read from the step thread, so everything here is
+        a cheap atomic read)."""
+        h = self.health
+        rem = h.drain_remaining_s
+        return dict(
+            state_code=h.state(in_flight=True).code,
+            inflight=self.engine.get_num_unfinished_requests(),
+            # -1 = no deadline ticking (not draining, or draining
+            # unbounded — state_code distinguishes).
+            drain_remaining_s=(-1.0 if rem is None else max(0.0, rem)),
+            reincarnations_total=h.reincarnations_total,
+            restored_total=h.requests_restored_total,
+            lost_total=h.requests_lost_total)
+
     @staticmethod
     def _estimate_prompt_tokens(prompt: Optional[str],
                                 prompt_token_ids: Optional[List[int]]
@@ -560,15 +731,26 @@ class AsyncAphrodite:
         return self.engine.get_model_config()
 
     async def check_health(self) -> HealthReport:
-        """RUNNING/DEGRADED/DEAD report with last-step age and retry
-        counters (surfaced by the OpenAI /health endpoint); raises
-        AsyncEngineDeadError when the engine can no longer serve."""
+        """RUNNING/DEGRADED/DRAINING/REBUILDING/DEAD report with
+        last-step age, retry and lifecycle counters (surfaced by every
+        frontend's /health endpoint); raises AsyncEngineDeadError when
+        the engine can no longer serve."""
         if self.health.is_dead:
             raise AsyncEngineDeadError(
                 "Engine is DEAD: "
                 + (self.health.dead_reason or "unknown failure"))
-        if not self.is_running:
+        if not self.is_running and not self.start_engine_loop:
+            # With lazy start the loop legitimately isn't running until
+            # the first request — an idle fresh replica is healthy. A
+            # crashed loop always records DEAD first (handled above).
             raise AsyncEngineDeadError("Background loop is stopped.")
+        try:
+            overload = self.engine.overload_snapshot().to_json()
+        except RuntimeError as e:
+            # Mid-rebuild the scheduler object is being swapped
+            # off-loop; skip one snapshot rather than 500 the probe.
+            logger.debug("overload snapshot unavailable: %s", e)
+            overload = None
         return self.health.report(
             in_flight=self.engine.has_unfinished_requests(),
-            overload=self.engine.overload_snapshot().to_json())
+            overload=overload)

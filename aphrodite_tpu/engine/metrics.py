@@ -94,6 +94,33 @@ class Metrics:
             Counter, "aphrodite:num_requests_expired",
             "Requests expired in the waiting queue past their TTFT "
             "deadline.", labelnames)
+        # Lifecycle gauges/counters (engine/supervisor.py): drain and
+        # reincarnation state, mirrored in the /health report so load
+        # balancers and dashboards see the same numbers.
+        self.gauge_engine_state = _get_or_create(
+            Gauge, "aphrodite:engine_lifecycle_state",
+            "Engine lifecycle state code (0=RUNNING 1=DEGRADED "
+            "2=DRAINING 3=REBUILDING 4=DEAD).", labelnames)
+        self.gauge_inflight = _get_or_create(
+            Gauge, "aphrodite:num_requests_inflight",
+            "Unfinished requests owned by the engine (waiting + "
+            "prefilling + running + swapped).", labelnames)
+        self.gauge_drain_remaining = _get_or_create(
+            Gauge, "aphrodite:drain_deadline_remaining_seconds",
+            "Seconds before a draining engine force-aborts in-flight "
+            "work (-1 = no drain deadline ticking).", labelnames)
+        self.counter_reincarnations = _get_or_create(
+            Counter, "aphrodite:reincarnations_total",
+            "Engine rebuilds (executor/KV teardown + restore) after "
+            "FATAL step faults.", labelnames)
+        self.counter_requests_restored = _get_or_create(
+            Counter, "aphrodite:requests_restored_total",
+            "Requests restored into the waiting queue across engine "
+            "rebuilds.", labelnames)
+        self.counter_requests_lost = _get_or_create(
+            Counter, "aphrodite:requests_lost_on_rebuild_total",
+            "Requests an engine rebuild could not restore (typed "
+            "errors delivered to their streams).", labelnames)
 
 
 @dataclass
@@ -117,6 +144,14 @@ class Stats:
     expired_total: int = 0
     ewma_prefill_tok_s: float = 0.0
     ewma_decode_tok_s: float = 0.0
+    # Lifecycle snapshot (provided by the async wrapper's
+    # lifecycle_source; cumulative counters get delta-exported).
+    state_code: int = 0
+    inflight: int = 0
+    drain_remaining_s: float = -1.0
+    reincarnations_total: int = 0
+    restored_total: int = 0
+    lost_total: int = 0
 
 
 class StatLogger:
@@ -132,6 +167,9 @@ class StatLogger:
         # Cumulative counts already exported, for counter deltas.
         self._sheds_exported = 0
         self._expired_exported = 0
+        self._reinc_exported = 0
+        self._restored_exported = 0
+        self._lost_exported = 0
         self.metrics = Metrics(labelnames=list(self.labels.keys()))
 
     def _throughput(self, tracked: List[int], now: float) -> float:
@@ -162,6 +200,21 @@ class StatLogger:
             max(0, stats.expired_total - self._expired_exported))
         self._expired_exported = max(self._expired_exported,
                                      stats.expired_total)
+        labeled(m.gauge_engine_state).set(stats.state_code)
+        labeled(m.gauge_inflight).set(stats.inflight)
+        labeled(m.gauge_drain_remaining).set(stats.drain_remaining_s)
+        labeled(m.counter_reincarnations).inc(
+            max(0, stats.reincarnations_total - self._reinc_exported))
+        self._reinc_exported = max(self._reinc_exported,
+                                   stats.reincarnations_total)
+        labeled(m.counter_requests_restored).inc(
+            max(0, stats.restored_total - self._restored_exported))
+        self._restored_exported = max(self._restored_exported,
+                                      stats.restored_total)
+        labeled(m.counter_requests_lost).inc(
+            max(0, stats.lost_total - self._lost_exported))
+        self._lost_exported = max(self._lost_exported,
+                                  stats.lost_total)
         for t in stats.time_to_first_tokens:
             labeled(m.histogram_time_to_first_token).observe(t)
         for t in stats.time_per_output_tokens:

@@ -84,6 +84,23 @@ class RequestTimeoutError(RuntimeError):
     Surfaced typed on the request's `AsyncStream`."""
 
 
+class EngineDrainingError(RuntimeError):
+    """The replica is draining for shutdown (SIGTERM / admin drain):
+    new work is rejected so a rolling restart can complete. Distinct
+    from :class:`RequestRejectedError` on purpose — the frontends map
+    draining to HTTP 503 (route to another replica) and overload to
+    429 (back off and retry here), and the two must never blur.
+
+    Also delivered mid-stream to in-flight requests the drain deadline
+    force-aborted. `retry_after_s` estimates when a replacement
+    replica should be taking traffic.
+    """
+
+    def __init__(self, message: str, retry_after_s: float = 1.0) -> None:
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
 def _clamp_retry(value: float) -> float:
     return max(_RETRY_AFTER_MIN_S, min(_RETRY_AFTER_MAX_S, value))
 

@@ -734,7 +734,11 @@ class Scheduler:
                         seen.add(id(group))
                         groups.append(group)
 
-        for group in groups:
+        # Reversed: each recompute rollback appendlefts its group, so
+        # walking back-to-front restores the groups' RELATIVE order at
+        # the head of `waiting` (FCFS survives the rollback — and a
+        # reincarnation restore sees the true queue order).
+        for group in reversed(groups):
             if group.is_finished():
                 # Fully processed before the failure; just make sure it
                 # is off the queues (free_finished never ran).
@@ -756,7 +760,8 @@ class Scheduler:
         # Re-queue this round's ignored groups so the retried round
         # re-emits their FINISHED_IGNORED outputs (they were already
         # popped from `waiting`; without this their streams hang).
-        for group in self._round_ignored:
+        # Reversed for the same relative-order reason as above.
+        for group in reversed(self._round_ignored):
             requeued = False
             for seq in group.get_seqs():
                 if seq.status == SequenceStatus.FINISHED_IGNORED:

@@ -34,6 +34,20 @@ overhead and degradation are measured with the same harness as the
 baseline. A `--chaos` run with `--chaos-fault none --chaos-abort-rate
 0` measures pure accounting overhead and must match baseline
 throughput within noise.
+
+Kill-chaos mode (`--chaos-kill`): the lifecycle proof. A FATAL fault
+(`--kill-fault`) is armed AFTER warmup so it fires mid-measurement;
+the engine must reincarnate (rebuild executor/KV pool, restore the
+waiting queue) and finish the run. The JSON gains a `chaos_kill`
+section asserting the zero-lost-requests invariant — every request
+either completed or received a typed error (`requests_unaccounted`
+must be 0), free pages return to `free0` on the REBUILT pool
+(`kv_leak_pages` must be 0) — plus the recovery time
+(`recovery_s` = executor+KV rebuild wall time). A drain storm
+follows: with requests in flight the replica enters DRAINING, late
+arrivals must be rejected with the typed 503-class error while every
+in-flight request runs to completion, proving the SIGTERM
+rolling-restart contract in-process.
 """
 from __future__ import annotations
 
@@ -75,6 +89,9 @@ async def run(args) -> dict:
     chaos_fault = str(getattr(args, "chaos_fault", "") or "")
     chaos_abort_rate = float(getattr(args, "chaos_abort_rate", 0.0)
                              or 0.0)
+    chaos_kill = bool(getattr(args, "chaos_kill", False))
+    kill_fault = str(getattr(args, "kill_fault", "") or
+                     "executor.execute_model:fatal:0.05:1")
     overload = bool(getattr(args, "overload", False))
     overload_mult = float(getattr(args, "overload_mult", 2.0) or 2.0)
     deadline_s = float(getattr(args, "deadline_s", 2.0) or 2.0)
@@ -96,6 +113,10 @@ async def run(args) -> dict:
         os.environ["APHRODITE_FAULT_SEED"] = str(
             getattr(args, "chaos_seed", 0) or 0)
         faultinject.reset()
+    if chaos_kill:
+        # Reincarnation must be armed for the kill to be survivable;
+        # respect an operator's explicit budget.
+        os.environ.setdefault("APHRODITE_REINCARNATIONS", "3")
 
     engine = AsyncAphrodite.from_engine_args(AsyncEngineArgs(
         model=args.model, load_format=args.load_format,
@@ -293,8 +314,16 @@ async def run(args) -> dict:
 
     block_manager = engine.engine.scheduler.block_manager
     free0 = block_manager.get_num_free_gpu_blocks()
+    if chaos_kill and kill_fault != "none":
+        # Armed AFTER warmup so the FATAL fires mid-measurement, not
+        # during the compile pass (count=1 spends the rule wherever it
+        # first fires).
+        os.environ["APHRODITE_FAULT"] = kill_fault
+        os.environ["APHRODITE_FAULT_SEED"] = str(
+            getattr(args, "chaos_seed", 0) or 0)
+        faultinject.reset()
     wall = await drive()
-    if overload:
+    if overload or chaos_kill:
         await drain_to_idle()
 
     def pct(xs, p):
@@ -367,6 +396,85 @@ async def run(args) -> dict:
             # shared ttft_p99 field above; survivors only.
             "degraded_ttft_p99": detail["ttft_p99"],
         }
+    if chaos_kill:
+        from aphrodite_tpu.processing.admission import (
+            EngineDrainingError)
+
+        health = engine.health
+        # The block manager may be a REBUILT object by now — the
+        # zero-leak invariant is that the fresh pool's free count
+        # equals the original free0 (same configs size both pools).
+        bm_now = engine.engine.scheduler.block_manager
+        accounted = sum(outcomes.values())
+        detail["chaos_kill"] = {
+            "fault_spec": kill_fault,
+            "reincarnations": health.reincarnations_total,
+            "requests_restored": health.requests_restored_total,
+            "requests_lost_typed": health.requests_lost_total,
+            "recovery_s": round(health.last_rebuild_s or 0.0, 3),
+            "engine_state": health.report(
+                in_flight=engine.engine.has_unfinished_requests()
+            ).state,
+            # Zero-lost invariant: every request completed or got a
+            # typed error — nothing silently vanished.
+            "requests_unaccounted": args.num_requests - accounted,
+            "free_pages_before": free0,
+            "free_pages_after": bm_now.get_num_free_gpu_blocks(),
+            "kv_leak_pages": free0 - bm_now.get_num_free_gpu_blocks(),
+            "faults_fired": faultinject.stats(),
+        }
+
+        # Drain storm: the SIGTERM rolling-restart contract proven
+        # in-process — in-flight requests complete, late arrivals get
+        # the typed 503-class rejection, the replica goes idle.
+        async def drain_storm(n_inflight=4, n_late=4) -> dict:
+            sp = SamplingParams(temperature=0.0,
+                                max_tokens=args.output_len,
+                                ignore_eos=True)
+
+            async def serve(i: int):
+                final = None
+                async for out in engine.generate(
+                        None, sp, f"drain-{i}",
+                        prompt_token_ids=prompts[i]):
+                    final = out
+                return final
+
+            tasks = [asyncio.create_task(serve(i))
+                     for i in range(n_inflight)]
+            await asyncio.sleep(0.05)       # let them admit
+            t0 = time.perf_counter()
+            engine.start_drain(deadline_s=60.0,
+                               reason="chaos-kill drain storm")
+            rejected = 0
+            for j in range(n_late):
+                try:
+                    async for _ in engine.generate(
+                            None, sp, f"late-{j}",
+                            prompt_token_ids=prompts[j]):
+                        pass
+                except EngineDrainingError:
+                    rejected += 1
+                except Exception as e:
+                    logger_warn("late request %d unexpected error: "
+                                "%s: %s", j, type(e).__name__, e)
+            clean = await engine.drained()
+            finals = await asyncio.gather(*tasks,
+                                          return_exceptions=True)
+            completed = sum(
+                1 for f in finals
+                if not isinstance(f, BaseException) and f is not None
+                and len(f.outputs[0].token_ids) == args.output_len)
+            return {
+                "inflight_offered": n_inflight,
+                "inflight_completed": completed,
+                "late_offered": n_late,
+                "late_rejected_draining": rejected,
+                "clean_exit": bool(clean),
+                "drain_s": round(time.perf_counter() - t0, 3),
+            }
+
+        detail["chaos_kill"]["drain"] = await drain_storm()
     return {
         "metric": "serving_p50_ttft_s",
         "value": round(pct(ttfts, 50), 4),
@@ -452,6 +560,18 @@ def main() -> None:
                              "point of their lifetime")
     parser.add_argument("--chaos-seed", type=int, default=0,
                         help="seed for the fault RNG and abort plan")
+    parser.add_argument("--chaos-kill", action="store_true",
+                        help="kill-chaos lifecycle proof: arm a FATAL "
+                             "fault mid-run (engine must reincarnate; "
+                             "zero-lost-requests + KV-leak invariants "
+                             "in a `chaos_kill` JSON section), then a "
+                             "drain storm (in-flight completes, late "
+                             "arrivals 503, clean drain)")
+    parser.add_argument("--kill-fault",
+                        default="executor.execute_model:fatal:0.05:1",
+                        help="APHRODITE_FAULT spec armed after warmup "
+                             "in --chaos-kill mode ('none' = drain "
+                             "storm only)")
     args = parser.parse_args()
     if args.model == "synthetic-7b":
         args.model = synthetic_7b_dir()

@@ -1,0 +1,18 @@
+"""Seeded CLOCK001 violation: wall-clock deadline arithmetic in
+engine-scope code (fires exactly once); the monotonic reads are
+clean."""
+import time
+
+
+def deadline_at(slo_s: float) -> float:
+    # time.time() jumps under NTP steps: a stepped clock expires (or
+    # un-expires) every queued deadline at once.
+    return time.time() + slo_s
+
+
+def heartbeat() -> float:
+    return time.monotonic()         # clean: jump-proof clock
+
+
+def elapsed_since(t0: float) -> float:
+    return time.monotonic() - t0    # clean

@@ -65,6 +65,7 @@ def test_health_reports_dead_after_fatal_fault(tiny_model_dir,
     """An unrecoverable injected fault must flip /health to 503/DEAD
     (load balancers eject the replica) while requests fail fast."""
     from aphrodite_tpu.common import faultinject
+    monkeypatch.setenv("APHRODITE_REINCARNATIONS", "0")
     monkeypatch.setenv("APHRODITE_FAULT",
                        "executor.execute_model:fatal:1:1")
     faultinject.reset()

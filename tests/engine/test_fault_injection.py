@@ -132,12 +132,15 @@ def test_request_scoped_fault_isolates_culprit(tiny_model_dir,
 
 def test_fatal_fault_fails_fast_and_reports_dead(tiny_model_dir,
                                                  monkeypatch):
-    """An unrecoverable fault moves the engine to DEAD: every in-flight
-    stream gets AsyncEngineDeadError, new requests fail fast (bounded
-    by a watchdog-scale timeout, i.e. no hang), and /health-level
-    reporting says DEAD."""
+    """With reincarnation disabled (APHRODITE_REINCARNATIONS=0), an
+    unrecoverable fault moves the engine straight to DEAD: every
+    in-flight stream gets AsyncEngineDeadError, new requests fail fast
+    (bounded by a watchdog-scale timeout, i.e. no hang), and
+    /health-level reporting says DEAD. (The recovery path is covered
+    by tests/engine/test_lifecycle.py.)"""
     from aphrodite_tpu.engine.async_aphrodite import (AsyncAphrodite,
                                                       AsyncEngineDeadError)
+    monkeypatch.setenv("APHRODITE_REINCARNATIONS", "0")
     monkeypatch.setenv("APHRODITE_FAULT",
                        "executor.execute_model:fatal:1:1")
     faultinject.reset()
@@ -172,8 +175,10 @@ def test_fatal_fault_fails_fast_and_reports_dead(tiny_model_dir,
 
 def test_retry_exhaustion_goes_dead(tiny_model_dir, monkeypatch):
     """More consecutive transient failures than APHRODITE_STEP_RETRIES
-    is terminal, not an infinite retry loop."""
+    is terminal (with reincarnation disabled), not an infinite retry
+    loop."""
     from aphrodite_tpu.engine.async_aphrodite import AsyncEngineDeadError
+    monkeypatch.setenv("APHRODITE_REINCARNATIONS", "0")
     monkeypatch.setenv("APHRODITE_STEP_RETRIES", "1")
     monkeypatch.setenv("APHRODITE_STEP_BACKOFF_S", "0.01")
     faulty, state = _run_async(

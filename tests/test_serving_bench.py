@@ -101,6 +101,42 @@ def test_serving_harness_overload_mode(tiny_model_dir, monkeypatch):
     assert o["sheds_total"] >= o["requests_shed"]
 
 
+def test_serving_harness_chaos_kill_mode(tiny_model_dir, monkeypatch):
+    """--chaos-kill JSON artifact: a FATAL fault armed at measurement
+    start forces one reincarnation (every request still completes —
+    zero unaccounted, zero KV leak on the REBUILT pool), then the
+    drain storm proves in-flight work completes while late arrivals
+    get the typed draining rejection and the replica drains clean."""
+    sys.path.insert(0, "benchmarks")
+    from serving import run
+    from aphrodite_tpu.common import faultinject
+
+    monkeypatch.delenv("APHRODITE_FAULT", raising=False)
+    monkeypatch.setenv("APHRODITE_REINCARNATIONS", "2")
+    monkeypatch.setenv("APHRODITE_REINCARNATION_BACKOFF_S", "0.01")
+    faultinject.reset()
+    try:
+        result = asyncio.run(run(_args(
+            tiny_model_dir, num_requests=8, chaos_kill=True,
+            kill_fault="executor.execute_model:fatal:1:1",
+            chaos_seed=0)))
+    finally:
+        monkeypatch.delenv("APHRODITE_FAULT", raising=False)
+        faultinject.reset()
+    ck = result["detail"]["chaos_kill"]
+    assert ck["reincarnations"] == 1
+    assert ck["requests_restored"] >= 1
+    assert ck["requests_lost_typed"] == 0
+    assert ck["recovery_s"] > 0
+    assert ck["requests_unaccounted"] == 0
+    assert ck["kv_leak_pages"] == 0, ck
+    assert ck["faults_fired"] == {"executor.execute_model:fatal": 1}
+    d = ck["drain"]
+    assert d["inflight_completed"] == d["inflight_offered"] == 4
+    assert d["late_rejected_draining"] == d["late_offered"] == 4
+    assert d["clean_exit"] is True
+
+
 def test_serving_harness_chaos_fault_free_matches_baseline(
         tiny_model_dir, monkeypatch):
     """A fault-free --chaos run (no spec, no aborts) must report every

@@ -18,6 +18,9 @@ Rule ID families:
 - EXC001..EXC002       — exception-handling hygiene on the supervised
                          step path (silent swallows, discarded
                          CancelledError)
+- CLOCK001             — wall-clock (`time.time()`) used for
+                         deadlines/durations/heartbeats in engine
+                         scope; `time.monotonic()` required
 - BP001                — bounded-queue hygiene: unbounded
                          asyncio.Queue/deque construction on the
                          serving path without a registered bound
@@ -29,9 +32,9 @@ Rule ID families:
                          HBM round trip (Zen-Attention) and online-
                          softmax rescale multiplies (AMLA mul-by-add)
 """
-from tools.aphrocheck.passes import (bound_pass, dma_pass, exc_pass,
-                                     flag_pass, fold_pass, grid_pass,
-                                     recomp_pass, ref_pass,
+from tools.aphrocheck.passes import (bound_pass, clock_pass, dma_pass,
+                                     exc_pass, flag_pass, fold_pass,
+                                     grid_pass, recomp_pass, ref_pass,
                                      roofline_pass, shard_pass,
                                      sync_pass, vmem_pass)
 
@@ -45,6 +48,7 @@ ALL_PASSES = (
     ("SHARD", shard_pass.run),
     ("RECOMP", recomp_pass.run),
     ("EXC", exc_pass.run),
+    ("CLOCK", clock_pass.run),
     ("BP", bound_pass.run),
     ("ROOF", roofline_pass.run),
     ("FOLD", fold_pass.run),

@@ -1,0 +1,82 @@
+"""CLOCK pass: wall-clock misuse on the engine's timing-sensitive
+paths.
+
+Deadlines, durations, heartbeats, and SLO math in the engine MUST use
+``time.monotonic()``: ``time.time()`` jumps backwards and forwards
+under NTP steps and suspend/resume, which silently breaks watchdog
+staleness checks, drain deadlines, TTFT-SLO admission, and retry
+backoff accounting (a 30 s NTP step once expired every queued request
+at once). The supervision/lifecycle layer is built entirely on the
+monotonic clock; this rule keeps new code on it.
+
+- CLOCK001: a ``time.time()`` call (attribute form, or a bare
+  ``time()`` under ``from time import time``) anywhere in the
+  ``aphrodite_tpu/engine/``, ``aphrodite_tpu/executor/``, or
+  ``aphrodite_tpu/processing/`` scope. Endpoints are exempt by scope
+  on purpose: the OpenAI wire format's ``created`` fields are epoch
+  timestamps and legitimately wall-clock. Explicitly-passed modules
+  outside the scanned roots (the seeded fixtures) are treated as
+  in-scope, matching the EXC pass convention.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.aphrocheck.core import Finding, dotted_name
+
+#: CLOCK001 scope: the deadline/heartbeat-bearing engine surface.
+_HOT_PREFIXES = ("aphrodite_tpu/engine/", "aphrodite_tpu/executor/",
+                 "aphrodite_tpu/processing/")
+
+#: Everything the CLI normally scans; explicitly-passed files outside
+#: these roots (the seeded fixtures) are treated as in-scope.
+_SCAN_PREFIXES = ("aphrodite_tpu/", "benchmarks/", "bench.py")
+
+
+def _in_scope(rel: str) -> bool:
+    rel = rel.replace("\\", "/")
+    if any(rel.startswith(p) for p in _HOT_PREFIXES):
+        return True
+    return not any(rel == p.rstrip("/") or rel.startswith(p)
+                   for p in _SCAN_PREFIXES)
+
+
+def _imports_bare_time(module) -> bool:
+    """True when `from time import time` makes a bare time() call a
+    wall-clock read in this module."""
+    for node in module.nodes:
+        if isinstance(node, ast.ImportFrom) and node.module == "time" \
+                and any(alias.name == "time" and alias.asname is None
+                        for alias in node.names):
+            return True
+    return False
+
+
+def run(ctx) -> List[Finding]:
+    findings: List[Finding] = []
+    for module in ctx.modules:
+        if not _in_scope(module.rel):
+            continue
+        bare_time = _imports_bare_time(module)
+        for call in module.calls:
+            name = dotted_name(call.func) or ""
+            if name == "time.time" or (bare_time and name == "time"):
+                findings.append(module.finding(
+                    "CLOCK001", call,
+                    "time.time() in engine scope: wall-clock jumps "
+                    "(NTP steps, suspend/resume) break deadlines, "
+                    "heartbeats and SLO math — use time.monotonic() "
+                    "(epoch stamps for wire formats belong in "
+                    "endpoints/, which is exempt by scope)"))
+    return findings
+
+
+#: (rule, one-line contract, example) — rendered by `--rules-md`.
+RULES = (
+    ("CLOCK001", "`time.time()` for deadlines/durations/heartbeats in "
+     "the `engine/`/`executor/`/`processing/` scope — wall-clock "
+     "jumps break watchdogs and SLOs; use `time.monotonic()` "
+     "(endpoints' epoch `created` stamps are exempt by scope)",
+     "`deadline = time.time() + slo_s` in the scheduler"),
+)
