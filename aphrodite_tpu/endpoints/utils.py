@@ -33,6 +33,10 @@ from aphrodite_tpu.common.logger import init_logger
 logger = init_logger(__name__)
 
 _SIGTERM_INSTALLED = web.AppKey("aphrodite_sigterm_installed", bool)
+#: The in-flight SIGTERM drain task, retained on the app so it cannot
+#: be garbage-collected mid-drain (a collected task silently stops
+#: draining AND swallows its exception).
+_DRAIN_TASK = web.AppKey("aphrodite_drain_task", object)
 
 
 async def request_disconnected(request: web.Request) -> bool:
@@ -106,6 +110,19 @@ async def _drain_then_exit(engine) -> None:
     asyncio.get_running_loop().call_soon(_raise_graceful_exit)
 
 
+def _log_drain_outcome(task: "asyncio.Task") -> None:
+    """Done-callback for the SIGTERM drain task: a drain that dies
+    mid-shutdown must be LOUD — the process is about to exit on the
+    assumption that in-flight work was handled."""
+    if task.cancelled():
+        return
+    exc = task.exception()
+    if exc is not None:
+        logger.error("SIGTERM drain task failed; in-flight requests "
+                     "may not have drained cleanly: %s: %s",
+                     type(exc).__name__, exc)
+
+
 def install_lifecycle(app: web.Application, engine,
                       admin_keys: Optional[List[str]] = None) -> None:
     """Wire the shared lifecycle surface onto one frontend app:
@@ -127,7 +144,11 @@ def install_lifecycle(app: web.Application, engine,
                 logger.warning("Second SIGTERM: exiting immediately.")
                 _raise_graceful_exit()
             logger.info("SIGTERM: draining before exit.")
-            loop.create_task(_drain_then_exit(engine))
+            # Retain the task on the app (a bare create_task can be
+            # GC'd mid-drain) and log — never swallow — its failure.
+            task = loop.create_task(_drain_then_exit(engine))
+            task.add_done_callback(_log_drain_outcome)
+            started_app[_DRAIN_TASK] = task
 
         try:
             # Replaces aiohttp's default immediate-exit SIGTERM

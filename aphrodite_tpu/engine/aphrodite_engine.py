@@ -157,6 +157,11 @@ class AphroditeEngine:
         # for requests aborted by request-scoped failures or crash-
         # barrier casualties this step; the async layer drains them and
         # propagates each exception to exactly that stream.
+        # thread-safe: two-world by design — the step thread appends
+        # (inside step()/reincarnate(), which the loop awaits) and the
+        # loop drains strictly BETWEEN those awaits via a list swap
+        # that is atomic under the GIL; the two writers never run
+        # concurrently.
         self._step_faults: List[Tuple[str, Exception]] = []
         # SchedulerOutputs committed by the current step (several when
         # the step pipelines builder rounds) — the crash barrier's
@@ -316,10 +321,21 @@ class AphroditeEngine:
             queue_depth=len(self.scheduler.waiting),
             waiting_tokens=self.scheduler.waiting_prefill_tokens())
 
+    def _check_epoch(self) -> None:
+        """Epoch guard for off-loop scheduler commits: a step thread
+        that outlived a reincarnation (watchdog-abandoned, woke up
+        later) must raise instead of touching the rebuilt scheduler —
+        its groups were already restored or errored by the rebuild."""
+        if getattr(self._step_tls, "epoch", self._epoch) != self._epoch:
+            raise StaleEngineStepError(
+                "engine step outlived a reincarnation; refusing to "
+                "touch the rebuilt scheduler")
+
     def _expire_deadlines(self) -> None:
         """Expire deadline-missed groups still in `waiting` (never
         computed — no pages, no schedule round) and record a typed
         RequestTimeoutError for each stream via the step-fault seam."""
+        self._check_epoch()
         expired = self.scheduler.expire_waiting(time.monotonic())
         if not expired:
             return
@@ -540,6 +556,10 @@ class AphroditeEngine:
             prompt_mds, scheduler_outputs.blocks_to_copy)
         if handle is None:
             return None
+        # Off-loop admission commits follow (schedule_prompt_only
+        # allocates pages and advances chunk progress): never against
+        # a scheduler this step does not own.
+        self._check_epoch()
         rounds = [scheduler_outputs]
         handles = [handle]
         while len(handles) < 4:
@@ -647,6 +667,7 @@ class AphroditeEngine:
         # Blocks reserved beyond the bucketed length stay on the
         # sequences' block tables and satisfy the next round's
         # reservation.
+        self._check_epoch()
         granted = self.scheduler.reserve_decode_burst(
             seq_group_metadata_list, want - 1, extra_cap,
             groups=scheduler_outputs.decode_groups)
@@ -777,6 +798,9 @@ class AphroditeEngine:
     def _process_sequence_group_outputs(
             self, seq_group: SequenceGroup,
             outputs: SequenceGroupOutput) -> None:
+        # Forks/frees below commit against the scheduler; a stale
+        # (reincarnation-outlived) step must not touch the rebuilt one.
+        self._check_epoch()
         # Prompt logprobs.
         if outputs.prompt_logprobs is not None:
             seq_group.prompt_logprobs = outputs.prompt_logprobs

@@ -92,16 +92,19 @@ def _consume_abandoned_step(fut) -> None:
 
 def _finalize_engine_loop(task: asyncio.Task,
                           request_tracker: "RequestTracker",
-                          health: HealthMonitor) -> None:
+                          health: HealthMonitor,
+                          idle_event: asyncio.Event) -> None:
     """Done-callback of the background loop. The loop exits cleanly
     after recording DEAD (engine_step handles its own failures), so an
     exception here means a bug in the loop itself — record it in the
     health state machine and fail the streams instead of re-raising
     into the event loop's unhandled-exception logger (noise nothing
-    catches)."""
+    catches). Either way the idle event fires so a `drained()` waiter
+    wakes and observes the death instead of waiting forever."""
     if task.cancelled():
         return
     exc = task.exception()
+    idle_event.set()
     if exc is None:
         return                  # clean exit: DEAD already recorded
     logger.error("engine loop terminated unexpectedly: %s: %s",
@@ -314,6 +317,12 @@ class AsyncAphrodite:
         self.health = HealthMonitor()
         self.background_loop: Optional[asyncio.Future] = None
         self._background_loop_unshielded = None
+        # Set while the replica is idle (no in-flight, no pending),
+        # cleared on every arrival; `drained()` waits on it instead of
+        # polling. Recreated per loop start so it binds to the live
+        # loop; set on death so drain waiters wake.
+        self._idle_event: asyncio.Event = asyncio.Event()
+        self._idle_event.set()
         # Lifecycle gauges (state code, reincarnation counters, drain
         # remaining) ride the engine's per-round Stats into Prometheus.
         self.engine.lifecycle_source = self._lifecycle_stats
@@ -343,13 +352,22 @@ class AsyncAphrodite:
                 "Engine is DEAD and cannot be restarted in-process: "
                 + (self.health.dead_reason or "unknown failure"))
         self._request_tracker.init_event()
-        loop = asyncio.get_event_loop()
+        # get_running_loop, not get_event_loop: the engine may be
+        # driven from a worker thread's loop (fleet mode), where the
+        # deprecated API grabs — or creates — the wrong loop.
+        loop = asyncio.get_running_loop()
+        # Fresh per loop start: asyncio primitives bind lazily to the
+        # loop that first waits on them, and a restarted engine must
+        # not wait on an event bound to a dead loop.
+        self._idle_event = asyncio.Event()
+        self._idle_event.set()
         self._background_loop_unshielded = loop.create_task(
             self.run_engine_loop())
         self._background_loop_unshielded.add_done_callback(
             functools.partial(_finalize_engine_loop,
                               request_tracker=self._request_tracker,
-                              health=self.health))
+                              health=self.health,
+                              idle_event=self._idle_event))
         self.background_loop = asyncio.shield(
             self._background_loop_unshielded)
 
@@ -359,7 +377,7 @@ class AsyncAphrodite:
         its executor thread wedged (a hung XLA compile/device call is
         uninterruptible from Python), so timeout is terminal — the
         point is detection instead of a forever-'healthy' hang."""
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         fut = loop.run_in_executor(None, self.engine.step)
         timeout = flags.get_float("APHRODITE_STEP_TIMEOUT_S")
         if not timeout or timeout <= 0:
@@ -412,7 +430,7 @@ class AsyncAphrodite:
             # Blocking (model load + cache init): off-loop, so the
             # event loop keeps answering /health with REBUILDING and
             # keeps queueing new arrivals for the rebuilt engine.
-            outcome = await asyncio.get_event_loop().run_in_executor(
+            outcome = await asyncio.get_running_loop().run_in_executor(
                 None, self.engine.reincarnate)
         except Exception as rebuild_exc:
             logger.error("engine rebuild failed: %s: %s",
@@ -436,6 +454,8 @@ class AsyncAphrodite:
         """Terminal transition: record DEAD, fail every in-flight and
         queued stream fast, and stop the loop."""
         self.health.mark_dead(exc)
+        # Wake drained() waiters: they re-check and observe DEAD.
+        self._idle_event.set()
         logger.error(
             "Engine is DEAD: %s: %s — in-flight and future requests "
             "will fail fast with AsyncEngineDeadError.",
@@ -509,6 +529,15 @@ class AsyncAphrodite:
         for request_output in request_outputs:
             self._request_tracker.process_request_output(
                 request_output, verbose=self.log_requests)
+        # Idle accounting for drained(): the replica is idle when the
+        # scheduler holds nothing and the tracker has no untransferred
+        # arrivals. The event stays set while idle (no lost wakeups),
+        # and add_request clears it on every arrival.
+        if not self.engine.has_unfinished_requests() and \
+                self._request_tracker.pending_load()[0] == 0:
+            self._idle_event.set()
+        else:
+            self._idle_event.clear()
         # A chunked-prefill round can legitimately emit no outputs (it
         # only wrote prompt KV); the loop must keep stepping while any
         # request is mid-flight, not just while outputs flow.
@@ -593,13 +622,15 @@ class AsyncAphrodite:
                     "inspect the output to find the stacktrace of the "
                     "error that caused the background loop to stop "
                     "(AsyncEngineDeadError).")
-        return self._request_tracker.add_request(
+        stream = self._request_tracker.add_request(
             request_id,
             prompt=prompt,
             sampling_params=sampling_params,
             prompt_token_ids=prompt_token_ids,
             arrival_time=arrival_time or time.monotonic(),
             prefix_pos=prefix_pos)
+        self._idle_event.clear()     # no longer idle: work arrived
+        return stream
 
     async def generate(
         self,
@@ -670,18 +701,26 @@ class AsyncAphrodite:
             else "waiting for in-flight work without a deadline")
         return deadline_s if deadline is not None else 0.0
 
-    async def drained(self, poll_s: float = 0.05) -> bool:
+    async def drained(self) -> bool:
         """Resolve once the draining replica is idle. True = every
         in-flight request ran to completion; False = the drain
         deadline expired and the stragglers were aborted with a typed
         `EngineDrainingError` (or the engine died mid-drain). Safe to
         call from a SIGTERM handler task — the serving loop keeps
-        running underneath."""
+        running underneath.
+
+        Event-driven, not polled: the engine loop keeps `_idle_event`
+        set exactly while the replica is idle (and sets it on death),
+        so this wakes the moment in-flight work hits zero; the only
+        timer is the drain deadline itself. The event stays SET while
+        idle, so there is no lost-wakeup window between the check and
+        the wait."""
         while True:
             if self.health.is_dead:
                 return False        # fail_all already errored streams
-            if not self.engine.has_unfinished_requests() and \
-                    self._request_tracker.pending_load()[0] == 0:
+            if self._idle_event.is_set() or (
+                    not self.engine.has_unfinished_requests() and
+                    self._request_tracker.pending_load()[0] == 0):
                 return True
             rem = self.health.drain_remaining_s
             if rem is not None and rem <= 0:
@@ -697,7 +736,11 @@ class AsyncAphrodite:
                     "Drain deadline exceeded: aborted %d in-flight "
                     "request(s) with typed errors.", aborted)
                 return False
-            await asyncio.sleep(poll_s)
+            try:
+                await asyncio.wait_for(self._idle_event.wait(),
+                                       timeout=rem)
+            except asyncio.TimeoutError:
+                continue    # deadline hit: loop re-checks and aborts
 
     def _lifecycle_stats(self) -> dict:
         """Per-round lifecycle gauge values (merged into Stats by the

@@ -27,6 +27,10 @@ class BlockPool:
         self.device = device
         self.block_size = block_size
         self.num_blocks = num_blocks
+        # thread-safe: allocate/free run on the step thread inside
+        # step(), or on the event loop (abort paths) strictly BETWEEN
+        # steps — engine_step awaits the step future before freeing,
+        # so the free list never sees concurrent mutation.
         self._free: List[PhysicalTokenBlock] = [
             PhysicalTokenBlock(device, idx, block_size)
             for idx in range(num_blocks)
@@ -90,6 +94,10 @@ class BlockSpaceManager:
 
         self.gpu_allocator = BlockPool(Device.TPU, block_size, num_gpu_blocks)
         self.cpu_allocator = BlockPool(Device.CPU, block_size, num_cpu_blocks)
+        # thread-safe: mutated on the step thread inside step() and on
+        # the event loop only via abort/free paths that run BETWEEN
+        # steps (engine_step awaits the step future first); the two
+        # writers are sequenced by the engine loop, never concurrent.
         self.block_tables: Dict[int, BlockTable] = {}
 
     # ------------------------------------------------------------------

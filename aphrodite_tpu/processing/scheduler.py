@@ -135,6 +135,13 @@ class Scheduler:
             sliding_window=cache_config.sliding_window)
         self.prefix_pool = PrefixPool(cache_config.block_size)
 
+        # thread-safe: two-world by sequencing, not locking — the
+        # event loop only appends (engine.add_request) BETWEEN steps
+        # (engine_step awaits the step future before touching the
+        # scheduler), the step thread mutates only inside step()/
+        # reincarnate() behind the epoch guard, and loop-side
+        # monitoring reads (queue depth, queued tokens) tolerate
+        # one-round staleness by design.
         self.waiting: Deque[SequenceGroup] = deque()
         # Admitted prompts whose KV is only partially written (chunked
         # prefill in flight); they hold their full page allocation and

@@ -1,0 +1,13 @@
+"""Seeded ASYNC001 violation: a blocking sleep reached from the event
+loop THROUGH a sync helper — the domain classifier must propagate
+EVENT_LOOP across the call edge, not stop at the async def boundary."""
+import time
+
+
+def _warm_cache():
+    # runs on the event loop via serve() below
+    time.sleep(0.5)          # ASYNC001: blocks the loop
+
+
+async def serve():
+    _warm_cache()

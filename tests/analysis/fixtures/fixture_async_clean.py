@@ -1,0 +1,25 @@
+"""Clean ASYNC constructs: the engine's watchdog idiom — a future's
+`.result()` read AFTER an awaited `asyncio.wait` over it, the correct
+`get_running_loop()` API, and a create_task whose task is stored and
+given a done-callback — must produce ZERO findings."""
+import asyncio
+
+
+def _log_result(task):
+    if not task.cancelled() and task.exception() is not None:
+        pass
+
+
+async def _reap(fut):
+    await asyncio.wait({fut})
+
+
+async def watchdog(engine):
+    loop = asyncio.get_running_loop()            # correct API: clean
+    fut = loop.run_in_executor(None, engine.step)
+    done, _ = await asyncio.wait({fut}, timeout=1.0)
+    if done:
+        return fut.result()                      # resolved: clean
+    task = loop.create_task(_reap(fut))          # stored: clean
+    task.add_done_callback(_log_result)
+    return None

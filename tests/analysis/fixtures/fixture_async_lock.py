@@ -1,0 +1,14 @@
+"""Seeded ASYNC004 violation (lock shape): an await inside a held
+SYNC lock — the coroutine parks holding the lock and every other task
+that wants it deadlocks behind the event loop."""
+import asyncio
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    async def refresh(self):
+        with self._lock:                         # ASYNC004
+            await asyncio.sleep(0)

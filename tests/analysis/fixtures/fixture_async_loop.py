@@ -1,0 +1,9 @@
+"""Seeded ASYNC003 violation: deprecated asyncio.get_event_loop() —
+grabs (or historically creates) the wrong loop when called off the
+main thread; get_running_loop() is required."""
+import asyncio
+
+
+def attach_watchdog(engine):
+    loop = asyncio.get_event_loop()              # ASYNC003
+    return loop.run_in_executor(None, engine.step)

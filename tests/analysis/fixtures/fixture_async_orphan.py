@@ -1,0 +1,12 @@
+"""Seeded ASYNC002 violation: a fire-and-forget create_task whose
+result is neither stored nor given a done-callback — the task can be
+garbage-collected mid-flight and its exception is swallowed."""
+import asyncio
+
+
+async def _background_sync():
+    await asyncio.sleep(1.0)
+
+
+async def kickoff():
+    asyncio.create_task(_background_sync())      # ASYNC002

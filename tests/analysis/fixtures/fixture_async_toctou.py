@@ -1,0 +1,16 @@
+"""Seeded ASYNC004 violation (TOCTOU shape): read of self.X, an await
+point, then a write of self.X — the loop runs other tasks during the
+await, so the write commits a stale read."""
+
+
+class AdmitCounter:
+    def __init__(self):
+        self.inflight = 0
+
+    async def _notify(self):
+        pass
+
+    async def admit(self):
+        seen = self.inflight
+        await self._notify()
+        self.inflight = seen + 1                 # ASYNC004

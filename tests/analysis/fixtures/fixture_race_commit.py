@@ -1,0 +1,18 @@
+"""Seeded RACE002 violation: a step-thread function committing
+scheduling state with no epoch guard on the path — a
+watchdog-abandoned step waking after a reincarnation would corrupt
+the rebuilt scheduler."""
+import asyncio
+
+
+class MiniEngine:
+    def __init__(self, scheduler):
+        self.scheduler = scheduler
+        self._epoch = 0
+
+    def step(self):
+        self.scheduler.schedule()                # RACE002
+
+
+async def drive(engine):
+    await asyncio.get_running_loop().run_in_executor(None, engine.step)

@@ -1,0 +1,39 @@
+"""Clean RACE002 construct: the engine's epoch-guard idiom — off-loop
+scheduler commits behind an epoch compare, either inline or through a
+`_check_epoch` helper — must produce ZERO findings (precision for the
+exact shape aphrodite_engine.py relies on)."""
+import asyncio
+
+
+class GuardedEngine:
+    def __init__(self, scheduler):
+        self.scheduler = scheduler
+        self._epoch = 0
+        self._step_epoch = 0
+
+    def _check_epoch(self):
+        if self._step_epoch != self._epoch:
+            raise RuntimeError("stale step")
+
+    def step(self):
+        # guarded through the helper: clean
+        self._check_epoch()
+        self.scheduler.schedule()
+
+    def commit(self):
+        # guarded inline: clean
+        if self._step_epoch != self._epoch:
+            raise RuntimeError("stale step")
+        self.scheduler.free_finished_seq_groups()
+
+    def rotate(self):
+        # the rotation point itself (writes the epoch): exempt
+        self._epoch += 1
+        self.scheduler.crash_rollback(None)
+
+
+async def drive(engine):
+    loop = asyncio.get_running_loop()
+    await loop.run_in_executor(None, engine.step)
+    await loop.run_in_executor(None, engine.commit)
+    await loop.run_in_executor(None, engine.rotate)

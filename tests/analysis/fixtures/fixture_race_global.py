@@ -1,0 +1,16 @@
+"""Seeded RACE003 violation: mutable module-level state mutated on
+the event loop and drained from the step thread — module globals have
+no owning instance to sequence access through."""
+import asyncio
+
+PENDING = {}                                     # RACE003
+
+
+def flush():
+    for key in list(PENDING):
+        PENDING.pop(key)
+
+
+async def admit(request_id):
+    PENDING[request_id] = 1
+    await asyncio.get_running_loop().run_in_executor(None, flush)

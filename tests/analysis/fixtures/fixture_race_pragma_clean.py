@@ -1,0 +1,25 @@
+"""Clean RACE001 construct: a genuinely two-world queue whose safety
+argument is registered with a `# thread-safe: <reason>` pragma (the
+engine's `_step_faults` idiom) — must produce ZERO findings."""
+import asyncio
+
+
+class FaultTracker:
+    def __init__(self):
+        # thread-safe: the step thread only appends inside the step
+        # the loop is awaiting, and the loop drains strictly between
+        # steps via a GIL-atomic list swap — never concurrent
+        self.faults = []
+
+    def record(self, item):
+        self.faults.append(item)         # STEP_THREAD writer
+
+    def drain(self):
+        out, self.faults = self.faults, []    # EVENT_LOOP writer
+        return out
+
+
+async def pump(tracker):
+    await asyncio.get_running_loop().run_in_executor(
+        None, tracker.record, 1)
+    return tracker.drain()

@@ -1,0 +1,20 @@
+"""Seeded RACE001 violation: one attribute written from BOTH worlds —
+an async handler on the event loop and a callable handed to
+run_in_executor — with nothing documenting why that is safe."""
+import asyncio
+
+
+class Gauge:
+    def __init__(self):
+        self.total = 0
+
+    def on_loop(self):
+        self.total += 1          # EVENT_LOOP writer (via serve)
+
+    def off_loop(self):
+        self.total += 1          # STEP_THREAD writer -> RACE001
+
+
+async def serve(g):
+    g.on_loop()
+    await asyncio.get_running_loop().run_in_executor(None, g.off_loop)

@@ -2,9 +2,10 @@
 
 Three layers:
 
-1. THE GATE: every pass (all 13 families, the ROOF/FOLD perf rules
-   included) over the real tree (`aphrodite_tpu/`, `bench.py`,
-   `benchmarks/`) must produce zero findings even with NO allowlist,
+1. THE GATE: every pass (all 15 families, the ROOF/FOLD perf rules
+   and the ASYNC/RACE concurrency rules included) over the real tree
+   (`aphrodite_tpu/`, `bench.py`, `benchmarks/`) must produce zero
+   findings even with NO allowlist,
    the checked-in allowlist must hold at most 5 entries (currently
    zero), none may be stale, the checker itself must never import
    jax, and the full sweep must finish under 2 s.
@@ -29,11 +30,13 @@ import time
 import pytest
 
 from tools.aphrocheck import DEFAULT_ALLOWLIST, build_context, run
-from tools.aphrocheck.core import (FLAGS_MODULE, REPO_ROOT, Allowlist,
+from tools.aphrocheck.core import (EVENT_LOOP, FLAGS_MODULE, REPO_ROOT,
+                                   STEP_THREAD, Allowlist,
                                    collect_files)
-from tools.aphrocheck.passes import (bound_pass, clock_pass, dma_pass,
-                                     exc_pass, flag_pass, fold_pass,
-                                     grid_pass, recomp_pass, ref_pass,
+from tools.aphrocheck.passes import (async_pass, bound_pass,
+                                     clock_pass, dma_pass, exc_pass,
+                                     flag_pass, fold_pass, grid_pass,
+                                     race_pass, recomp_pass, ref_pass,
                                      roofline_pass, shard_pass,
                                      sync_pass, vmem_pass)
 from tools.aphrocheck.registry import parse_registry
@@ -74,7 +77,7 @@ def test_repo_is_clean():
 
 
 def test_repo_clean_without_allowlist():
-    """The stronger form of the gate: all 13 pass families produce
+    """The stronger form of the gate: all 15 pass families produce
     ZERO findings with no allowlist at all — every real finding the
     new passes surfaced was fixed in-tree or registered in source
     (perf-known pragmas for the ROOF/FOLD motivating findings), so
@@ -180,6 +183,14 @@ def test_scan_covers_benches():
     (roofline_pass.run, "fixture_roof_flush.py", "ROOF003"),
     (fold_pass.run, "fixture_fold_chain.py", "FOLD001"),
     (fold_pass.run, "fixture_fold_rescale.py", "FOLD002"),
+    (async_pass.run, "fixture_async_block.py", "ASYNC001"),
+    (async_pass.run, "fixture_async_orphan.py", "ASYNC002"),
+    (async_pass.run, "fixture_async_loop.py", "ASYNC003"),
+    (async_pass.run, "fixture_async_lock.py", "ASYNC004"),
+    (async_pass.run, "fixture_async_toctou.py", "ASYNC004"),
+    (race_pass.run, "fixture_race_twoworld.py", "RACE001"),
+    (race_pass.run, "fixture_race_commit.py", "RACE002"),
+    (race_pass.run, "fixture_race_global.py", "RACE003"),
 ])
 def test_rule_fires_exactly_once(pass_fn, fixture, rule):
     findings = _pass_findings(pass_fn, [_fixture(fixture)])
@@ -320,6 +331,132 @@ def test_shard_fixtures_stay_precise():
     s = _pass_findings(sync_pass.run,
                        [_fixture("fixture_shard_transfer.py")])
     assert not s, [f.render() for f in s]
+
+
+def test_domain_classifier_two_worlds():
+    """The core upgrade behind the ASYNC/RACE families: the call
+    graph tags functions with the world that executes them — async
+    defs and their sync callees EVENT_LOOP, run_in_executor targets
+    and their callees STEP_THREAD — and the two never blur through
+    an async def (sync code calling a coroutine function only
+    creates the coroutine)."""
+    ctx, _ = build_context(
+        REPO_ROOT, [_fixture("fixture_race_commit.py"),
+                    _fixture("fixture_async_block.py")])
+    cg = ctx.call_graph
+    domains = {}
+    for module in ctx.modules:
+        import ast
+        for node in module.nodes:
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                domains[node.name] = cg.domains_of(node)
+    assert EVENT_LOOP in domains["drive"]       # async def
+    assert STEP_THREAD in domains["step"]       # run_in_executor arg
+    assert EVENT_LOOP not in domains["step"]
+    assert EVENT_LOOP in domains["_warm_cache"]  # sync loop callee
+    assert STEP_THREAD not in domains["_warm_cache"]
+
+
+def test_domain_classifier_on_real_engine():
+    """Against the real tree: engine.step and everything below it is
+    STEP_THREAD (and ONLY that — the LLM.generate/AsyncAphrodite.
+    generate name collision must not leak EVENT_LOOP into the step
+    subtree), while the supervised engine_step coroutine is
+    EVENT_LOOP."""
+    import ast
+    ctx, _ = build_context(REPO_ROOT)
+    cg = ctx.call_graph
+    by_name = {}
+    for module in ctx.modules:
+        if "engine/" not in module.rel.replace("\\", "/") and \
+                "processing/" not in module.rel.replace("\\", "/"):
+            continue
+        for node in module.nodes:
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                by_name.setdefault(node.name, set()).update(
+                    cg.domains_of(node))
+    assert by_name["step"] == {STEP_THREAD}
+    assert by_name["_process_round"] == {STEP_THREAD}
+    assert by_name["observe_round"] == {STEP_THREAD}
+    assert EVENT_LOOP in by_name["engine_step"]
+    assert EVENT_LOOP in by_name["admit_or_raise"]
+    assert STEP_THREAD not in by_name["admit_or_raise"]
+
+
+def test_async_clean_constructs_stay_quiet():
+    """The engine's watchdog idiom — `fut.result()` after an awaited
+    asyncio.wait over it, get_running_loop, a stored create_task with
+    a done-callback — produces ZERO ASYNC findings (precision for the
+    exact shapes async_aphrodite.py relies on)."""
+    findings = _pass_findings(async_pass.run,
+                              [_fixture("fixture_async_clean.py")])
+    assert not findings, [f.render() for f in findings]
+
+
+def test_race_epoch_guard_recognized_clean():
+    """The epoch-guard idiom (inline compare, or through a
+    _check_epoch helper, or the rotation point itself) produces ZERO
+    RACE findings — precision for the exact shape the engine's
+    off-loop commit paths rely on."""
+    findings = _pass_findings(race_pass.run,
+                              [_fixture("fixture_race_epoch_clean.py")])
+    assert not findings, [f.render() for f in findings]
+
+
+def test_race_pragma_recognized_clean():
+    """A genuinely two-world queue whose safety argument is registered
+    with `# thread-safe: <reason>` (the `_step_faults` idiom) produces
+    ZERO RACE findings."""
+    findings = _pass_findings(race_pass.run,
+                              [_fixture("fixture_race_pragma_clean.py")])
+    assert not findings, [f.render() for f in findings]
+
+
+def test_race001_single_writer_counters_clean():
+    """The precision contract behind RACE001: the admission
+    controller's counters/EWMAs and the health monitor's state are
+    single-WRITER-domain with other-world readers — the documented
+    clean pattern — and must produce zero findings WITHOUT any
+    pragma (neither file contains one)."""
+    findings = _pass_findings(
+        race_pass.run,
+        ["aphrodite_tpu/processing/admission.py",
+         "aphrodite_tpu/engine/supervisor.py"])
+    assert not [f for f in findings if f.rule == "RACE001"], \
+        [f.render() for f in findings]
+    for rel in ("aphrodite_tpu/processing/admission.py",
+                "aphrodite_tpu/engine/supervisor.py"):
+        with open(os.path.join(REPO_ROOT, rel), encoding="utf-8") as f:
+            assert "thread-safe:" not in f.read(), \
+                f"{rel} should be clean WITHOUT pragmas"
+
+
+def test_async_scope_exempts_benchmarks():
+    """ASYNC rules are serving-layer scope: the bench harness's
+    create_task fan-outs and blocking waits are driver code, not loop
+    code, and must stay quiet."""
+    findings = _pass_findings(async_pass.run,
+                              ["benchmarks/serving.py", "bench.py"])
+    assert not findings, [f.render() for f in findings]
+
+
+def test_live_async_findings_fixed_in_tree():
+    """Regression for the two live findings this tool surfaced (and
+    the epoch-guard gaps): the async engine and the shared endpoint
+    lifecycle are clean under the ASYNC and RACE passes, and the
+    deprecated get_event_loop() is gone from the engine entirely."""
+    rels = ["aphrodite_tpu/engine/async_aphrodite.py",
+            "aphrodite_tpu/engine/aphrodite_engine.py",
+            "aphrodite_tpu/endpoints/utils.py"]
+    for pass_fn in (async_pass.run, race_pass.run):
+        findings = _pass_findings(pass_fn, rels)
+        assert not findings, [f.render() for f in findings]
+    with open(os.path.join(REPO_ROOT, "aphrodite_tpu", "engine",
+                           "async_aphrodite.py"),
+              encoding="utf-8") as f:
+        assert "get_event_loop()" not in f.read()
 
 
 def test_shard004_scope_exempts_non_executor():
@@ -471,6 +608,8 @@ def test_cli_rules_md_and_readme_drift():
     for rule in ("FLAG001", "FLAG006", "VMEM001", "DMA003", "GRID002",
                  "SYNC003", "REF001", "REF004", "SHARD003", "SHARD004",
                  "RECOMP003", "EXC001", "EXC002", "CLOCK001", "BP001",
+                 "ASYNC001", "ASYNC002", "ASYNC003", "ASYNC004",
+                 "RACE001", "RACE002", "RACE003",
                  "ROOF001", "ROOF002", "ROOF003", "ROOF004", "FOLD001",
                  "FOLD002"):
         assert f"| {rule} |" in table, f"{rule} missing from rules-md"
@@ -480,6 +619,21 @@ def test_cli_rules_md_and_readme_drift():
     assert table in readme, \
         "README Static checks table out of date: regenerate with " \
         "`python -m tools.aphrocheck --rules-md`"
+
+
+def test_ci_workflow_runs_the_gates():
+    """CI runs the same gates tier-1 enforces: the workflow exists
+    and invokes both the full aphrocheck sweep and the ROADMAP tier-1
+    pytest command (the gates existed before, but nothing ran them
+    outside the builder's shell)."""
+    path = os.path.join(REPO_ROOT, ".github", "workflows", "check.yml")
+    assert os.path.exists(path), "CI workflow missing"
+    with open(path, encoding="utf-8") as f:
+        workflow = f.read()
+    assert "python -m tools.aphrocheck" in workflow
+    assert "python -m pytest tests/" in workflow
+    assert "JAX_PLATFORMS=cpu" in workflow
+    assert "-m 'not slow'" in workflow
 
 
 def test_pyproject_registers_lint_entry():

@@ -521,3 +521,83 @@ def test_failed_rebuild_counts_nothing():
     assert h2.is_draining
     assert h2.drain_remaining_s is None
     assert h2.report().drain_deadline_remaining_s is None
+
+
+# ---------------------------------------------------------------------
+# two-world regression tests (PR 11, aphrorace): the engine must be
+# drivable from a worker thread's event loop (get_running_loop, not the
+# deprecated get_event_loop), and drained() must be event-driven — it
+# resolves the moment in-flight hits zero, with no poll timer.
+# ---------------------------------------------------------------------
+
+def test_engine_loop_from_worker_thread(tiny_model_dir):
+    """Fleet mode runs each replica's asyncio loop on a worker thread:
+    generate + drain + drained() must work end-to-end off the main
+    thread (the deprecated get_event_loop() grabbed — or failed to
+    create — the wrong loop there)."""
+    import threading
+
+    engine = _async_engine(tiny_model_dir)
+    result, errors = {}, []
+
+    def worker():
+        async def go():
+            final = None
+            async for out in engine.generate(
+                    None, SamplingParams(**SP), "threaded",
+                    prompt_token_ids=_prompt(0)):
+                final = out
+            result["tokens"] = list(final.outputs[0].token_ids)
+            engine.start_drain(deadline_s=10.0, reason="thread test")
+            result["drained"] = await asyncio.wait_for(
+                engine.drained(), timeout=20)
+
+        try:
+            asyncio.run(go())
+        except BaseException as e:   # surface into the main thread
+            errors.append(e)
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join(timeout=120)
+    assert not t.is_alive(), "worker-thread engine loop hung"
+    assert not errors, errors
+    assert len(result["tokens"]) == SP["max_tokens"]
+    assert result["drained"] is True
+
+
+def test_drained_is_event_driven(tiny_model_dir):
+    """drained() resolves via the tracker-fed idle event, not a poll
+    loop: an idle replica resolves immediately, and after the last
+    in-flight request finishes the waiter wakes without any sleep
+    cadence (asserted by resolving well inside the old 50 ms poll)."""
+    engine = _async_engine(tiny_model_dir)
+
+    async def go():
+        # Idle from the start: resolves without the loop ever running.
+        assert await asyncio.wait_for(engine.drained(), timeout=1) \
+            is True
+
+        final = None
+        async for out in engine.generate(
+                None, SamplingParams(**SP), "one",
+                prompt_token_ids=_prompt(1)):
+            final = out
+        assert final is not None
+        # The event must already be set by the round that finished the
+        # request — drained() resolves with no timer in the path.
+        t0 = time.monotonic()
+        assert await asyncio.wait_for(engine.drained(), timeout=5) \
+            is True
+        assert time.monotonic() - t0 < 0.05
+        assert engine._idle_event.is_set()
+
+        # New arrivals flip the replica busy again.
+        stream = await engine.add_request(
+            "two", None, SamplingParams(**SP),
+            prompt_token_ids=_prompt(2))
+        assert not engine._idle_event.is_set()
+        async for _ in stream:
+            pass
+
+    asyncio.run(go())

@@ -35,6 +35,18 @@ Rule families (see each pass module's docstring for the contract):
                  kernel launches still paying an HBM round trip
                  (Zen-Attention) and online-softmax rescale
                  multiplies AMLA's mul-by-add rewrite eliminates
+  ASYNC001-004   event-loop hygiene over the domain-classified call
+                 graph (aphrorace): blocking calls in the EVENT_LOOP
+                 domain, fire-and-forget create_task swallows,
+                 deprecated asyncio.get_event_loop(), await points
+                 inside critical state (held sync locks,
+                 read-await-write TOCTOU)
+  RACE001-003    two-world shared-state hazards (aphrorace): `self.`
+                 attributes written in BOTH the event-loop and
+                 step-thread domains without a `# thread-safe:`
+                 reason, off-loop scheduler commits bypassing the
+                 reincarnation epoch guard, mutable module-level
+                 state shared across the worlds
 
 Name resolution is interprocedural: a same-package call graph
 (core.CallGraph) lets helper parameters resolve through their call
@@ -60,7 +72,8 @@ DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(
     os.path.abspath(__file__)), "allowlist.json")
 
 _RULE_ORDER = ("PARSE", "FLAG", "VMEM", "DMA", "GRID", "SYNC", "REF",
-               "SHARD", "RECOMP", "EXC", "BP", "ROOF", "FOLD")
+               "SHARD", "RECOMP", "EXC", "BP", "ASYNC", "RACE",
+               "ROOF", "FOLD")
 
 
 @dataclasses.dataclass

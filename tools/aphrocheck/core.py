@@ -30,6 +30,20 @@ Key pieces:
   which is what lets the passes see through the helper-wrapped
   pallas_call idiom (one `_stream_call`-style launcher shared by
   several wrappers) instead of stopping at the function boundary.
+- Execution domains: the call graph also tags every function with the
+  WORLD that executes it — EVENT_LOOP (an `async def` body, or a
+  callback handed to `create_task`/`call_soon`/`add_done_callback`/
+  signal handlers, plus everything those call), STEP_THREAD (callables
+  handed to `run_in_executor`/`Executor.submit`/`Thread(target=)`,
+  plus everything those call), or both. The ASYNC and RACE passes
+  reason about which world executes a statement: a blocking call only
+  matters on the loop, an unguarded scheduler commit only matters off
+  it, and a `self.` attribute written in BOTH worlds is a data race
+  unless something documents why it is not. Resolution is by tail
+  name (over-approximate for same-named methods, like the rest of the
+  graph); indirect dispatch through stored callables is invisible, so
+  domains under-approximate reachability — rules built on them can
+  miss, but what they flag is real.
 """
 from __future__ import annotations
 
@@ -329,6 +343,16 @@ def tail_name(node: ast.AST) -> Optional[str]:
     return name.rsplit(".", 1)[-1] if name else None
 
 
+def call_tail(call: ast.Call) -> Optional[str]:
+    """Tail name of a call's callee, robust to non-Name receivers:
+    `asyncio.get_running_loop().run_in_executor(...)` has a Call at
+    the base of its attribute chain (dotted_name sees nothing), but
+    the method name is still the Attribute's own attr."""
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    return tail_name(call.func)
+
+
 def str_const(node: ast.AST) -> Optional[str]:
     if isinstance(node, ast.Constant) and isinstance(node.value, str):
         return node.value
@@ -374,6 +398,25 @@ def assignments_of(scope: ast.AST, name: str,
 
 # -- same-package call graph ------------------------------------------
 
+#: Execution-domain tags (CallGraph.domains_of).
+EVENT_LOOP = "event_loop"
+STEP_THREAD = "step_thread"
+
+#: Callables that schedule their argument ONTO the asyncio event loop:
+#: tail name -> positional index of the callback/coroutine argument.
+_LOOP_SINKS = {
+    "create_task": 0, "ensure_future": 0, "run_until_complete": 0,
+    "run_coroutine_threadsafe": 0, "call_soon": 0,
+    "call_soon_threadsafe": 0, "add_done_callback": 0,
+    "call_later": 1, "call_at": 1, "add_signal_handler": 1,
+}
+
+#: Callables that move their argument onto a worker thread (the step
+#: thread world): tail name -> positional index of the callable.
+#: Thread(target=...) is handled separately (keyword form).
+_THREAD_SINKS = {"run_in_executor": 1, "submit": 0}
+
+
 @dataclasses.dataclass
 class ParamBinding:
     """One caller-site expression bound to a callee parameter."""
@@ -396,6 +439,8 @@ class CallGraph:
     def __init__(self, modules: Sequence[Module]) -> None:
         self.defs: Dict[str, List[Tuple[Module, ast.AST]]] = {}
         self._bindings: Dict[str, Dict[str, List[ParamBinding]]] = {}
+        self._modules = list(modules)
+        self._domains: Optional[Dict[int, set]] = None
         for module in modules:
             for node in ast.walk(module.tree):
                 if isinstance(node, (ast.FunctionDef,
@@ -440,6 +485,175 @@ class CallGraph:
     def functions_named(self, name: str
                         ) -> List[Tuple[Module, ast.AST]]:
         return self.defs.get(name, [])
+
+    # -- execution domains (the two-world classification) -------------
+
+    @staticmethod
+    def owner_function(module: Module, node: ast.AST
+                       ) -> Optional[ast.AST]:
+        """Nearest enclosing def (lambdas skipped: their bodies run
+        where the surrounding code hands them off, which the sinks
+        below already model for the cases we care about)."""
+        cur = module.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = module.parents.get(cur)
+        return None
+
+    @staticmethod
+    def _callback_names(node: Optional[ast.AST]) -> List[str]:
+        """Function names a callback argument may refer to: a bare
+        reference (`self.engine.step`), a coroutine invocation
+        (`self.run_engine_loop()`), or a functools.partial of either."""
+        if node is None:
+            return []
+        if isinstance(node, ast.Call):
+            if tail_name(node.func) == "partial" and node.args:
+                return CallGraph._callback_names(node.args[0])
+            name = tail_name(node.func)
+            return [name] if name else []
+        name = tail_name(node)
+        return [name] if name else []
+
+    @staticmethod
+    def _call_arity(call: ast.Call) -> Optional[int]:
+        """Positional+keyword argument count, or None when the call
+        spreads (*args/**kwargs) and arity cannot be known."""
+        for arg in call.args:
+            if isinstance(arg, ast.Starred):
+                return None
+        for kw in call.keywords:
+            if kw.arg is None:
+                return None
+        return len(call.args) + len(call.keywords)
+
+    @staticmethod
+    def _def_accepts(fn: ast.AST, n: Optional[int],
+                     method_call: bool) -> bool:
+        """Whether a def could be the target of a call with `n`
+        arguments — the cheap arity filter that keeps same-named
+        methods of unrelated classes (`AsyncStream.put(item)` vs
+        `LRUCache.put(key, value)`) from cross-polluting domains."""
+        if n is None:
+            return True
+        a = fn.args
+        pos = list(a.posonlyargs) + list(a.args)
+        required = len(pos) - len(a.defaults)
+        maxn = len(pos) + len(a.kwonlyargs)
+        if method_call and pos and pos[0].arg in ("self", "cls"):
+            required -= 1
+            maxn -= 1
+        required += sum(1 for d in a.kw_defaults if d is None)
+        if a.vararg is not None or a.kwarg is not None:
+            maxn = len(pos) + len(a.kwonlyargs) + 1_000_000
+        return max(0, required) <= n <= maxn
+
+    @staticmethod
+    def _is_awaited(module: Module, call: ast.Call) -> bool:
+        """Whether a call's result is consumed as an awaitable
+        (`await f()`, `async for ... in f()`, `async with f()`)."""
+        parent = module.parents.get(call)
+        if isinstance(parent, ast.Await):
+            return True
+        if isinstance(parent, ast.AsyncFor) and parent.iter is call:
+            return True
+        if isinstance(parent, ast.withitem) and \
+                parent.context_expr is call:
+            grand = module.parents.get(parent)
+            return isinstance(grand, ast.AsyncWith)
+        return False
+
+    def _edge_targets(self, name: str, arity: Optional[int],
+                      awaited: bool, method_call: bool) -> list:
+        """Defs a call edge may reach. Two disambiguators prune
+        same-name collisions: arity (the callee must accept the call),
+        and sync/async kind — an awaited call runs async defs, a plain
+        call runs sync defs (calling a coroutine function without
+        awaiting only creates the coroutine; the loop sinks handle the
+        hand-off forms). Either filter is skipped when it would prune
+        ALL candidates (an unambiguous name resolves as before)."""
+        cands = self.defs.get(name, [])
+        by_arity = [(m, f) for m, f in cands
+                    if self._def_accepts(f, arity, method_call)]
+        if by_arity:
+            cands = by_arity
+        async_defs = [(m, f) for m, f in cands
+                      if isinstance(f, ast.AsyncFunctionDef)]
+        sync_defs = [(m, f) for m, f in cands
+                     if not isinstance(f, ast.AsyncFunctionDef)]
+        if async_defs and sync_defs:
+            return async_defs if awaited else sync_defs
+        return cands
+
+    def ensure_domains(self) -> Dict[int, set]:
+        """id(def-node) -> {EVENT_LOOP, STEP_THREAD} subset, computed
+        once: seeds (async defs, loop-sink callbacks, thread-sink
+        callables) propagated through the name-resolved call edges.
+        STEP_THREAD never propagates INTO an async def (sync code
+        calling a coroutine function only creates the coroutine)."""
+        if self._domains is not None:
+            return self._domains
+        domains: Dict[int, set] = {}
+        # owner id -> [(callee name, arity, awaited, method_call)]
+        edges: Dict[int, list] = {}
+        work: List[Tuple[ast.AST, str]] = []
+
+        def seed(fn: ast.AST, domain: str) -> None:
+            if domain == STEP_THREAD and \
+                    isinstance(fn, ast.AsyncFunctionDef):
+                return
+            tagged = domains.setdefault(id(fn), set())
+            if domain not in tagged:
+                tagged.add(domain)
+                work.append((fn, domain))
+
+        for module in self._modules:
+            for node in module.nodes:
+                if isinstance(node, ast.AsyncFunctionDef):
+                    seed(node, EVENT_LOOP)
+            for call in module.calls:
+                owner = self.owner_function(module, call)
+                name = call_tail(call)
+                if owner is not None and name in self.defs:
+                    edges.setdefault(id(owner), []).append(
+                        (name, self._call_arity(call),
+                         self._is_awaited(module, call),
+                         isinstance(call.func, ast.Attribute)))
+                # sink seeds: the handed-off callable changes worlds
+                targets: List[str] = []
+                domain = None
+                if name in _LOOP_SINKS:
+                    idx = _LOOP_SINKS[name]
+                    if idx < len(call.args):
+                        targets = self._callback_names(call.args[idx])
+                        domain = EVENT_LOOP
+                elif name in _THREAD_SINKS:
+                    idx = _THREAD_SINKS[name]
+                    if idx < len(call.args):
+                        targets = self._callback_names(call.args[idx])
+                        domain = STEP_THREAD
+                elif name == "Thread":
+                    targets = self._callback_names(
+                        keyword_arg(call, "target"))
+                    domain = STEP_THREAD
+                for target in targets:
+                    for _, fn in self.defs.get(target, ()):
+                        seed(fn, domain)
+
+        while work:
+            fn, domain = work.pop()
+            for name, arity, awaited, meth in edges.get(id(fn), ()):
+                for _, callee_fn in self._edge_targets(
+                        name, arity, awaited, meth):
+                    seed(callee_fn, domain)
+        self._domains = domains
+        return domains
+
+    def domains_of(self, fn: ast.AST) -> frozenset:
+        """Execution domains of one def node (empty = unreachable from
+        any seed — the rules built on domains stay silent there)."""
+        return frozenset(self.ensure_domains().get(id(fn), ()))
 
 
 # -- integer interval evaluation (VMEM pass) --------------------------

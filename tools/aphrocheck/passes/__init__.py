@@ -31,10 +31,24 @@ Rule ID families:
 - FOLD001..FOLD002     — kernel-adjacent elementwise chains paying an
                          HBM round trip (Zen-Attention) and online-
                          softmax rescale multiplies (AMLA mul-by-add)
+- ASYNC001..ASYNC004   — event-loop hygiene over the domain-classified
+                         call graph: blocking calls on the loop,
+                         fire-and-forget task swallows, deprecated
+                         get_event_loop(), await points inside
+                         critical state (held sync locks, read-await-
+                         write TOCTOU)
+- RACE001..RACE003     — two-world shared-state hazards: `self.`
+                         attributes written in BOTH the event-loop and
+                         step-thread domains without a registered
+                         reason, off-loop scheduler commits that
+                         bypass the reincarnation epoch guard, and
+                         mutable module-level state shared across the
+                         worlds
 """
-from tools.aphrocheck.passes import (bound_pass, clock_pass, dma_pass,
-                                     exc_pass, flag_pass, fold_pass,
-                                     grid_pass, recomp_pass, ref_pass,
+from tools.aphrocheck.passes import (async_pass, bound_pass,
+                                     clock_pass, dma_pass, exc_pass,
+                                     flag_pass, fold_pass, grid_pass,
+                                     race_pass, recomp_pass, ref_pass,
                                      roofline_pass, shard_pass,
                                      sync_pass, vmem_pass)
 
@@ -50,6 +64,8 @@ ALL_PASSES = (
     ("EXC", exc_pass.run),
     ("CLOCK", clock_pass.run),
     ("BP", bound_pass.run),
+    ("ASYNC", async_pass.run),
+    ("RACE", race_pass.run),
     ("ROOF", roofline_pass.run),
     ("FOLD", fold_pass.run),
 )
