@@ -398,6 +398,50 @@ _register(Flag(
     minimum=0))
 
 _register(Flag(
+    "APHRODITE_ROUTER_POLL_S", "float", 0.25,
+    "Fleet router health-poll interval (seconds): how often each "
+    "replica's GET /health?probe=1 fast path is sampled for the load "
+    "signal. Snapshots older than 4x this are STALE — the router "
+    "then falls back to round-robin over non-circuit-broken replicas "
+    "instead of trusting dead load numbers.",
+    minimum=0.01))
+
+_register(Flag(
+    "APHRODITE_ROUTER_RETRIES", "int", 3,
+    "Fleet router per-request retry budget: max times a request that "
+    "was rejected BEFORE any token streamed (503-draining replica, "
+    "connection refused/reset, replica 5xx) is re-sent to a "
+    "different replica. Once streaming has begun the request is "
+    "never re-issued.",
+    minimum=0))
+
+_register(Flag(
+    "APHRODITE_ROUTER_BACKOFF_S", "float", 0.05,
+    "Base delay (seconds) of the fleet router's exponential backoff "
+    "between request retries: attempt k sleeps base * 2^(k-1), "
+    "stretched to the replica's Retry-After hint when one was sent "
+    "and capped by the request's ttft_slo_s deadline.",
+    minimum=0))
+
+_register(Flag(
+    "APHRODITE_ROUTER_SPILL", "float", 8.0,
+    "Prefix-affinity spill threshold in load-score units (~queued "
+    "requests): a keyed request abandons its affinity replica for "
+    "the least-loaded one when the affinity replica's load exceeds "
+    "the fleet minimum by more than this — prefix-cache hits are "
+    "worth a bounded queue imbalance, not an unbounded one.",
+    minimum=0))
+
+_register(Flag(
+    "APHRODITE_ROUTER_CB_WINDOW_S", "float", 2.0,
+    "Fleet router circuit-break window (seconds): a replica whose "
+    "health poll or proxied request failed at the connection level "
+    "is excluded from routing for this long after the last failure; "
+    "a DEAD health report keeps re-arming the window until /health "
+    "recovers.",
+    minimum=0))
+
+_register(Flag(
     "APHRODITE_PREEMPT_BUDGET", "int", 4,
     "Max RECOMPUTE/SWAP preemptions per scheduling round; decode "
     "rows that still lack a free page past the budget skip the round "

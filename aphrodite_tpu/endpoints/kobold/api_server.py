@@ -10,7 +10,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import math
 from typing import List, Optional, Tuple
 
 from aiohttp import web
@@ -39,8 +38,7 @@ def _overloaded(e: RequestRejectedError) -> web.Response:
     """HTTP 429 + Retry-After for an admission-shed request."""
     return web.json_response(
         {"detail": str(e)}, status=429,
-        headers={"Retry-After": str(max(1, int(math.ceil(
-            e.retry_after_s))))})
+        headers=retry_after_headers(e.retry_after_s))
 
 
 def _draining(e: EngineDrainingError) -> web.Response:

@@ -9,7 +9,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import math
 from dataclasses import fields as dataclass_fields
 from typing import List, Optional
 
@@ -106,8 +105,7 @@ class OobaServer:
             except RequestRejectedError as e:
                 return web.json_response(
                     {"detail": str(e)}, status=429,
-                    headers={"Retry-After": str(max(1, int(math.ceil(
-                        e.retry_after_s))))})
+                    headers=retry_after_headers(e.retry_after_s))
             except EngineDrainingError as e:
                 return _draining(e)
             response = web.StreamResponse()
@@ -142,8 +140,7 @@ class OobaServer:
         except RequestRejectedError as e:
             return web.json_response(
                 {"detail": str(e)}, status=429,
-                headers={"Retry-After": str(max(1, int(math.ceil(
-                    e.retry_after_s))))})
+                headers=retry_after_headers(e.retry_after_s))
         except RequestTimeoutError as e:
             return web.json_response({"detail": str(e)}, status=408)
         except EngineDrainingError as e:

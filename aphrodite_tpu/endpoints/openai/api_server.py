@@ -23,7 +23,6 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
-import math
 from typing import AsyncIterator, List, Optional
 
 from aiohttp import web
@@ -67,9 +66,9 @@ def _overloaded(e: RequestRejectedError) -> web.Response:
     Retry-After estimate (whole seconds, at least 1)."""
     body = ErrorResponse(message=str(e), type="overloaded_error",
                          code="429").model_dump()
-    retry_after = max(1, int(math.ceil(e.retry_after_s)))
     return web.json_response(body, status=429,
-                             headers={"Retry-After": str(retry_after)})
+                             headers=retry_after_headers(
+                                 e.retry_after_s))
 
 
 def _draining(e: EngineDrainingError) -> web.Response:

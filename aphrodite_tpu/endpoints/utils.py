@@ -44,15 +44,69 @@ async def request_disconnected(request: web.Request) -> bool:
     return request.transport is None or request.transport.is_closing()
 
 
+def retry_after_seconds(seconds: float) -> int:
+    """`Retry-After` wire value: whole seconds, at least 1. The ONE
+    place the rounding rule lives — every frontend emits through it
+    and the fleet router's parser assumes it."""
+    return max(1, int(math.ceil(seconds)))
+
+
 def retry_after_headers(seconds: float) -> dict:
     """`Retry-After` header dict (whole seconds, at least 1)."""
-    return {"Retry-After": str(max(1, int(math.ceil(seconds))))}
+    return {"Retry-After": str(retry_after_seconds(seconds))}
 
 
-async def health_response(engine) -> web.Response:
+def parse_retry_after(headers) -> Optional[float]:
+    """Inverse of :func:`retry_after_headers`: the `Retry-After` value
+    of a response header mapping as seconds, or None when absent or
+    malformed (HTTP-date forms are not produced by these frontends and
+    parse as None). The fleet router uses this to pace its retries."""
+    raw = headers.get("Retry-After") if headers is not None else None
+    if raw is None:
+        return None
+    try:
+        return max(0.0, float(str(raw).strip()))
+    except ValueError:
+        return None
+
+
+def probe_body(engine) -> dict:
+    """The `GET /health?probe=1` fast path: lifecycle state + overload
+    snapshot only — none of the full report's counters — so a router
+    polling N replicas at a short interval stays cheap on both ends."""
+    in_flight = engine.engine.has_unfinished_requests()
+    try:
+        overload = engine.engine.overload_snapshot().to_json()
+    except RuntimeError:
+        # Mid-rebuild the scheduler object is being swapped off-loop;
+        # report one probe without a snapshot rather than 500.
+        overload = None
+    return {
+        "state": engine.health.state(in_flight=in_flight).value,
+        "draining": engine.health.is_draining,
+        "inflight": engine.engine.get_num_unfinished_requests(),
+        "overload": overload,
+    }
+
+
+async def health_response(engine, probe: bool = False) -> web.Response:
     """Serialize the engine's HealthReport with load-balancer-ready
-    status codes (shared by all three frontends' /health routes)."""
+    status codes (shared by all three frontends' /health routes).
+    `probe=True` (the `?probe=1` query) serializes only lifecycle
+    state + overload snapshot — same status-code contract, a fraction
+    of the payload — for high-rate router polls."""
     from aphrodite_tpu.engine.async_aphrodite import AsyncEngineDeadError
+    if probe:
+        body = probe_body(engine)
+        if body["state"] == "DEAD":
+            return web.json_response(body, status=503)
+        if body["state"] == "DRAINING":
+            rem = engine.health.drain_remaining_s
+            return web.json_response(
+                body, status=503,
+                headers=retry_after_headers(
+                    rem if rem is not None else 30))
+        return web.json_response(body)
     try:
         report = await engine.check_health()
     except AsyncEngineDeadError as e:
@@ -130,7 +184,8 @@ def install_lifecycle(app: web.Application, engine,
     that drains before exiting (see module docstring)."""
 
     async def health(request: web.Request) -> web.Response:
-        return await health_response(engine)
+        probe = request.query.get("probe", "") not in ("", "0")
+        return await health_response(engine, probe=probe)
 
     app.router.add_get("/health", health)
     app.router.add_post("/admin/drain",

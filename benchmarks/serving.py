@@ -483,6 +483,324 @@ async def run(args) -> dict:
     }
 
 
+async def run_fleet(args) -> dict:
+    """Fleet mode: N REAL replica server processes behind the fleet
+    router, Poisson load driven through the router over HTTP, with a
+    mid-run rolling deploy (`--rollout-at`) and an optional chaos
+    SIGKILL of one replica (`--chaos-kill` / `--kill-at`). Reports
+    fleet goodput, TTFT percentiles, prefix-affinity hit rate, retry
+    counters, per-replica accounting, rollout-window continuity, and
+    the zero-lost invariant (`requests_unaccounted == 0`)."""
+    import tempfile
+
+    import aiohttp
+    from aiohttp import web as aioweb
+
+    from aphrodite_tpu.fleet.launcher import FleetLauncher
+    from aphrodite_tpu.fleet.router import FleetRouter
+
+    n = int(args.fleet)
+    turns = max(1, int(getattr(args, "session_turns", 4) or 4))
+    rollout_at = float(getattr(args, "rollout_at", 0.5))
+    kill_at = float(getattr(args, "kill_at", -1.0))
+    if bool(getattr(args, "chaos_kill", False)) and kill_at < 0:
+        kill_at = 0.3
+    admin_key = "fleet-admin"
+    log_dir = tempfile.mkdtemp(prefix="fleet-logs-")
+
+    extra = ["--load-format", args.load_format,
+             "--dtype", args.dtype,
+             "--max-num-seqs", str(args.max_num_seqs),
+             "--max-model-len", str(args.max_model_len),
+             "--multi-step", str(args.multi_step),
+             "--swap-space", "0.01",
+             "--disable-log-stats"]
+    launcher = FleetLauncher(args.model, n, admin_key=admin_key,
+                             served_model_name="fleet",
+                             extra_args=extra, log_dir=log_dir)
+    logger_warn("fleet: spawning %d replicas (logs in %s)", n, log_dir)
+    await launcher.start_all(ready_timeout_s=300.0)
+
+    http = aiohttp.ClientSession(timeout=aiohttp.ClientTimeout(
+        total=None, sock_connect=10.0))
+
+    # Deterministic session workload: each session shares a prompt
+    # PREFIX (first half of the prompt) so its turns hash to the same
+    # affinity key; suffixes make every request distinct.
+    rng = np.random.RandomState(0)
+    n_sessions = max(1, args.num_requests // turns)
+    prefix_len = max(8, args.prompt_len // 2)
+    session_prefix = {
+        s: rng.randint(5, 400, size=prefix_len).tolist()
+        for s in range(n_sessions)
+    }
+    prompts = []
+    for i in range(args.num_requests):
+        s = i % n_sessions
+        suffix = rng.randint(
+            5, 400, size=args.prompt_len - prefix_len).tolist()
+        prompts.append((s, session_prefix[s] + suffix))
+
+    async def warm_one(url: str, prompt, out_len: int) -> None:
+        body = {"model": "fleet", "prompt": prompt,
+                "max_tokens": out_len, "temperature": 0.0,
+                "ignore_eos": True}
+        try:
+            async with http.post(url + "/v1/completions",
+                                 json=body) as resp:
+                await resp.read()
+        except aiohttp.ClientError as e:
+            logger_warn("warmup request to %s failed: %s", url, e)
+
+    async def warm_replica(url: str) -> None:
+        """Absorb the workload's shape-bucket compiles directly on
+        one replica (every replica must compile its own lattice)."""
+        for batch in (1, min(2, args.max_num_seqs),
+                      min(4, args.max_num_seqs)):
+            await asyncio.gather(*(
+                warm_one(url, prompts[j % len(prompts)][1],
+                         args.output_len)
+                for j in range(batch)))
+        await warm_one(url, prompts[0][1], max(1, 13 % args.output_len))
+
+    if int(getattr(args, "warmup", 0) or 0):
+        logger_warn("fleet: warming %d replicas", n)
+        await asyncio.gather(*(warm_replica(h.url)
+                               for h in launcher.handles()))
+
+    async def restart_and_warm(handle) -> None:
+        """Rollout restart hook: bounce the process, then warm the
+        fresh replica BEFORE the router re-admits it, so re-admitted
+        capacity serves at speed instead of compiling on live
+        traffic."""
+        await launcher.restart(handle)
+        async with aiohttp.ClientSession() as boot:
+            await launcher._wait_ready(boot, handle, 300.0)
+        await warm_replica(handle.url)
+
+    router = FleetRouter(launcher.handles(), admin_keys=[admin_key],
+                         restart_cb=restart_and_warm)
+    await router.start()
+    app_runner = aioweb.AppRunner(router.build_app())
+    await app_runner.setup()
+    site = aioweb.TCPSite(app_runner, "127.0.0.1", 0)
+    await site.start()
+    base = f"http://127.0.0.1:{app_runner.addresses[0][1]}"
+
+    outcomes = {"served": 0, "failed_mid_stream": 0,
+                "client_5xx_prestream": 0, "rejected_429": 0,
+                "rejected_other": 0, "transport_errors": 0}
+    ttfts, e2es = [], []
+    completions = []            # perf_counter stamps of served reqs
+
+    async def one(i: int) -> None:
+        _, prompt = prompts[i]
+        body = {"model": "fleet", "prompt": prompt,
+                "max_tokens": args.output_len, "temperature": 0.0,
+                "ignore_eos": True, "stream": True}
+        t0 = time.perf_counter()
+        try:
+            async with http.post(base + "/v1/completions",
+                                 json=body) as resp:
+                if resp.status == 200:
+                    first = None
+                    done = False
+                    try:
+                        async for chunk in resp.content.iter_any():
+                            if first is None and chunk:
+                                first = time.perf_counter()
+                            if b"[DONE]" in chunk:
+                                done = True
+                    except aiohttp.ClientError:
+                        pass
+                    t1 = time.perf_counter()
+                    if done:
+                        outcomes["served"] += 1
+                        ttfts.append((first or t1) - t0)
+                        e2es.append(t1 - t0)
+                        completions.append(t1)
+                    else:
+                        # Mid-stream casualty: truthful truncation,
+                        # never silently re-issued.
+                        outcomes["failed_mid_stream"] += 1
+                    return
+                await resp.read()
+                if resp.status == 429:
+                    outcomes["rejected_429"] += 1
+                elif resp.status >= 500:
+                    # Forbidden: the router must retry these away
+                    # for requests that never began streaming.
+                    outcomes["client_5xx_prestream"] += 1
+                else:
+                    outcomes["rejected_other"] += 1
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            outcomes["transport_errors"] += 1
+            logger_warn("request %d transport error: %s: %s", i,
+                        type(e).__name__, e)
+
+    rollout_result = {}
+
+    async def fire_rollout() -> None:
+        t0r = time.perf_counter()
+        try:
+            async with http.post(
+                    base + "/admin/rollout",
+                    json={"deadline_s": 60.0,
+                          "ready_timeout_s": 300.0},
+                    headers={"Authorization":
+                             f"Bearer {admin_key}"}) as resp:
+                rollout_result["status"] = resp.status
+                rollout_result["report"] = await resp.json()
+        except aiohttp.ClientError as e:
+            rollout_result["status"] = -1
+            rollout_result["error"] = f"{type(e).__name__}: {e}"
+        rollout_result["window"] = (t0r, time.perf_counter())
+
+    kill_info = None
+    rollout_task = None
+    kill_index = (int(kill_at * args.num_requests)
+                  if kill_at >= 0 else None)
+    rollout_index = (int(rollout_at * args.num_requests)
+                     if rollout_at >= 0 else None)
+    arrival_rng = np.random.RandomState(1234)
+    tasks = []
+    t_start = time.perf_counter()
+    async for i in poisson_arrivals(args.num_requests,
+                                    args.request_rate, arrival_rng):
+        if kill_index is not None and i == kill_index:
+            victim = n - 1
+            launcher.kill(victim)
+            kill_info = {"replica": f"replica-{victim}",
+                         "at_request": i,
+                         "at_s": round(
+                             time.perf_counter() - t_start, 3)}
+            logger_warn("fleet: chaos SIGKILL of replica-%d at "
+                        "request %d", victim, i)
+        if rollout_index is not None and i == rollout_index:
+            logger_warn("fleet: firing mid-run rolling deploy at "
+                        "request %d", i)
+            rollout_task = asyncio.create_task(fire_rollout())
+        tasks.append(asyncio.create_task(one(i)))
+    await asyncio.gather(*tasks)
+    wall = time.perf_counter() - t_start
+    if rollout_task is not None:
+        await rollout_task
+
+    def pct(xs, p):
+        return float(np.percentile(np.asarray(xs), p)) if xs else 0.0
+
+    rollout_detail = None
+    if rollout_result:
+        w0, w1 = rollout_result.get("window", (0.0, 0.0))
+        stamps = sorted(t for t in completions if w0 <= t <= w1)
+        edges = [w0] + stamps + [w1]
+        max_gap = max((b - a for a, b in zip(edges, edges[1:])),
+                      default=0.0) if w1 > w0 else 0.0
+        rollout_detail = {
+            "status": rollout_result.get("status"),
+            "started_at_s": round(w0 - t_start, 3),
+            "duration_s": round(w1 - w0, 3),
+            "completions_during": len(stamps),
+            # Zero-downtime evidence: the longest served-request gap
+            # inside the rollout window (never a full outage).
+            "max_completion_gap_s": round(max_gap, 3),
+            "report": rollout_result.get("report"),
+            "error": rollout_result.get("error"),
+        }
+
+    stats = router.stats
+    accounted = sum(outcomes.values())
+    detail = {
+        "fleet": n,
+        "request_rate": args.request_rate,
+        "num_requests": args.num_requests,
+        "prompt_len": args.prompt_len,
+        "output_len": args.output_len,
+        "session_turns": turns,
+        "sessions": n_sessions,
+        "goodput_out_tok_s": round(
+            outcomes["served"] * args.output_len / wall, 1),
+        "ttft_p50": round(pct(ttfts, 50), 4),
+        "ttft_p90": round(pct(ttfts, 90), 4),
+        "ttft_p99": round(pct(ttfts, 99), 4),
+        "e2e_p50": round(pct(e2es, 50), 4),
+        "e2e_p99": round(pct(e2es, 99), 4),
+        "outcomes": dict(outcomes),
+        # The fleet-wide zero-lost invariant: every request resolved
+        # to exactly one outcome.
+        "requests_unaccounted": args.num_requests - accounted,
+        "affinity_hit_rate": stats.to_json()["affinity_hit_rate"],
+        "retries": {"conn": stats.retries_conn,
+                    "status_503": stats.retries_503,
+                    "status_5xx": stats.retries_5xx,
+                    "total": stats.retries_total},
+        "router": stats.to_json(),
+        "replicas": {r.name: r.describe()
+                     for r in router.replicas},
+        "rollout": rollout_detail,
+        "chaos_kill": kill_info,
+        "replica_logs": log_dir,
+    }
+
+    await http.close()
+    await app_runner.cleanup()
+    await router.stop()
+    await launcher.shutdown()
+    return {
+        "metric": "fleet_goodput_out_tok_s",
+        "value": detail["goodput_out_tok_s"],
+        "unit": "tok/s",
+        "detail": detail,
+    }
+
+
+def synthetic_tiny_dir() -> str:
+    """Tiny-llama config + offline-trained ByteLevel BPE tokenizer
+    (mirrors tests/conftest.py's tiny_model_dir). Fleet replicas
+    serve real HTTP with real tokenizers, so unlike synthetic-7b this
+    model dir must carry one; it is small enough that N engine
+    subprocesses build in seconds on CPU."""
+    import json as _json
+    import tempfile
+
+    from tokenizers import (Tokenizer, decoders, models,
+                            pre_tokenizers, trainers)
+    tmp = tempfile.mkdtemp(prefix="serving-tiny-")
+    corpus = [
+        "the quick brown fox jumps over the lazy dog",
+        "hello world this is a tiny tokenizer training corpus",
+        "continuous batching over a paged key value cache",
+        "fleet routing with prefix affinity and rolling deploys",
+        "0123456789 !?.,:;()[]{}",
+    ] * 4
+    tok = Tokenizer(models.BPE(unk_token=None))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=True)
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=512, special_tokens=["<s>", "</s>", "<pad>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet())
+    tok.train_from_iterator(corpus, trainer)
+    tok.save(os.path.join(tmp, "tokenizer.json"))
+    with open(os.path.join(tmp, "tokenizer_config.json"), "w") as f:
+        _json.dump({"tokenizer_class": "PreTrainedTokenizerFast",
+                    "bos_token": "<s>", "eos_token": "</s>",
+                    "pad_token": "<pad>",
+                    "model_max_length": 512}, f)
+    with open(os.path.join(tmp, "config.json"), "w") as f:
+        _json.dump({
+            "architectures": ["LlamaForCausalLM"],
+            "model_type": "llama",
+            "vocab_size": tok.get_vocab_size(),
+            "hidden_size": 64, "intermediate_size": 128,
+            "num_hidden_layers": 2, "num_attention_heads": 4,
+            "num_key_value_heads": 2,
+            "max_position_embeddings": 512, "rms_norm_eps": 1e-6,
+            "rope_theta": 10000.0, "tie_word_embeddings": False,
+            "torch_dtype": "float32", "bos_token_id": 0,
+            "eos_token_id": 1}, f)
+    return tmp
+
+
 def synthetic_7b_dir() -> str:
     """Mistral-7B-shaped dummy config (bench.py's geometry) so the
     serving artifact runs hermetically (zero egress)."""
@@ -572,11 +890,38 @@ def main() -> None:
                         help="APHRODITE_FAULT spec armed after warmup "
                              "in --chaos-kill mode ('none' = drain "
                              "storm only)")
+    parser.add_argument("--fleet", type=int, default=0,
+                        help="fleet mode: spawn N replica server "
+                             "processes behind the fleet router and "
+                             "drive the load through it over HTTP "
+                             "(reports goodput, affinity hit rate, "
+                             "retries, requests_unaccounted)")
+    parser.add_argument("--session-turns", type=int, default=4,
+                        help="fleet mode: requests per multi-turn "
+                             "session (turns share a prompt prefix, "
+                             "driving prefix-affinity routing)")
+    parser.add_argument("--rollout-at", type=float, default=0.5,
+                        help="fleet mode: fire the zero-downtime "
+                             "POST /admin/rollout after this "
+                             "fraction of arrivals (-1 = no rollout)")
+    parser.add_argument("--kill-at", type=float, default=-1.0,
+                        help="fleet mode: SIGKILL the last replica "
+                             "after this fraction of arrivals "
+                             "(-1 = off; --chaos-kill defaults it "
+                             "to 0.3)")
     args = parser.parse_args()
     if args.model == "synthetic-7b":
         args.model = synthetic_7b_dir()
         args.load_format = "dummy"
-    print(json.dumps(asyncio.run(run(args))))
+    elif args.model == "synthetic-tiny":
+        args.model = synthetic_tiny_dir()
+        args.load_format = "dummy"
+        args.dtype = "float32"
+        args.max_model_len = min(args.max_model_len, 256)
+    if args.fleet > 0:
+        print(json.dumps(asyncio.run(run_fleet(args))))
+    else:
+        print(json.dumps(asyncio.run(run(args))))
 
 
 if __name__ == "__main__":

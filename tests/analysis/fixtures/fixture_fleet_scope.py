@@ -1,0 +1,29 @@
+"""Seeded violations proving the fleet scope extension: exactly one
+ASYNC001, one RACE001, and one BP001, in one module. The test copies
+this file to `aphrodite_tpu/fleet/` inside a throwaway tree — at THAT
+path the hot-prefix scope (not the explicit-fixture escape hatch)
+must make the ASYNC/RACE/BP passes fire, and at a non-fleet path
+outside the serving layers the ASYNC/BP findings must stay quiet."""
+import asyncio
+import time
+from collections import deque
+
+
+class RouterLike:
+
+    def __init__(self) -> None:
+        self.pending = deque()   # BP001: unbounded deque, no pragma
+        self.inflight = 0
+
+    def on_loop(self) -> None:
+        self.inflight += 1       # EVENT_LOOP writer (via poll)
+
+    def off_loop(self) -> None:
+        self.inflight += 1       # STEP_THREAD writer -> RACE001
+
+
+async def poll(router: RouterLike) -> None:
+    router.on_loop()
+    time.sleep(0.1)              # ASYNC001: blocks the event loop
+    await asyncio.get_running_loop().run_in_executor(
+        None, router.off_loop)

@@ -442,6 +442,46 @@ def test_async_scope_exempts_benchmarks():
     assert not findings, [f.render() for f in findings]
 
 
+def test_fleet_scope_extension_fires(tmp_path):
+    """The ASYNC/RACE/BP families cover `aphrodite_tpu/fleet/` (the
+    router is pure event-loop code — exactly their bug class): the
+    seeded fixture copied to a fleet path fires one finding per
+    family through the HOT-PREFIX scope (not the explicit-fixture
+    escape hatch), while the same file at a non-serving path inside
+    the package stays quiet."""
+    import shutil
+    src = os.path.join(REPO_ROOT, _fixture("fixture_fleet_scope.py"))
+    fleet_rel = "aphrodite_tpu/fleet/seeded.py"
+    other_rel = "aphrodite_tpu/modeling/seeded.py"
+    for rel in (fleet_rel, other_rel):
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(src, str(dst))
+    ctx, parse_findings = build_context(str(tmp_path), [fleet_rel])
+    assert not parse_findings
+    assert [f.rule for f in async_pass.run(ctx)] == ["ASYNC001"]
+    assert [f.rule for f in race_pass.run(ctx)] == ["RACE001"]
+    assert [f.rule for f in bound_pass.run(ctx)] == ["BP001"]
+    ctx2, parse_findings2 = build_context(str(tmp_path), [other_rel])
+    assert not parse_findings2
+    for pass_fn in (async_pass.run, race_pass.run, bound_pass.run):
+        assert not pass_fn(ctx2), \
+            [f.render() for f in pass_fn(ctx2)]
+
+
+def test_fleet_real_tree_is_clean_under_new_scope():
+    """The router/replica/launcher modules themselves satisfy the
+    passes that now gate them (the gate proves this too, but this
+    pins the fleet files specifically so a scope regression cannot
+    silently exempt them)."""
+    rels = ["aphrodite_tpu/fleet/router.py",
+            "aphrodite_tpu/fleet/replica.py",
+            "aphrodite_tpu/fleet/launcher.py"]
+    for pass_fn in (async_pass.run, race_pass.run, bound_pass.run):
+        findings = pass_fn(build_context(REPO_ROOT, rels)[0])
+        assert not findings, [f.render() for f in findings]
+
+
 def test_live_async_findings_fixed_in_tree():
     """Regression for the two live findings this tool surfaced (and
     the epoch-guard gaps): the async engine and the shared endpoint

@@ -60,6 +60,30 @@ def test_health(server_ctx):
     run(server_ctx, go)
 
 
+def test_health_probe_fast_path(server_ctx):
+    """GET /health?probe=1 serializes ONLY lifecycle state + overload
+    snapshot (the fleet router's poll payload); the full report stays
+    the default."""
+    async def go(client):
+        r = await client.get("/health", params={"probe": "1"})
+        assert r.status == 200
+        body = await r.json()
+        assert set(body) == {"state", "draining", "inflight",
+                             "overload"}
+        assert body["state"] in ("RUNNING", "DEGRADED")
+        assert body["draining"] is False
+        assert isinstance(body["inflight"], int)
+        assert "queue_depth" in body["overload"]
+        assert "ewma_prefill_tok_s" in body["overload"]
+        # The probe must NOT carry the full report's counters...
+        assert "steps_completed" not in body
+        # ...which the default /health still does.
+        r = await client.get("/health")
+        full = await r.json()
+        assert "steps_completed" in full and "retries_total" in full
+    run(server_ctx, go)
+
+
 def test_health_reports_dead_after_fatal_fault(tiny_model_dir,
                                                monkeypatch):
     """An unrecoverable injected fault must flip /health to 503/DEAD

@@ -1,0 +1,621 @@
+"""Fleet router unit + e2e tests over fake replica servers.
+
+The fakes speak exactly the replica surface the router consumes —
+``GET /health?probe=1``, authed ``POST /admin/drain``, and a
+``/v1/completions`` that can serve JSON, stream SSE, reject with
+503-draining, or die mid-stream — so every routing/retry/rollout
+behavior is driven over real localhost HTTP without engine builds.
+"""
+import asyncio
+import json
+
+import pytest
+from aiohttp import web
+import aiohttp
+
+from aphrodite_tpu.fleet.replica import ReplicaHandle, ReplicaSnapshot
+from aphrodite_tpu.fleet.router import FleetRouter
+
+
+def snap(state="RUNNING", inflight=0, depth=0, tokens=0,
+         ewma=1000.0, age=0.0):
+    import time
+    return ReplicaSnapshot(
+        state=state, draining=state == "DRAINING", inflight=inflight,
+        queue_depth=depth, waiting_prefill_tokens=tokens,
+        ewma_prefill_tok_s=ewma,
+        polled_at=time.monotonic() - age)
+
+
+class FakeReplica:
+    """One configurable stand-in engine server on a real local port."""
+
+    def __init__(self, name, admin_key="k"):
+        self.name = name
+        self.admin_key = admin_key
+        self.state = "RUNNING"
+        self.inflight = 0
+        self.queue_depth = 0
+        self.reject_503 = False          # completions answer 503
+        self.sse_chunks = 3
+        self.die_after_chunks = None     # abrupt close mid-stream
+        self.requests = []               # recorded completion bodies
+        self.drain_calls = 0
+        self.url = None
+        self._runner = None
+
+    async def start(self):
+        app = web.Application()
+        app.router.add_get("/health", self._health)
+        app.router.add_post("/admin/drain", self._drain)
+        app.router.add_post("/v1/completions", self._completions)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        port = self._runner.addresses[0][1]
+        self.url = f"http://127.0.0.1:{port}"
+
+    async def stop(self):
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    def handle(self):
+        return ReplicaHandle(self.url, name=self.name,
+                             admin_key=self.admin_key)
+
+    async def _health(self, request):
+        body = {
+            "state": self.state,
+            "draining": self.state == "DRAINING",
+            "inflight": self.inflight,
+            "overload": {"queue_depth": self.queue_depth,
+                         "waiting_prefill_tokens": 0,
+                         "ewma_prefill_tok_s": 1000.0},
+        }
+        status = 503 if self.state in ("DRAINING", "DEAD") else 200
+        return web.json_response(body, status=status)
+
+    async def _drain(self, request):
+        token = request.headers.get("Authorization", "")\
+            .removeprefix("Bearer ").strip()
+        if token != self.admin_key:
+            return web.json_response({"detail": "bad key"}, status=401)
+        self.drain_calls += 1
+        self.state = "DRAINING"
+        self.reject_503 = True
+        self.inflight = 0
+        return web.json_response({"state": "DRAINING"})
+
+    async def _completions(self, request):
+        body = await request.json()
+        self.requests.append(body)
+        if self.reject_503:
+            return web.json_response(
+                {"detail": "draining"}, status=503,
+                headers={"Retry-After": "1"})
+        if body.get("stream"):
+            resp = web.StreamResponse(headers={
+                "Content-Type": "text/event-stream"})
+            await resp.prepare(request)
+            for i in range(self.sse_chunks):
+                if self.die_after_chunks is not None and \
+                        i >= self.die_after_chunks:
+                    # Abrupt death after `die_after_chunks` chunks
+                    # (0 = before any data): close the socket with
+                    # the chunked body unterminated.
+                    request.transport.close()
+                    return resp
+                await resp.write(
+                    f'data: {{"i": {i}, "replica": '
+                    f'"{self.name}"}}\n\n'.encode())
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+            return resp
+        return web.json_response({"replica": self.name, "ok": True})
+
+
+async def _make_router(fakes, monkeypatch=None, **kw):
+    handles = [f.handle() for f in fakes]
+    router = FleetRouter(handles, **kw)
+    # No background poll loop in tests: polls happen explicitly via
+    # router._poll_once() so snapshot state is deterministic. The
+    # session the poll loop would have created is still needed.
+    router._session = aiohttp.ClientSession(
+        timeout=aiohttp.ClientTimeout(total=None, sock_connect=5.0))
+    return router, handles
+
+
+async def _client_for(router):
+    runner = web.AppRunner(router.build_app())
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = runner.addresses[0][1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
+# ------------------------------------------------------------------
+# pick(): load awareness, affinity, staleness, circuit breaking
+# ------------------------------------------------------------------
+
+def test_load_aware_pick_avoids_saturated_replica():
+    """Picks follow the polled load score, not round-robin: a
+    saturated replica (deep queue, big backlog) is never chosen while
+    an idle peer exists."""
+    a, b, c = (ReplicaHandle(f"http://x{i}", name=f"r{i}")
+               for i in range(3))
+    router = FleetRouter([a, b, c])
+    a.snapshot = snap(depth=40, inflight=16, tokens=65536, ewma=500.0)
+    b.snapshot = snap(depth=1, inflight=2)
+    c.snapshot = snap(depth=0, inflight=1)
+    picks = [router.pick() for _ in range(10)]
+    assert a not in picks
+    assert c in picks           # least loaded gets traffic
+    assert router.stats.picks_load == 10
+
+
+def test_pick_skips_draining_dead_cordoned():
+    a, b = (ReplicaHandle(f"http://x{i}", name=f"r{i}")
+            for i in range(2))
+    router = FleetRouter([a, b])
+    a.snapshot = snap(state="DRAINING")
+    b.snapshot = snap()
+    assert router.pick() is b
+    b.cordoned = True
+    assert router.pick() is None      # a draining, b cordoned
+    b.cordoned = False
+    a.snapshot = snap(state="DEAD")
+    assert router.pick() is b
+
+
+def test_affinity_routes_sessions_and_spills_under_imbalance():
+    """A keyed request sticks to its rendezvous replica while load is
+    balanced, and spills to the least-loaded replica once the
+    affinity target's load exceeds the spill threshold."""
+    replicas = [ReplicaHandle(f"http://x{i}", name=f"r{i}")
+                for i in range(3)]
+    router = FleetRouter(replicas)
+    for r in replicas:
+        r.snapshot = snap()
+    key = "ids:1,2,3,4"
+    first = router.pick(key)
+    for _ in range(5):
+        assert router.pick(key) is first       # sticky while balanced
+    assert router.stats.affinity_hits == 6
+    assert router.stats.affinity_spills == 0
+    # Saturate the affinity target past APHRODITE_ROUTER_SPILL (8.0
+    # default): the key spills to the least-loaded replica.
+    first.snapshot = snap(depth=30, inflight=10)
+    spilled = router.pick(key)
+    assert spilled is not first
+    assert router.stats.affinity_spills == 1
+    # Different keys spread across replicas (rendezvous, not modulo
+    # anything): at least two distinct targets over a few keys.
+    for r in replicas:
+        r.snapshot = snap()
+    targets = {router.pick(f"ids:{i}") for i in range(8)}
+    assert len(targets) >= 2
+
+
+def test_stale_snapshots_fall_back_to_round_robin(monkeypatch):
+    """A poll outage must not black-hole the fleet: stale snapshots
+    lose their load signal and picks degrade to round-robin over
+    non-broken replicas."""
+    monkeypatch.setenv("APHRODITE_ROUTER_POLL_S", "0.05")
+    a, b = (ReplicaHandle(f"http://x{i}", name=f"r{i}")
+            for i in range(2))
+    router = FleetRouter([a, b])
+    a.snapshot = snap(age=10.0)     # stale (>4x poll interval)
+    b.snapshot = snap(age=10.0, depth=99)  # stale load is IGNORED
+    picks = [router.pick() for _ in range(4)]
+    assert picks.count(a) == 2 and picks.count(b) == 2
+    assert router.stats.picks_stale_fallback == 4
+
+
+def test_circuit_break_on_dead_and_readmit_on_recovery():
+    a, b = (ReplicaHandle(f"http://x{i}", name=f"r{i}")
+            for i in range(2))
+    router = FleetRouter([a, b])
+    b.snapshot = snap()
+    # DEAD report: circuit-broken AND non-routable.
+    a.record_health(snap(state="DEAD"), cb_window_s=60.0)
+    assert a.circuit_broken()
+    assert all(router.pick() is b for _ in range(4))
+    # Recovery: a routable report clears the breaker immediately.
+    a.record_health(snap(state="RUNNING"), cb_window_s=60.0)
+    assert not a.circuit_broken()
+    assert a in [router.pick() for _ in range(4)]
+
+
+def test_connection_failures_break_circuit_until_window():
+    import time
+    a = ReplicaHandle("http://x0", name="r0")
+    a.snapshot = snap()
+    a.record_failure(cb_window_s=0.05)
+    assert a.circuit_broken()
+    time.sleep(0.06)
+    assert not a.circuit_broken()
+
+
+# ------------------------------------------------------------------
+# proxy e2e: retry, streaming invariants
+# ------------------------------------------------------------------
+
+def test_transparent_retry_of_draining_replica():
+    """A 503-DRAINING replica is invisible to the client: the router
+    retries onto a healthy peer and serves 200 with zero
+    client-visible errors."""
+    async def go():
+        a, b = FakeReplica("a"), FakeReplica("b")
+        await a.start()
+        await b.start()
+        router, handles = await _make_router([a, b])
+        # Make `a` the preferred pick, then have it reject.
+        handles[0].snapshot = snap(depth=0)
+        handles[1].snapshot = snap(depth=5)
+        a.reject_503 = True
+        runner, base = await _client_for(router)
+        try:
+            async with aiohttp.ClientSession() as client:
+                resp = await client.post(base + "/v1/completions",
+                                         json={"n": 1})
+                assert resp.status == 200
+                body = await resp.json()
+                assert body["replica"] == "b"
+            assert router.stats.retries_503 == 1
+            assert len(a.requests) == 1 and len(b.requests) == 1
+            # The rejecting replica stops being picked immediately.
+            assert handles[0].snapshot.state == "DRAINING"
+        finally:
+            await runner.cleanup()
+            await router.stop()
+            await a.stop()
+            await b.stop()
+
+    asyncio.run(go())
+
+
+def test_retry_on_connection_refused_and_circuit_break():
+    """A kill-dead replica (connection refused) is retried onto a
+    peer and circuit-broken out of rotation."""
+    async def go():
+        a, b = FakeReplica("a"), FakeReplica("b")
+        await a.start()
+        await b.start()
+        dead_url = a.url
+        await a.stop()      # port now refuses connections
+        router, handles = await _make_router([a, b])
+        handles[0].url = dead_url
+        handles[0].snapshot = snap(depth=0)   # looks best on paper
+        handles[1].snapshot = snap(depth=3)
+        runner, base = await _client_for(router)
+        try:
+            async with aiohttp.ClientSession() as client:
+                resp = await client.post(base + "/v1/completions",
+                                         json={"n": 1})
+                assert resp.status == 200
+                assert (await resp.json())["replica"] == "b"
+            assert router.stats.retries_conn == 1
+            assert handles[0].circuit_broken()
+        finally:
+            await runner.cleanup()
+            await router.stop()
+            await b.stop()
+
+    asyncio.run(go())
+
+
+def test_streaming_served_through_router():
+    async def go():
+        a = FakeReplica("a")
+        await a.start()
+        router, handles = await _make_router([a])
+        handles[0].snapshot = snap()
+        runner, base = await _client_for(router)
+        try:
+            async with aiohttp.ClientSession() as client:
+                resp = await client.post(
+                    base + "/v1/completions",
+                    json={"prompt": "hi", "stream": True})
+                assert resp.status == 200
+                text = (await resp.read()).decode()
+                assert text.count("data:") == a.sse_chunks + 1
+                assert "[DONE]" in text
+            assert router.stats.served_streaming == 1
+        finally:
+            await runner.cleanup()
+            await router.stop()
+            await a.stop()
+
+    asyncio.run(go())
+
+
+def test_no_retry_after_first_token():
+    """The no-silent-reissue invariant: a replica that dies
+    MID-STREAM (after tokens reached the client) is NOT retried — the
+    client sees a truthfully truncated stream, and no peer ever sees
+    the request."""
+    async def go():
+        a, b = FakeReplica("a"), FakeReplica("b")
+        a.die_after_chunks = 1
+        await a.start()
+        await b.start()
+        router, handles = await _make_router([a, b])
+        handles[0].snapshot = snap(depth=0)     # a preferred
+        handles[1].snapshot = snap(depth=5)
+        runner, base = await _client_for(router)
+        try:
+            async with aiohttp.ClientSession() as client:
+                resp = await client.post(
+                    base + "/v1/completions",
+                    json={"n": 1, "stream": True})
+                assert resp.status == 200
+                try:
+                    text = (await resp.read()).decode()
+                except aiohttp.ClientError:
+                    text = ""
+                assert "[DONE]" not in text      # truncated, honest
+            assert router.stats.failed_mid_stream == 1
+            assert router.stats.retries_total == 0
+            assert len(b.requests) == 0          # never re-issued
+        finally:
+            await runner.cleanup()
+            await router.stop()
+            await a.stop()
+            await b.stop()
+
+    asyncio.run(go())
+
+
+def test_retry_before_first_token_is_transparent():
+    """The flip side: a streaming request whose replica dies BEFORE
+    the first chunk is retried transparently — the client sees one
+    clean 200 stream from the peer."""
+    async def go():
+        a, b = FakeReplica("a"), FakeReplica("b")
+        a.die_after_chunks = 0      # close before any data
+        await a.start()
+        await b.start()
+        router, handles = await _make_router([a, b])
+        handles[0].snapshot = snap(depth=0)
+        handles[1].snapshot = snap(depth=5)
+        runner, base = await _client_for(router)
+        try:
+            async with aiohttp.ClientSession() as client:
+                resp = await client.post(
+                    base + "/v1/completions",
+                    json={"n": 1, "stream": True})
+                assert resp.status == 200
+                text = (await resp.read()).decode()
+                assert "[DONE]" in text
+                assert '"replica": "b"' in text
+            assert router.stats.retries_conn == 1
+            assert router.stats.failed_mid_stream == 0
+        finally:
+            await runner.cleanup()
+            await router.stop()
+            await a.stop()
+            await b.stop()
+
+    asyncio.run(go())
+
+
+def test_deadline_caps_total_retry_time(monkeypatch):
+    """ttft_slo_s caps total router time across retries: with every
+    replica rejecting, the request fails fast instead of walking the
+    whole backoff ladder."""
+    monkeypatch.setenv("APHRODITE_ROUTER_BACKOFF_S", "5.0")
+    monkeypatch.setenv("APHRODITE_ROUTER_RETRIES", "3")
+
+    async def go():
+        import time
+        a = FakeReplica("a")
+        a.reject_503 = True
+        await a.start()
+        router, handles = await _make_router([a])
+        handles[0].snapshot = snap()
+        runner, base = await _client_for(router)
+        try:
+            async with aiohttp.ClientSession() as client:
+                t0 = time.monotonic()
+                resp = await client.post(
+                    base + "/v1/completions",
+                    json={"prompt": "hi", "ttft_slo_s": 0.3})
+                elapsed = time.monotonic() - t0
+                # Truthful relay of the upstream rejection, well
+                # before the 5s-base backoff ladder would finish.
+                assert resp.status == 503
+                assert elapsed < 2.0
+        finally:
+            await runner.cleanup()
+            await router.stop()
+            await a.stop()
+
+    asyncio.run(go())
+
+
+def test_probe_parse_retry_after_roundtrip():
+    """The router parses exactly what the frontends emit."""
+    from aphrodite_tpu.endpoints.utils import (parse_retry_after,
+                                               retry_after_headers)
+    assert parse_retry_after(retry_after_headers(2.3)) == 3.0
+    assert parse_retry_after(retry_after_headers(0.0)) == 1.0
+    assert parse_retry_after({}) is None
+    assert parse_retry_after({"Retry-After": "nope"}) is None
+
+
+def test_affinity_key_extraction():
+    router = FleetRouter([ReplicaHandle("http://x", name="r")])
+    key_ids = router.affinity_key({}, {"prompt": [1, 2, 3]})
+    assert key_ids == "ids:1,2,3"
+    assert router.affinity_key({}, {"prompt": [[1, 2], [3]]}) == \
+        "ids:1,2"
+    assert router.affinity_key({}, {"prompt": "hello"}) == \
+        "text:hello"
+    chat = {"messages": [{"role": "user", "content": "hi"}]}
+    assert router.affinity_key({}, chat).startswith("chat:")
+    assert router.affinity_key(
+        {"X-Aphrodite-Session": "s1"}, None) == "session:s1"
+    assert router.affinity_key({}, {"n": 2}) is None
+    # Shared prefixes map to the SAME key (the fleet-level prefix
+    # cache multiplier): truncation at the key length.
+    long_a = {"prompt": list(range(100))}
+    long_b = {"prompt": list(range(100)) + [999]}
+    assert router.affinity_key({}, long_a) == \
+        router.affinity_key({}, long_b)
+
+
+# ------------------------------------------------------------------
+# rolling deploy
+# ------------------------------------------------------------------
+
+def test_rolling_deploy_walks_fleet_with_zero_rejections():
+    """POST /admin/rollout drains each replica via its authed
+    /admin/drain, restarts it through the launcher hook, re-admits it
+    only once /health is routable again — while concurrent client
+    traffic sees zero rejected-without-retry requests."""
+    async def go():
+        fakes = [FakeReplica(f"r{i}") for i in range(3)]
+        for f in fakes:
+            await f.start()
+        restarts = []
+
+        async def restart_cb(handle):
+            fake = next(f for f in fakes if f.url == handle.url)
+            restarts.append(fake.name)
+            fake.state = "RUNNING"
+            fake.reject_503 = False
+
+        router, handles = await _make_router(
+            fakes, admin_keys=["roll-key"], restart_cb=restart_cb)
+        await router._poll_once()
+        runner, base = await _client_for(router)
+        stop_traffic = asyncio.Event()
+        outcomes = {"ok": 0, "bad": 0}
+
+        async def traffic(client):
+            while not stop_traffic.is_set():
+                try:
+                    resp = await client.post(
+                        base + "/v1/completions",
+                        json={"prompt": "hi"})
+                    if resp.status == 200:
+                        outcomes["ok"] += 1
+                    else:
+                        outcomes["bad"] += 1
+                    await resp.read()
+                except aiohttp.ClientError:
+                    outcomes["bad"] += 1
+                await asyncio.sleep(0.01)
+
+        try:
+            async with aiohttp.ClientSession() as client:
+                # Unauthed rollout is rejected.
+                resp = await client.post(base + "/admin/rollout",
+                                         json={})
+                assert resp.status == 401
+                t = asyncio.get_running_loop().create_task(
+                    traffic(client))
+                t.add_done_callback(lambda _: None)
+                resp = await client.post(
+                    base + "/admin/rollout",
+                    json={"deadline_s": 5.0, "ready_timeout_s": 5.0},
+                    headers={"Authorization": "Bearer roll-key"})
+                report = await resp.json()
+                assert resp.status == 200, report
+                stop_traffic.set()
+                await asyncio.gather(t, return_exceptions=True)
+            assert report["ok"] is True
+            assert [r["replica"] for r in report["replicas"]] == \
+                ["r0", "r1", "r2"]
+            assert all(r["drain"] == "drained"
+                       for r in report["replicas"])
+            assert all(r["ready"] for r in report["replicas"])
+            assert restarts == ["r0", "r1", "r2"]
+            assert all(f.drain_calls == 1 for f in fakes)
+            assert not any(h.cordoned for h in handles)
+            # Zero-downtime contract: every concurrent request was
+            # served (rejected-without-retry count is zero).
+            assert outcomes["ok"] >= 1
+            assert outcomes["bad"] == 0, outcomes
+            assert router.stats.rollouts_total == 1
+        finally:
+            await runner.cleanup()
+            await router.stop()
+            for f in fakes:
+                await f.stop()
+
+    asyncio.run(go())
+
+
+def test_rollout_rejects_concurrent_and_bad_body():
+    async def go():
+        fake = FakeReplica("r0")
+        await fake.start()
+        router, handles = await _make_router(
+            [fake], admin_keys=["roll-key"])
+        await router._poll_once()
+        runner, base = await _client_for(router)
+        try:
+            async with aiohttp.ClientSession() as client:
+                first = asyncio.get_running_loop().create_task(
+                    client.post(
+                        base + "/admin/rollout",
+                        json={"deadline_s": 2.0,
+                              "ready_timeout_s": 2.0},
+                        headers={"Authorization":
+                                 "Bearer roll-key"}))
+                first.add_done_callback(lambda _: None)
+                await asyncio.sleep(0.05)
+                second = await client.post(
+                    base + "/admin/rollout", json={},
+                    headers={"Authorization": "Bearer roll-key"})
+                assert second.status == 409
+                resp = await first
+                assert resp.status in (200, 500)
+        finally:
+            await runner.cleanup()
+            await router.stop()
+            await fake.stop()
+
+    asyncio.run(go())
+
+
+def test_fleet_health_aggregate():
+    async def go():
+        a, b = FakeReplica("a"), FakeReplica("b")
+        await a.start()
+        await b.start()
+        a.state = "DEAD"
+        router, handles = await _make_router([a, b])
+        await router._poll_once()
+        runner, base = await _client_for(router)
+        try:
+            async with aiohttp.ClientSession() as client:
+                resp = await client.get(base + "/health")
+                body = await resp.json()
+                assert resp.status == 200
+                assert body["state"] == "RUNNING"
+                assert body["replicas_serving"] == 1
+                assert body["replicas"]["a"]["circuit_broken"]
+                b.state = "DEAD"
+                await router._poll_once()
+                resp = await client.get(base + "/health")
+                assert resp.status == 503
+                assert "Retry-After" in resp.headers
+                resp = await client.get(base + "/fleet/stats")
+                stats = await resp.json()
+                assert "router" in stats and "replicas" in stats
+                # /admin/* is never proxied to replicas.
+                resp = await client.post(base + "/admin/drain",
+                                         json={})
+                assert resp.status == 404
+        finally:
+            await runner.cleanup()
+            await router.stop()
+            await a.stop()
+            await b.stop()
+
+    asyncio.run(go())

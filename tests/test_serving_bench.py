@@ -4,6 +4,8 @@ import argparse
 import asyncio
 import sys
 
+import pytest
+
 
 def _args(tiny_model_dir, **kw):
     defaults = dict(
@@ -135,6 +137,34 @@ def test_serving_harness_chaos_kill_mode(tiny_model_dir, monkeypatch):
     assert d["inflight_completed"] == d["inflight_offered"] == 4
     assert d["late_rejected_draining"] == d["late_offered"] == 4
     assert d["clean_exit"] is True
+
+
+@pytest.mark.slow
+def test_serving_harness_fleet_smoke():
+    """--fleet smoke (slow: spawns real replica server processes):
+    two replicas behind the router, a mid-run rolling deploy, every
+    request served with zero unaccounted and zero pre-stream 5xx.
+    Excluded from tier-1; CI runs it in the dedicated fleet job."""
+    sys.path.insert(0, "benchmarks")
+    from serving import run_fleet, synthetic_tiny_dir
+
+    args = argparse.Namespace(
+        model=synthetic_tiny_dir(), load_format="dummy",
+        dtype="float32", quantization=None, kv_cache_dtype="auto",
+        max_num_seqs=4, max_model_len=256, multi_step=4,
+        request_rate=4.0, num_requests=12, prompt_len=32,
+        output_len=6, warmup=1, fleet=2, session_turns=3,
+        rollout_at=0.5, kill_at=-1.0, chaos_kill=False)
+    result = asyncio.run(run_fleet(args))
+    assert result["metric"] == "fleet_goodput_out_tok_s"
+    d = result["detail"]
+    assert d["requests_unaccounted"] == 0
+    assert d["outcomes"]["client_5xx_prestream"] == 0
+    assert d["outcomes"]["served"] == 12
+    assert d["goodput_out_tok_s"] > 0
+    assert d["rollout"]["status"] == 200
+    assert d["rollout"]["report"]["ok"] is True
+    assert d["affinity_hit_rate"] is not None
 
 
 def test_serving_harness_chaos_fault_free_matches_baseline(

@@ -55,9 +55,11 @@ from tools.aphrocheck.core import (EVENT_LOOP, Finding, Module,
                                    call_tail, dotted_name, has_pragma,
                                    paths_conflict, tail_name)
 
-#: Scope: the layers between a client connection and the step thread.
+#: Scope: the layers between a client connection and the step thread,
+#: plus the fleet router — pure event-loop code where one blocked
+#: coroutine stalls every proxied stream and health poll.
 _HOT_PREFIXES = ("aphrodite_tpu/engine/", "aphrodite_tpu/endpoints/",
-                 "aphrodite_tpu/processing/")
+                 "aphrodite_tpu/processing/", "aphrodite_tpu/fleet/")
 
 #: Everything the CLI normally scans; explicitly-passed files outside
 #: these roots (the seeded fixtures) are treated as in-scope.
@@ -313,8 +315,8 @@ RULES = (
     ("ASYNC001", "blocking call (`time.sleep`, `subprocess.*`, sync "
      "HTTP/file/socket I/O, `Future.result()`) in a function the "
      "domain classifier places on the EVENT LOOP, within the "
-     "`engine/`/`endpoints/`/`processing/` scope — one blocked "
-     "coroutine stalls every stream and health probe "
+     "`engine/`/`endpoints/`/`processing/`/`fleet/` scope — one "
+     "blocked coroutine stalls every stream and health probe "
      "(`fut.result()` after an awaited `asyncio.wait` over it is "
      "recognized clean)",
      "`time.sleep(0.5)` in a helper called from `engine_step`"),

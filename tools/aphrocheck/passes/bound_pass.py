@@ -31,8 +31,10 @@ from tools.aphrocheck.core import (Finding, dotted_name, has_pragma,
                                    int_const, keyword_arg)
 
 #: BP001 scope: the layers between a client connection and the
-#: scheduler, where an unbounded queue defeats admission control.
-_HOT_PREFIXES = ("aphrodite_tpu/engine/", "aphrodite_tpu/endpoints/")
+#: scheduler, where an unbounded queue defeats admission control —
+#: and the fleet router, where one defeats every replica's at once.
+_HOT_PREFIXES = ("aphrodite_tpu/engine/", "aphrodite_tpu/endpoints/",
+                 "aphrodite_tpu/fleet/")
 
 #: Everything the CLI normally scans; explicitly-passed files outside
 #: these roots (the seeded fixtures) are treated as hot-path scope.
@@ -107,8 +109,8 @@ def run(ctx) -> List[Finding]:
 #: (rule, one-line contract, example) — rendered by `--rules-md`.
 RULES = (
     ("BP001", "`asyncio.Queue()`/`deque()` constructed without a "
-     "capacity bound in the `engine/`/`endpoints/` scope and without "
-     "a `# bounded-by: <reason>` comment registering why it cannot "
-     "grow unboundedly",
+     "capacity bound in the `engine/`/`endpoints/`/`fleet/` scope "
+     "and without a `# bounded-by: <reason>` comment registering why "
+     "it cannot grow unboundedly",
      "`self._backlog = asyncio.Queue()` with no bound or pragma"),
 )

@@ -57,7 +57,7 @@ from tools.aphrocheck.core import (EVENT_LOOP, STEP_THREAD, Finding,
                                    tail_name)
 
 _HOT_PREFIXES = ("aphrodite_tpu/engine/", "aphrodite_tpu/endpoints/",
-                 "aphrodite_tpu/processing/")
+                 "aphrodite_tpu/processing/", "aphrodite_tpu/fleet/")
 _ENGINE_PREFIXES = ("aphrodite_tpu/engine/",)
 
 #: Everything the CLI normally scans; explicitly-passed files outside
