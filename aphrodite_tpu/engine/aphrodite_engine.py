@@ -254,7 +254,7 @@ class AphroditeEngine:
 
         prefix = None
         if prefix_pos is not None:
-            prefix = self.scheduler.prefix_pool.add_or_get_prefix(
+            prefix = self.scheduler.prefix_pool.intern(
                 prompt_token_ids[:prefix_pos])
 
         seq_group = SequenceGroup(request_id, [seq], sampling_params,
@@ -319,7 +319,8 @@ class AphroditeEngine:
         DEAD."""
         return self.admission.snapshot(
             queue_depth=len(self.scheduler.waiting),
-            waiting_tokens=self.scheduler.waiting_prefill_tokens())
+            waiting_tokens=self.scheduler.waiting_prefill_tokens(),
+            prefix_pinned_pages=self.scheduler.prefix_pinned_pages())
 
     def _check_epoch(self) -> None:
         """Epoch guard for off-loop scheduler commits: a step thread
@@ -442,6 +443,11 @@ class AphroditeEngine:
             old_sched.abort_seq_group(group.request_id)
         restorable = [g for g in old_sched.waiting
                       if not g.is_finished()]
+        # Drop the old pool's prefix pins THROUGH the free seam: the
+        # torn-down scheduler's accounting ends exact (free pages ==
+        # boot value, pinned gauge 0) and no stale pin can be
+        # resurrected into the rebuilt pool.
+        old_sched.clear_prefixes()
         logger.warning(
             "Reincarnating engine: rebuilding executor + KV pool, "
             "restoring %d request(s), %d unrestorable.",
@@ -456,8 +462,8 @@ class AphroditeEngine:
                                    self.cache_config, self.lora_config)
         for group in restorable:
             if group.prefix is not None:
-                group.prefix = self.scheduler.prefix_pool.\
-                    add_or_get_prefix(group.prefix.token_ids)
+                group.prefix = self.scheduler.prefix_pool.intern(
+                    group.prefix.token_ids)
             self.scheduler.add_seq_group(group)
         self._inflight_rounds = []
         for rid in lost:
@@ -1038,6 +1044,7 @@ class AphroditeEngine:
             time_per_output_tokens=tpots,
             time_e2e_requests=e2es,
             num_waiting_tokens=self.scheduler.waiting_prefill_tokens(),
+            prefix_pinned_pages=self.scheduler.prefix_pinned_pages(),
             sheds_total=self.admission.sheds_total,
             expired_total=self.admission.expired_total,
             ewma_prefill_tok_s=self.admission.ewma_prefill_tok_s,

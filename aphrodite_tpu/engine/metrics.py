@@ -86,6 +86,10 @@ class Metrics:
         self.gauge_ewma_decode = _get_or_create(
             Gauge, "aphrodite:ewma_decode_tokens_per_s",
             "EWMA decode throughput.", labelnames)
+        self.gauge_prefix_pinned = _get_or_create(
+            Gauge, "aphrodite:prefix_pinned_pages",
+            "KV pages pinned by the prefix cache (held on purpose; "
+            "subtracted by the zero-leak accounting).", labelnames)
         self.counter_requests_shed = _get_or_create(
             Counter, "aphrodite:num_requests_shed",
             "Requests rejected at admission by overload control.",
@@ -140,6 +144,7 @@ class Stats:
     # Overload-control snapshot (cumulative counters; the logger
     # tracks deltas for the Prometheus counters).
     num_waiting_tokens: int = 0
+    prefix_pinned_pages: int = 0
     sheds_total: int = 0
     expired_total: int = 0
     ewma_prefill_tok_s: float = 0.0
@@ -190,6 +195,7 @@ class StatLogger:
             stats.num_generation_tokens)
         labeled(m.gauge_waiting_prefill_tokens).set(
             stats.num_waiting_tokens)
+        labeled(m.gauge_prefix_pinned).set(stats.prefix_pinned_pages)
         labeled(m.gauge_ewma_prefill).set(stats.ewma_prefill_tok_s)
         labeled(m.gauge_ewma_decode).set(stats.ewma_decode_tok_s)
         labeled(m.counter_requests_shed).inc(

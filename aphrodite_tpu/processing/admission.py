@@ -116,6 +116,10 @@ class AdmissionSnapshot:
     expired_total: int
     ewma_prefill_tok_s: float
     ewma_decode_tok_s: float
+    # Pages pinned by the prefix cache: held on purpose, not leaked —
+    # dashboards and the bench's zero-leak check subtract them from
+    # the free-page delta instead of fuzzing the invariant.
+    prefix_pinned_pages: int = 0
 
     def to_json(self) -> Dict[str, Any]:
         body = dataclasses.asdict(self)
@@ -264,12 +268,13 @@ class AdmissionController:
 
     # -- reporting ---------------------------------------------------
 
-    def snapshot(self, queue_depth: int,
-                 waiting_tokens: int) -> AdmissionSnapshot:
+    def snapshot(self, queue_depth: int, waiting_tokens: int,
+                 prefix_pinned_pages: int = 0) -> AdmissionSnapshot:
         return AdmissionSnapshot(
             queue_depth=queue_depth,
             waiting_prefill_tokens=waiting_tokens,
             sheds_total=self.sheds_total,
             expired_total=self.expired_total,
             ewma_prefill_tok_s=self._ewma_prefill_tok_s,
-            ewma_decode_tok_s=self._ewma_decode_tok_s)
+            ewma_decode_tok_s=self._ewma_decode_tok_s,
+            prefix_pinned_pages=prefix_pinned_pages)

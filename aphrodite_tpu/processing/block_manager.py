@@ -1,12 +1,23 @@
-"""Paged KV-cache block accounting (host side).
+"""Paged KV-cache block accounting (host side) — the page OWNER.
 
 Semantics match the reference's `aphrodite/processing/block_manager.py:10,68`
 (ref-counted allocator, watermark admission, copy-on-write fork, sliding-
-window block reuse, CPU<->HBM swap planning). This module is pure Python and
-device-agnostic: it only plans block operations; the executor applies them
-to the HBM page arrays (`executor/cache.py`) as batched gathers/scatters and
-host transfers — there is no per-block memcpy on TPU, the swap/copy plans
-are turned into single vectorized device ops per step.
+window block reuse, host<->HBM swap planning). This module is pure Python
+and device-agnostic: it only plans block operations; the executor applies
+them to the HBM page arrays (`executor/cache.py`) as batched gathers/
+scatters and host transfers — there is no per-block memcpy on TPU, the
+swap/copy plans are turned into single vectorized device ops per step.
+
+Ownership contract (machine-enforced by aphrocheck's LEAK/OWN passes):
+this module — together with `common/block.py` and `common/prefix.py` —
+is the ONLY place `PhysicalTokenBlock.ref_count`, the pool free lists,
+and the `block_tables` map may be mutated, and raw block objects never
+cross the module boundary: callers see `block_number` ints only
+(`get_block_table` / `block_numbers` / the swap mappings). Every
+refcount increment is paired with a statically-reachable free seam
+(`free`/`reset` for sequence tables, `free_prefix` for prefix pins);
+`python -m tools.aphrocheck --ledger` emits the alloc-site -> free-seam
+map (OWNERSHIP.json) that tier-1 drift-gates.
 """
 from __future__ import annotations
 
@@ -16,6 +27,7 @@ from typing import Dict, List, Optional, Set, Tuple
 from aphrodite_tpu.common import faultinject
 from aphrodite_tpu.common.block import (BlockTable, Device,
                                         PhysicalTokenBlock)
+from aphrodite_tpu.common.prefix import Prefix
 from aphrodite_tpu.common.sequence import (Sequence, SequenceGroup,
                                            SequenceStatus)
 
@@ -92,13 +104,25 @@ class BlockSpaceManager:
         self.watermark = watermark
         self.watermark_blocks = int(watermark * num_gpu_blocks)
 
-        self.gpu_allocator = BlockPool(Device.TPU, block_size, num_gpu_blocks)
-        self.cpu_allocator = BlockPool(Device.CPU, block_size, num_cpu_blocks)
+        # TPU-native names; the reference's gpu_/cpu_allocator spelling
+        # survives as read-only aliases below for parity callers.
+        self.hbm_pool = BlockPool(Device.TPU, block_size, num_gpu_blocks)
+        self.host_pool = BlockPool(Device.CPU, block_size, num_cpu_blocks)
         # thread-safe: mutated on the step thread inside step() and on
         # the event loop only via abort/free paths that run BETWEEN
         # steps (engine_step awaits the step future first); the two
         # writers are sequenced by the engine loop, never concurrent.
         self.block_tables: Dict[int, BlockTable] = {}
+
+    @property
+    def gpu_allocator(self) -> BlockPool:
+        """Reference-parity alias for :attr:`hbm_pool` (read-only)."""
+        return self.hbm_pool
+
+    @property
+    def cpu_allocator(self) -> BlockPool:
+        """Reference-parity alias for :attr:`host_pool` (read-only)."""
+        return self.host_pool
 
     # ------------------------------------------------------------------
     # Prompt admission / allocation
@@ -122,7 +146,7 @@ class BlockSpaceManager:
         for running sequences' next decode slots) so admitting a
         prompt can never immediately force a preemption."""
         needed = self._prompt_blocks_needed(seq_group)
-        free = self.gpu_allocator.get_num_free_blocks()
+        free = self.hbm_pool.get_num_free_blocks()
         # The watermark hysteresis avoids admitting a prompt that would
         # immediately force evictions.
         if self.num_total_gpu_blocks - needed < self.watermark_blocks:
@@ -150,10 +174,16 @@ class BlockSpaceManager:
         for logical_idx in range(num_prompt_blocks):
             if (self.block_sliding_window is not None
                     and logical_idx >= self.block_sliding_window):
+                # Sliding-window reuse: the aliased block is already in
+                # this table and already carries this group's refs —
+                # frees walk set(table), one decrement per unique block.
+                # Re-assigning `= num_seqs` here (the reference's shape)
+                # CLOBBERED a prefix-pinned or cross-group-shared count
+                # when the window wrapped onto a prefix block.
                 block = block_table[logical_idx % self.block_sliding_window]
             else:
-                block = self.gpu_allocator.allocate()
-            block.ref_count = seq_group.num_seqs()
+                block = self.hbm_pool.allocate()
+                block.ref_count = seq_group.num_seqs()
             block_table.append(block)
 
         if prefix is not None and not prefix.allocated:
@@ -174,7 +204,7 @@ class BlockSpaceManager:
     def can_append_slot(self, seq_group: SequenceGroup) -> bool:
         # One new block per running sequence is the worst case.
         num_seqs = seq_group.num_seqs(status=SequenceStatus.RUNNING)
-        return num_seqs <= self.gpu_allocator.get_num_free_blocks()
+        return num_seqs <= self.hbm_pool.get_num_free_blocks()
 
     def append_slot(self, seq: Sequence) -> Optional[Tuple[int, int]]:
         """Reserve a slot for one new token.
@@ -194,7 +224,7 @@ class BlockSpaceManager:
                 block_table.append(block_table[len(block_table) %
                                                self.block_sliding_window])
             else:
-                block_table.append(self.gpu_allocator.allocate())
+                block_table.append(self.hbm_pool.allocate())
                 return None
 
         last_block = block_table[-1]
@@ -202,9 +232,9 @@ class BlockSpaceManager:
         if last_block.ref_count == 1:
             return None
         # Shared tail block (post-fork): copy-on-write.
-        new_block = self.gpu_allocator.allocate()
+        new_block = self.hbm_pool.allocate()
         block_table[-1] = new_block
-        self.gpu_allocator.free(last_block)
+        self.hbm_pool.free(last_block)
         return last_block.block_number, new_block.block_number
 
     def burst_blocks_needed(self, seq: Sequence, num_ahead: int) -> int:
@@ -228,7 +258,7 @@ class BlockSpaceManager:
         table = self.block_tables[seq.seq_id]
         needed = (seq.get_len() - 1 + num_ahead) // self.block_size + 1
         while len(table) < needed:
-            table.append(self.gpu_allocator.allocate())
+            table.append(self.hbm_pool.allocate())
 
     def fork(self, parent_seq: Sequence, child_seq: Sequence) -> None:
         src_block_table = self.block_tables[parent_seq.seq_id]
@@ -252,7 +282,7 @@ class BlockSpaceManager:
     def can_swap_in(self, seq_group: SequenceGroup) -> bool:
         blocks = self._group_physical_blocks(seq_group)
         num_swapped_seqs = seq_group.num_seqs(status=SequenceStatus.SWAPPED)
-        free = self.gpu_allocator.get_num_free_blocks()
+        free = self.hbm_pool.get_num_free_blocks()
         # Each sequence will need one fresh block right after swap-in.
         required = len(blocks) + num_swapped_seqs
         return free - required >= self.watermark_blocks
@@ -273,10 +303,10 @@ class BlockSpaceManager:
                     hbm_block = mapping[cpu_block]
                     hbm_block.ref_count += 1
                 else:
-                    hbm_block = self.gpu_allocator.allocate()
+                    hbm_block = self.hbm_pool.allocate()
                     mapping[cpu_block] = hbm_block
                 new_block_table.append(hbm_block)
-                self.cpu_allocator.free(cpu_block)
+                self.host_pool.free(cpu_block)
             self.block_tables[seq.seq_id] = new_block_table
         return {
             cpu.block_number: hbm.block_number
@@ -285,7 +315,7 @@ class BlockSpaceManager:
 
     def can_swap_out(self, seq_group: SequenceGroup) -> bool:
         blocks = self._group_physical_blocks(seq_group)
-        return len(blocks) <= self.cpu_allocator.get_num_free_blocks()
+        return len(blocks) <= self.host_pool.get_num_free_blocks()
 
     def swap_out(self, seq_group: SequenceGroup) -> Dict[int, int]:
         """Plan HBM->host copies; returns {hbm_block: cpu_block}."""
@@ -296,16 +326,16 @@ class BlockSpaceManager:
                 if (seq_group.prefix is not None
                         and hbm_block in seq_group.prefix.block_table):
                     # Shared prefix blocks stay resident on HBM.
-                    self.gpu_allocator.free(hbm_block)
+                    self.hbm_pool.free(hbm_block)
                     continue
                 if hbm_block in mapping:
                     cpu_block = mapping[hbm_block]
                     cpu_block.ref_count += 1
                 else:
-                    cpu_block = self.cpu_allocator.allocate()
+                    cpu_block = self.host_pool.allocate()
                     mapping[hbm_block] = cpu_block
                 new_block_table.append(cpu_block)
-                self.gpu_allocator.free(hbm_block)
+                self.hbm_pool.free(hbm_block)
             self.block_tables[seq.seq_id] = new_block_table
         return {
             hbm.block_number: cpu.block_number
@@ -319,15 +349,31 @@ class BlockSpaceManager:
     def _free_block_table(self, block_table: BlockTable) -> None:
         for block in set(block_table):
             if block.device == Device.TPU:
-                self.gpu_allocator.free(block)
+                self.hbm_pool.free(block)
             else:
-                self.cpu_allocator.free(block)
+                self.host_pool.free(block)
 
     def free(self, seq: Sequence) -> None:
         if seq.seq_id not in self.block_tables:
             # Never scheduled, or already freed.
             return
         self._free_block_table(self.block_tables.pop(seq.seq_id))
+
+    def free_prefix(self, prefix: Prefix) -> int:
+        """Release a prefix's pin: the one refcount `allocate` added
+        when it first populated the prefix's block table. Returns the
+        number of pages whose pin was dropped. Idempotent via the
+        un-allocated early return; pages still shared by live
+        sequences survive their own tables' refs and return to the
+        pool on the last sequence free."""
+        if not prefix.allocated:
+            return 0
+        released = 0
+        for block in prefix.block_table:
+            self.hbm_pool.free(block)
+            released += 1
+        prefix.reset_block_table()
+        return released
 
     def reset(self) -> None:
         for block_table in self.block_tables.values():
@@ -337,8 +383,14 @@ class BlockSpaceManager:
     def get_block_table(self, seq: Sequence) -> List[int]:
         return [b.block_number for b in self.block_tables[seq.seq_id]]
 
+    def block_numbers(self, seq_id: int) -> List[int]:
+        """Page numbers for one sequence id — the int-only projection
+        callers outside this module must use (raw PhysicalTokenBlock
+        objects never cross the owner boundary)."""
+        return [b.block_number for b in self.block_tables[seq_id]]
+
     def get_num_free_gpu_blocks(self) -> int:
-        return self.gpu_allocator.get_num_free_blocks()
+        return self.hbm_pool.get_num_free_blocks()
 
     def get_num_free_cpu_blocks(self) -> int:
-        return self.cpu_allocator.get_num_free_blocks()
+        return self.host_pool.get_num_free_blocks()

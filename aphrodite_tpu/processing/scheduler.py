@@ -834,7 +834,7 @@ class Scheduler:
         # reservations never starve prompt admission (can_allocate) or
         # peer decode groups (can_append_slot); also keep waiting work
         # from stalling behind long bursts.
-        free = (self.block_manager.gpu_allocator.get_num_free_blocks() -
+        free = (self.block_manager.get_num_free_gpu_blocks() -
                 self.block_manager.watermark_blocks)
         granted = 0
         for t in range(1, max_extra + 1):
@@ -858,11 +858,26 @@ class Scheduler:
                 self.block_manager.reserve_slots(seq, cap(seq, granted))
             for md in seq_group_metadata_list:
                 for seq_id in md.block_tables:
-                    md.block_tables[seq_id] = [
-                        b.block_number
-                        for b in self.block_manager.block_tables[seq_id]
-                    ]
+                    md.block_tables[seq_id] = \
+                        self.block_manager.block_numbers(seq_id)
         return granted
+
+    def prefix_pinned_pages(self) -> int:
+        """Pages pinned by the prefix cache (the gauge the /health
+        overload section and the bench's exact zero-leak accounting
+        read; pinned pages are held on purpose, not leaked)."""
+        return self.prefix_pool.pinned_pages()
+
+    def clear_prefixes(self) -> int:
+        """Drop every prefix pin and empty the pool, routing the
+        pinned pages through the block manager's free seam. Returns
+        the number of pages released. Run by `reincarnate()` on the
+        torn-down scheduler so a rebuilt pool can never resurrect
+        stale pins (and so the old pool's accounting ends exact)."""
+        released = 0
+        for prefix in self.prefix_pool.clear():
+            released += self.block_manager.free_prefix(prefix)
+        return released
 
     def fork_seq(self, parent_seq: Sequence, child_seq: Sequence) -> None:
         self.block_manager.fork(parent_seq, child_seq)

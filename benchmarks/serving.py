@@ -314,6 +314,16 @@ async def run(args) -> dict:
 
     block_manager = engine.engine.scheduler.block_manager
     free0 = block_manager.get_num_free_gpu_blocks()
+    # Exact zero-leak accounting: prefix pins are pages held ON
+    # PURPOSE, so the leak check subtracts the pin delta instead of
+    # fuzzing the invariant (pinned0 is normally 0 — warmup traffic
+    # carries no prefix_pos — but measured traffic may pin).
+    pinned0 = engine.engine.scheduler.prefix_pinned_pages()
+
+    def kv_leak(free_now: int, pinned_now: int) -> int:
+        """free0 == free_now + newly-pinned pages, else pages leaked
+        (positive) or double-freed (negative)."""
+        return free0 - free_now - (pinned_now - pinned0)
     if chaos_kill and kill_fault != "none":
         # Armed AFTER warmup so the FATAL fires mid-measurement, not
         # during the compile pass (count=1 spends the rule wherever it
@@ -373,7 +383,10 @@ async def run(args) -> dict:
             "admitted_ttft_p99": round(pct(ttfts, 99), 4),
             "free_pages_before": free0,
             "free_pages_after": free_end,
-            "kv_leak_pages": free0 - free_end,
+            "prefix_pinned_pages": engine.engine.scheduler.
+            prefix_pinned_pages(),
+            "kv_leak_pages": kv_leak(
+                free_end, engine.engine.scheduler.prefix_pinned_pages()),
             "sheds_total": admission.sheds_total,
             "expired_total": admission.expired_total,
             "ewma_prefill_tok_s": round(
@@ -420,7 +433,11 @@ async def run(args) -> dict:
             "requests_unaccounted": args.num_requests - accounted,
             "free_pages_before": free0,
             "free_pages_after": bm_now.get_num_free_gpu_blocks(),
-            "kv_leak_pages": free0 - bm_now.get_num_free_gpu_blocks(),
+            "prefix_pinned_pages": engine.engine.scheduler.
+            prefix_pinned_pages(),
+            "kv_leak_pages": kv_leak(
+                bm_now.get_num_free_gpu_blocks(),
+                engine.engine.scheduler.prefix_pinned_pages()),
             "faults_fired": faultinject.stats(),
         }
 

@@ -1,0 +1,15 @@
+"""Seeded LEAK002 violation (clobber form): the sliding-window reuse
+bug shape — `ref_count = n` applied to a block that is REUSED on one
+path (aliased out of the table, possibly prefix-pinned or shared),
+overwriting whatever count it carried.
+"""
+
+
+def allocate_window(pool, table, window, n, num_seqs):
+    for idx in range(n):
+        if idx >= window:
+            block = table[idx % window]    # reused: carries refs
+        else:
+            block = pool.allocate()
+        block.ref_count = num_seqs         # clobbers the reused block
+        table.append(block)

@@ -1,0 +1,46 @@
+"""Clean-construct precision fixture: the owner module's CoW
+`append_slot` (free-then-read-number) and `swap_in`-style mapping
+idioms produce ZERO LEAK findings — precision for the exact shapes
+`block_manager.py` relies on.
+"""
+
+
+class MiniManager:
+
+    def __init__(self, pool, host_pool):
+        self.pool = pool
+        self.host_pool = host_pool
+        self.block_tables = {}
+
+    def append_slot(self, seq_id):
+        block_table = self.block_tables[seq_id]
+        last_block = block_table[-1]
+        if last_block.ref_count == 1:
+            return None
+        new_block = self.pool.allocate()
+        block_table[-1] = new_block
+        self.pool.free(last_block)
+        return last_block.block_number, new_block.block_number
+
+    def swap_in(self, seq_id):
+        mapping = {}
+        new_block_table = []
+        for host_block in self.block_tables[seq_id]:
+            if host_block in mapping:
+                hbm_block = mapping[host_block]
+                hbm_block.ref_count += 1
+            else:
+                hbm_block = self.pool.allocate()
+                mapping[host_block] = hbm_block
+            new_block_table.append(hbm_block)
+            self.host_pool.free(host_block)
+        self.block_tables[seq_id] = new_block_table
+        return {src.block_number: dst.block_number
+                for src, dst in mapping.items()}
+
+    def free(self, seq_id):
+        self._free_block_table(self.block_tables.pop(seq_id))
+
+    def _free_block_table(self, table):
+        for block in set(table):
+            self.pool.free(block)

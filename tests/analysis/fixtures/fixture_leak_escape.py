@@ -1,0 +1,21 @@
+"""Seeded LEAK001 violation: a pool allocation escaping on the
+exception edge — `validate` can raise between the allocate and the
+store, outside any try, losing the page. The clean variant stores the
+result in the same expression and must stay quiet.
+"""
+
+
+def validate(token):
+    if token < 0:
+        raise ValueError(token)
+
+
+def leaky_admit(pool, table, token):
+    block = pool.allocate()
+    validate(token)        # may raise: `block` is not stored yet
+    table.append(block)
+
+
+def clean_admit(pool, table, token):
+    validate(token)
+    table.append(pool.allocate())

@@ -1,0 +1,21 @@
+"""Seeded LEAK002 violation (pin form): a refcount increment whose
+destination container (`pin_table`, filled through the storing call)
+has NO statically-reachable free seam — the PrefixPool pin-forever
+class the in-tree `BlockSpaceManager.free_prefix` seam retires.
+"""
+
+
+class SharedPrefix:
+
+    def __init__(self):
+        self.pin_table = None
+
+    def set_pin_table(self, blocks):
+        self.pin_table = blocks.copy()
+
+
+def pin_forever(prefix, table, count):
+    shared = table[:count]
+    for block in shared:
+        block.ref_count += 1      # pinned, and nothing ever unpins
+    prefix.set_pin_table(shared)

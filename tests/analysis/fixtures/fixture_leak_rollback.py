@@ -1,0 +1,22 @@
+"""Seeded LEAK004 violation: a state-removal seam (the crash-rollback
+shape) popping a block table WITHOUT routing it through a free seam.
+The routed variant (`pop` fed straight into the free helper — the real
+`BlockSpaceManager.free` shape) must stay quiet.
+"""
+
+
+class CrashyScheduler:
+
+    def __init__(self, pool):
+        self.pool = pool
+        self.block_tables = {}
+
+    def crash_rollback(self, seq_id):
+        self.block_tables.pop(seq_id)      # pages dropped un-freed
+
+    def clean_rollback(self, seq_id):
+        self._free_block_table(self.block_tables.pop(seq_id))
+
+    def _free_block_table(self, table):
+        for block in set(table):
+            self.pool.free(block)

@@ -1,0 +1,14 @@
+"""Seeded LEAK003 violation: double free of a freed block name. The
+read of `.block_number` between the two frees is the recognized-clean
+append_slot CoW idiom and must NOT be what fires.
+"""
+
+
+def cow_replace(pool, table):
+    old = table[-1]
+    fresh = pool.allocate()
+    table[-1] = fresh
+    pool.free(old)
+    src = old.block_number     # clean: read-number-after-free
+    pool.free(old)             # double free
+    return src, fresh.block_number

@@ -1,0 +1,13 @@
+"""Seeded OWN002 violation: raw block objects read out of a block
+manager's `block_tables` from non-owner code. The clean variant uses
+the owner's int-only projection and must stay quiet.
+"""
+
+
+def snapshot_tables(runner, seq_id):
+    table = runner.block_manager.block_tables[seq_id]   # raw blocks
+    return [b.block_number for b in table]
+
+
+def clean_snapshot(runner, seq_id):
+    return runner.block_manager.block_numbers(seq_id)

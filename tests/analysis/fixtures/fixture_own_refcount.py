@@ -1,0 +1,13 @@
+"""Seeded OWN001 violation: the ownership surface (`ref_count`)
+mutated outside the owner modules. The pragma'd variant registers its
+reason and must stay quiet.
+"""
+
+
+def steal_page(block):
+    block.ref_count += 1       # non-owner mutation of the surface
+
+
+def documented_steal(block):
+    # owner-ok: seeded fixture exercising the registered-reason path
+    block.ref_count += 1

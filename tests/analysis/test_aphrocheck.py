@@ -2,8 +2,9 @@
 
 Three layers:
 
-1. THE GATE: every pass (all 15 families, the ROOF/FOLD perf rules
-   and the ASYNC/RACE concurrency rules included) over the real tree
+1. THE GATE: every pass (all 17 families, the ROOF/FOLD perf rules,
+   the ASYNC/RACE concurrency rules, and the LEAK/OWN page-ownership
+   rules included) over the real tree
    (`aphrodite_tpu/`, `bench.py`, `benchmarks/`) must produce zero
    findings even with NO allowlist,
    the checked-in allowlist must hold at most 5 entries (currently
@@ -36,7 +37,8 @@ from tools.aphrocheck.core import (EVENT_LOOP, FLAGS_MODULE, REPO_ROOT,
 from tools.aphrocheck.passes import (async_pass, bound_pass,
                                      clock_pass, dma_pass, exc_pass,
                                      flag_pass, fold_pass, grid_pass,
-                                     race_pass, recomp_pass, ref_pass,
+                                     leak_pass, own_pass, race_pass,
+                                     recomp_pass, ref_pass,
                                      roofline_pass, shard_pass,
                                      sync_pass, vmem_pass)
 from tools.aphrocheck.registry import parse_registry
@@ -77,7 +79,7 @@ def test_repo_is_clean():
 
 
 def test_repo_clean_without_allowlist():
-    """The stronger form of the gate: all 15 pass families produce
+    """The stronger form of the gate: all 17 pass families produce
     ZERO findings with no allowlist at all — every real finding the
     new passes surfaced was fixed in-tree or registered in source
     (perf-known pragmas for the ROOF/FOLD motivating findings), so
@@ -127,6 +129,8 @@ def test_checker_never_imports_jax():
          "import tools.aphrocheck.registry; "
          "import tools.aphrocheck.passes.roofline_pass; "
          "import tools.aphrocheck.passes.fold_pass; "
+         "import tools.aphrocheck.passes.leak_pass; "
+         "import tools.aphrocheck.passes.own_pass; "
          "assert 'jax' not in sys.modules, 'checker imports jax'; "
          "assert 'numpy' not in sys.modules, 'checker imports numpy'"],
         cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
@@ -191,6 +195,13 @@ def test_scan_covers_benches():
     (race_pass.run, "fixture_race_twoworld.py", "RACE001"),
     (race_pass.run, "fixture_race_commit.py", "RACE002"),
     (race_pass.run, "fixture_race_global.py", "RACE003"),
+    (leak_pass.run, "fixture_leak_escape.py", "LEAK001"),
+    (leak_pass.run, "fixture_leak_clobber.py", "LEAK002"),
+    (leak_pass.run, "fixture_leak_pin.py", "LEAK002"),
+    (leak_pass.run, "fixture_leak_uaf.py", "LEAK003"),
+    (leak_pass.run, "fixture_leak_rollback.py", "LEAK004"),
+    (own_pass.run, "fixture_own_refcount.py", "OWN001"),
+    (own_pass.run, "fixture_own_escape.py", "OWN002"),
 ])
 def test_rule_fires_exactly_once(pass_fn, fixture, rule):
     findings = _pass_findings(pass_fn, [_fixture(fixture)])
@@ -650,6 +661,8 @@ def test_cli_rules_md_and_readme_drift():
                  "RECOMP003", "EXC001", "EXC002", "CLOCK001", "BP001",
                  "ASYNC001", "ASYNC002", "ASYNC003", "ASYNC004",
                  "RACE001", "RACE002", "RACE003",
+                 "LEAK001", "LEAK002", "LEAK003", "LEAK004",
+                 "OWN001", "OWN002",
                  "ROOF001", "ROOF002", "ROOF003", "ROOF004", "FOLD001",
                  "FOLD002"):
         assert f"| {rule} |" in table, f"{rule} missing from rules-md"

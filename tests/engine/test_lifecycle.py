@@ -214,6 +214,44 @@ def test_sync_reincarnate_restores_waiting_fcfs(tiny_model_dir,
     assert faulty == clean
 
 
+def test_reincarnate_clears_stale_prefix_pins(tiny_model_dir):
+    """reincarnate() routes the torn-down scheduler's prefix pins
+    through the free seam (`clear_prefixes`): the old pool's
+    accounting ends exact (free pages back to boot, pinned gauge 0),
+    the rebuilt pool starts pin-free, and the re-keyed prefix simply
+    recomputes — no stale pin can be resurrected."""
+    engine = _sync_engine(tiny_model_dir)
+    sp = SamplingParams(**SP)
+    free0 = engine.scheduler.block_manager.get_num_free_gpu_blocks()
+    engine.add_request("pfx", None, sp,
+                       prompt_token_ids=_prompt(0, n=40),
+                       prefix_pos=32)     # 2 pinned pages at bs=16
+    engine.step()                         # allocates + pins
+    assert engine.scheduler.prefix_pinned_pages() == 2
+
+    old_sched = engine.scheduler
+    outcome = engine.reincarnate()
+    assert outcome.restored == 1 and outcome.lost == []
+    assert old_sched.prefix_pinned_pages() == 0
+    assert old_sched.block_manager.get_num_free_gpu_blocks() == free0
+    assert engine.scheduler.prefix_pinned_pages() == 0
+    assert engine.scheduler.block_manager.\
+        get_num_free_gpu_blocks() == free0
+    (group,) = list(engine.scheduler.waiting)
+    assert group.request_id == "pfx"
+    assert group.prefix is not None and not group.prefix.allocated
+
+    while engine.has_unfinished_requests():
+        engine.step()
+    # the restored request re-pinned its recomputed prefix; releasing
+    # it lands the pool exactly at boot — the zero-leak invariant with
+    # pins accounted, not fuzzed
+    assert engine.scheduler.prefix_pinned_pages() == 2
+    assert engine.scheduler.clear_prefixes() == 2
+    assert engine.scheduler.block_manager.\
+        get_num_free_gpu_blocks() == free0
+
+
 def test_stale_step_cannot_commit_after_reincarnation(tiny_model_dir,
                                                       monkeypatch):
     """The epoch guard: a step that was in flight when reincarnate()

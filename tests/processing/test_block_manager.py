@@ -2,6 +2,7 @@
 import pytest
 
 from aphrodite_tpu.common.block import Device
+from aphrodite_tpu.common.prefix import Prefix, PrefixPool
 from aphrodite_tpu.common.sampling_params import SamplingParams
 from aphrodite_tpu.common.sequence import (Sequence, SequenceGroup,
                                            SequenceStatus)
@@ -13,7 +14,8 @@ BLOCK_SIZE = 4
 _seq_counter = iter(range(10_000))
 
 
-def make_group(prompt_len, num_seqs=1, request_id="0", best_of=None):
+def make_group(prompt_len, num_seqs=1, request_id="0", best_of=None,
+               prefix=None):
     seqs = [
         Sequence(next(_seq_counter), "x", list(range(prompt_len)), BLOCK_SIZE)
         for _ in range(num_seqs)
@@ -21,7 +23,8 @@ def make_group(prompt_len, num_seqs=1, request_id="0", best_of=None):
     params = SamplingParams(n=num_seqs,
                             best_of=best_of or num_seqs,
                             temperature=1.0)
-    return SequenceGroup(request_id, seqs, params, arrival_time=0.0)
+    return SequenceGroup(request_id, seqs, params, arrival_time=0.0,
+                         prefix=prefix)
 
 
 def test_pool_alloc_free():
@@ -132,6 +135,95 @@ def test_swap_roundtrip():
     assert mgr.get_num_free_cpu_blocks() == 10
     mgr.free(seq)
     assert mgr.get_num_free_gpu_blocks() == 10
+
+
+def test_sliding_window_reuse_does_not_clobber_prefix_pin():
+    """Regression (the LEAK002 clobber shape): when window reuse and
+    prefix sharing coincide, the reused in-window slot aliases a
+    PREFIX block — the old unconditional `ref_count = num_seqs`
+    overwrote the pin + sharers and a later free double-freed. The
+    reuse path must leave the count alone (each unique block already
+    carries one ref per owner)."""
+    mgr = BlockSpaceManager(BLOCK_SIZE, 10, 10, watermark=0,
+                            sliding_window=8)   # 2-block window
+    prefix = Prefix(list(range(BLOCK_SIZE)), BLOCK_SIZE)  # 1 block
+    g1 = make_group(20, request_id="g1", prefix=prefix)   # 5 blocks
+    mgr.allocate(g1)
+    assert prefix.allocated
+    prefix.computed = True
+    pinned = prefix.block_table[0]
+    # pin (1) + g1's share (1)
+    assert pinned.ref_count == 2
+
+    g2 = make_group(20, request_id="g2", prefix=prefix)
+    mgr.allocate(g2)
+    # pin + g1 + g2 — the window wrapping onto the prefix block must
+    # not have reset this to 1 (the old bug)
+    assert pinned.ref_count == 3
+
+    for g in (g1, g2):
+        for seq in g.get_seqs():
+            mgr.free(seq)
+    # only the pin holds one page now
+    assert pinned.ref_count == 1
+    assert mgr.get_num_free_gpu_blocks() == 9
+    # releasing the pin through the owner's free seam drains it fully
+    assert mgr.free_prefix(prefix) == 1
+    assert not prefix.allocated and not prefix.computed
+    assert mgr.get_num_free_gpu_blocks() == 10
+    # idempotent: a reset prefix releases nothing more
+    assert mgr.free_prefix(prefix) == 0
+
+
+def test_prefix_pool_accounting_and_clear():
+    """PrefixPool accounting: `pinned_pages()` tracks allocated
+    prefixes exactly, and `clear()` transfers ownership of the
+    entries so the pins can be routed through `free_prefix` (the
+    Scheduler.clear_prefixes / reincarnate wiring)."""
+    mgr = BlockSpaceManager(BLOCK_SIZE, 10, 10, watermark=0)
+    pool = PrefixPool(BLOCK_SIZE)
+    assert pool.pinned_pages() == 0
+    prefix = pool.intern(list(range(8)))        # 2 blocks
+    assert prefix is not None
+    assert pool.intern(list(range(8))) is prefix   # pooled, not dup
+    assert pool.pinned_pages() == 0             # not yet allocated
+    group = make_group(12, request_id="p", prefix=prefix)
+    mgr.allocate(group)
+    assert pool.pinned_pages() == 2
+    for seq in group.get_seqs():
+        mgr.free(seq)
+    # pinned pages survive their sequences — held on purpose
+    assert mgr.get_num_free_gpu_blocks() == 8
+    entries = pool.clear()
+    assert entries == [prefix] and pool.prefixes == {}
+    released = sum(mgr.free_prefix(p) for p in entries)
+    assert released == 2
+    assert mgr.get_num_free_gpu_blocks() == 10
+    assert pool.pinned_pages() == 0
+
+
+def test_block_numbers_projection():
+    """The owner's int-only projection matches get_block_table and
+    never hands out block objects."""
+    mgr = BlockSpaceManager(BLOCK_SIZE, 10, 10, watermark=0)
+    group = make_group(8, request_id="n")
+    mgr.allocate(group)
+    seq = group.get_seqs()[0]
+    nums = mgr.block_numbers(seq.seq_id)
+    assert nums == mgr.get_block_table(seq)
+    assert all(isinstance(n, int) for n in nums)
+
+
+def test_parity_aliases_still_work():
+    """The reference-spelling aliases (gpu_allocator/cpu_allocator,
+    PrefixPool.add_or_get_prefix) stay functional for parity
+    callers."""
+    mgr = BlockSpaceManager(BLOCK_SIZE, 4, 4, watermark=0)
+    assert mgr.gpu_allocator is mgr.hbm_pool
+    assert mgr.cpu_allocator is mgr.host_pool
+    pool = PrefixPool(BLOCK_SIZE)
+    assert pool.add_or_get_prefix(list(range(4))) is \
+        pool.intern(list(range(4)))
 
 
 def test_free_and_reset():

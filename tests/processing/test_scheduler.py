@@ -195,6 +195,32 @@ def test_full_prefix_hit_ctx_clamp_is_page_aligned():
     assert seq.data.num_computed_tokens == 8
 
 
+def test_prefix_pins_gauged_and_cleared():
+    """Prefix accounting at the scheduler seam: a schedule round that
+    pins a shared prefix shows up in `prefix_pinned_pages()`, the
+    pinned pages survive the sequences that created them (held on
+    purpose), and `clear_prefixes()` routes every pin through the
+    block manager's free seam — free pages return exactly to boot
+    (the reincarnate() wiring that keeps the torn-down pool's
+    accounting exact)."""
+    sched = make_scheduler(num_gpu_blocks=16)
+    free_boot = sched.block_manager.get_num_free_gpu_blocks()
+    group = make_group("P", prompt_len=12)
+    group.prefix = sched.prefix_pool.intern(list(range(8)))  # 2 pages
+    sched.add_seq_group(group)
+    sched.schedule()
+    assert sched.prefix_pinned_pages() == 2
+    sched.abort_seq_group("P")
+    # sequences gone, pins held
+    assert sched.block_manager.get_num_free_gpu_blocks() == \
+        free_boot - 2
+    released = sched.clear_prefixes()
+    assert released == 2
+    assert sched.prefix_pinned_pages() == 0
+    assert sched.block_manager.get_num_free_gpu_blocks() == free_boot
+    assert sched.prefix_pool.prefixes == {}
+
+
 def test_fcfs_order_preserved_after_preempt():
     sched = make_scheduler(num_gpu_blocks=4, max_paddings=1024)
     g1 = make_group("r1", prompt_len=7)

@@ -47,6 +47,18 @@ Rule families (see each pass module's docstring for the contract):
                  reason, off-loop scheduler commits bypassing the
                  reincarnation epoch guard, mutable module-level
                  state shared across the worlds
+  LEAK001-004    KV-page alloc/free pairing and refcount lifecycle
+                 (aphroleak): escaping allocate() results (exception
+                 edges included), unbalanced refcount increments /
+                 non-fresh `ref_count = n` clobbers, use-after-free
+                 of freed block names, and state-removal seams that
+                 bypass the free seams; `--ledger` emits the
+                 OWNERSHIP.json alloc-site -> free-seam baseline
+  OWN001-002     the enforced page-ownership boundary: mutations of
+                 `ref_count`/pool free lists/block tables outside
+                 the owner modules, and raw PhysicalTokenBlock
+                 objects escaping owner scope (only block_number
+                 ints may cross); `# owner-ok: <reason>` escape
 
 Name resolution is interprocedural: a same-package call graph
 (core.CallGraph) lets helper parameters resolve through their call
@@ -73,7 +85,7 @@ DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(
 
 _RULE_ORDER = ("PARSE", "FLAG", "VMEM", "DMA", "GRID", "SYNC", "REF",
                "SHARD", "RECOMP", "EXC", "BP", "ASYNC", "RACE",
-               "ROOF", "FOLD")
+               "LEAK", "OWN", "ROOF", "FOLD")
 
 
 @dataclasses.dataclass

@@ -44,11 +44,25 @@ Rule ID families:
                          bypass the reincarnation epoch guard, and
                          mutable module-level state shared across the
                          worlds
+- LEAK001..LEAK004   — KV-page alloc/free pairing and refcount
+                         lifecycle over the owner modules: escaping
+                         allocate() results (exception edges
+                         included), unbalanced refcount increments /
+                         non-fresh clobbers, use-after-free of freed
+                         block names, and state-removal seams that
+                         bypass the free seams
+- OWN001..OWN002     — the enforced page-ownership boundary: surface
+                         mutations (ref_count, pool free lists, block
+                         tables) outside the owner modules, and raw
+                         PhysicalTokenBlock objects escaping owner
+                         scope (only block_number ints may cross)
 """
+
 from tools.aphrocheck.passes import (async_pass, bound_pass,
                                      clock_pass, dma_pass, exc_pass,
                                      flag_pass, fold_pass, grid_pass,
-                                     race_pass, recomp_pass, ref_pass,
+                                     leak_pass, own_pass, race_pass,
+                                     recomp_pass, ref_pass,
                                      roofline_pass, shard_pass,
                                      sync_pass, vmem_pass)
 
@@ -66,6 +80,8 @@ ALL_PASSES = (
     ("BP", bound_pass.run),
     ("ASYNC", async_pass.run),
     ("RACE", race_pass.run),
+    ("LEAK", leak_pass.run),
+    ("OWN", own_pass.run),
     ("ROOF", roofline_pass.run),
     ("FOLD", fold_pass.run),
 )

@@ -211,6 +211,10 @@ def _top_level_kernel_fns(module: Module) -> List[ast.AST]:
     cached = getattr(module, "_dma_kernel_fns", None)
     if cached is not None:
         return cached
+    if "make_async_copy" not in module.text:
+        # text prefilter: no async copies, no DMA kernels to walk
+        module._dma_kernel_fns = []
+        return []
     out = []
     for node in module.tree.body:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -326,8 +330,11 @@ def run(ctx) -> List[Finding]:
             kernel = _Kernel(module, fn)
             _check_start_wait(module, kernel, findings)
             _check_moduli(module, kernel, findings)
-        _check_sem_lengths(module, findings,
-                           getattr(ctx, "call_graph", None))
+        # text prefilter: DMA semaphores only exist at pallas_call
+        # sites
+        if "pallas_call" in module.text:
+            _check_sem_lengths(module, findings,
+                               getattr(ctx, "call_graph", None))
     return findings
 
 

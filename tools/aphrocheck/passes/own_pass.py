@@ -1,0 +1,321 @@
+"""OWN pass: the enforced KV-page ownership boundary.
+
+`processing/block_manager.py` (with `common/block.py` and
+`common/prefix.py`) is the page OWNER: every future cache kind the
+ROADMAP's cache-kind registry opens (paged / sliding-ring / O(1)
+recurrent state) must implement the same boundary, so the boundary has
+to be machine-enforced BEFORE the refactor, not reviewed after. Two
+rules over every non-owner scanned module:
+
+- OWN001: any MUTATION of the ownership surface outside the owner
+  modules — writing `.ref_count`, touching a pool's `._free` list, or
+  mutating a block manager's `block_tables` map (subscript store,
+  `.pop`/`.clear`/`.update`, `del`, rebind) — without a reasoned
+  `# owner-ok: <reason>` pragma.
+- OWN002: raw `PhysicalTokenBlock` objects escaping owner scope: a
+  non-owner module calling a pool's `.allocate()` or reaching into a
+  block manager's `block_tables` values (subscript read, iteration,
+  `.values()`/`.items()`). Only `block_number` ints may cross into
+  executor/metadata — use the owner's projections
+  (`get_block_table`, `block_numbers`, the swap mappings). A bare
+  truthiness/len read of the map (the bench's drain-to-idle check)
+  stays clean: no block object escapes.
+
+This module also renders the `--ledger` surface: OWNERSHIP.json maps
+every alloc site to the owned containers its pages land in and the
+statically-reachable free seams that drain each container (built on
+leak_pass's ownership model; line numbers excluded so pure code motion
+does not drift the baseline). Tier-1 byte-equality-gates the checked-in
+file, so a new seam that forgets its free path fails the build — the
+static twin of the chaos harnesses' `kv_leak_pages == 0`.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from tools.aphrocheck.core import (Finding, Module, call_tail,
+                                   dotted_name, has_pragma)
+from tools.aphrocheck.passes.leak_pass import (OWNED_TABLES,
+                                               OWNER_MODULES,
+                                               POOL_NAMES, _fns,
+                                               _is_alloc_call,
+                                               _qualname, _recv_tail,
+                                               ownership_model)
+
+_PRAGMA = "owner-ok:"
+
+#: Mutating method tails on the owner dict.
+_DICT_MUTATORS = {"pop", "popitem", "clear", "update", "setdefault"}
+
+
+def _is_owner(rel: str) -> bool:
+    return rel.replace("\\", "/") in OWNER_MODULES
+
+
+def _chain(node: ast.AST) -> List[str]:
+    name = dotted_name(node)
+    return name.split(".") if name else []
+
+
+def _is_manager_tables(expr: ast.AST) -> bool:
+    """True for `<...>.block_manager.block_tables` — the owner dict
+    reached through a block manager, as opposed to the int-list
+    metadata maps (`md.block_tables`)."""
+    if not (isinstance(expr, ast.Attribute) and
+            expr.attr == "block_tables"):
+        return False
+    chain = _chain(expr)
+    return len(chain) >= 2 and chain[-2] == "block_manager"
+
+
+def _own001(module: Module) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def flag(node: ast.AST, what: str) -> None:
+        if has_pragma(module, node.lineno, _PRAGMA):
+            return
+        findings.append(module.finding(
+            "OWN001", node,
+            f"{what} outside the owner modules "
+            "(processing/block_manager.py) — route the mutation "
+            "through the owner API, or register the reason with "
+            "`# owner-ok: <reason>`"))
+
+    for node in module.nodes:
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for tgt in targets:
+                base = tgt.value if isinstance(tgt, ast.Subscript) \
+                    else tgt
+                if isinstance(base, ast.Attribute) and \
+                        base.attr == "ref_count":
+                    flag(node, "`.ref_count` is mutated")
+                elif isinstance(base, ast.Attribute) and \
+                        base.attr == "_free":
+                    flag(node, "a pool's `._free` list is rebound")
+                elif isinstance(tgt, ast.Subscript) and \
+                        _is_manager_tables(tgt.value):
+                    flag(node, "a block manager's `block_tables` map "
+                                "is written")
+        elif isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                base = tgt.value if isinstance(tgt, ast.Subscript) \
+                    else tgt
+                if isinstance(base, ast.Attribute) and \
+                        base.attr in ("_free", "ref_count"):
+                    flag(node, f"`.{base.attr}` is deleted")
+                elif isinstance(tgt, ast.Subscript) and \
+                        _is_manager_tables(tgt.value):
+                    flag(node, "a block manager's `block_tables` "
+                                "entry is deleted")
+        elif isinstance(node, ast.Call):
+            t = call_tail(node)
+            if not isinstance(node.func, ast.Attribute):
+                continue
+            recv = node.func.value
+            if isinstance(recv, ast.Attribute) and \
+                    recv.attr == "_free":
+                flag(node, "a pool's `._free` list is mutated")
+            elif t in _DICT_MUTATORS and _is_manager_tables(recv):
+                flag(node, "a block manager's `block_tables` map is "
+                            "mutated")
+    return findings
+
+
+def _own002(module: Module) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def flag(node: ast.AST, what: str) -> None:
+        if has_pragma(module, node.lineno, _PRAGMA):
+            return
+        findings.append(module.finding(
+            "OWN002", node,
+            f"{what} — raw PhysicalTokenBlock objects must not escape "
+            "the owner modules; only `block_number` ints may cross "
+            "(use get_block_table()/block_numbers()/the swap "
+            "mappings), or register the reason with "
+            "`# owner-ok: <reason>`"))
+
+    for node in module.nodes:
+        if not isinstance(node, (ast.Call, ast.Subscript, ast.For)):
+            continue
+        if isinstance(node, ast.Call):
+            if _is_alloc_call(node) and \
+                    _recv_tail(node) in POOL_NAMES:
+                flag(node, "a page pool's `.allocate()` is called")
+                continue
+            t = call_tail(node)
+            if t in ("values", "items") and \
+                    isinstance(node.func, ast.Attribute) and \
+                    _is_manager_tables(node.func.value):
+                flag(node, "block-table objects are iterated out of a "
+                            "block manager")
+        elif isinstance(node, ast.Subscript):
+            if isinstance(node.ctx, ast.Load) and \
+                    _is_manager_tables(node.value):
+                flag(node, "a block table is read out of a block "
+                            "manager")
+        elif isinstance(node, ast.For):
+            if _is_manager_tables(node.iter):
+                flag(node, "a block manager's `block_tables` is "
+                            "iterated")
+    return findings
+
+
+def run(ctx) -> List[Finding]:
+    # Every non-owner module in the context is checked: the scanned
+    # tree on full sweeps, explicitly-passed fixtures on subset scans.
+    findings: List[Finding] = []
+    for module in ctx.modules:
+        if _is_owner(module.rel):
+            continue
+        # text prefilter: a module that never names the ownership
+        # surface cannot violate it
+        if not ("ref_count" in module.text or "_free" in module.text
+                or "block_tables" in module.text
+                or "allocate" in module.text):
+            continue
+        findings.extend(_own001(module))
+        findings.extend(_own002(module))
+    return findings
+
+
+# ------------------------------------------------------------------
+# the --ledger surface (OWNERSHIP.json)
+# ------------------------------------------------------------------
+
+def report_payload(ctx) -> dict:
+    """The OWNERSHIP.json schema: alloc sites -> owned containers ->
+    statically-reachable free seams, plus the refcount and removal
+    seams. Line numbers are excluded on purpose: pure code motion
+    must not drift the baseline, only ownership-structure changes."""
+    model = ownership_model(ctx)
+    reachable_only = bool(getattr(ctx, "full_scan", False))
+    alloc_sites: Dict[str, dict] = {}
+    refcount_seams: Dict[str, dict] = {}
+    removal_seams: Dict[str, dict] = {}
+
+    from tools.aphrocheck.passes import leak_pass
+
+    for module in ctx.modules:
+        rel = module.rel.replace("\\", "/")
+        if not _is_owner(rel):
+            continue
+        for fn in _fns(module):
+            where = f"{rel}::{_qualname(module, fn)}"
+            pools = set()
+            containers = set()
+            increments = 0
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and _is_alloc_call(node):
+                    pools.add(_recv_tail(node) or "pool")
+                    parent = module.parents.get(node)
+                    name = None
+                    if isinstance(parent, ast.Assign):
+                        names = [t.id for t in parent.targets
+                                 if isinstance(t, ast.Name)]
+                        name = names[0] if names else None
+                    elif isinstance(parent, ast.Call) and \
+                            isinstance(parent.func, ast.Attribute):
+                        recv = parent.func.value
+                        key = leak_pass._container_key(recv)
+                        if key is None and isinstance(recv, ast.Name):
+                            containers |= \
+                                leak_pass._local_container_keys(
+                                    module, fn, recv.id, model.storing)
+                        elif key is not None:
+                            containers.add(key)
+                    if name is not None:
+                        containers |= leak_pass._block_destinations(
+                            module, fn, name, model.storing)
+                elif isinstance(node, ast.AugAssign) and \
+                        isinstance(node.op, ast.Add):
+                    recv = leak_pass._refcount_target(node.target)
+                    if recv is not None and recv.id != "self":
+                        increments += 1
+                        containers |= leak_pass._block_destinations(
+                            module, fn, recv.id, model.storing,
+                            anchor=node)
+            if pools:
+                alloc_sites[where] = {
+                    "pools": sorted(pools),
+                    "containers": sorted(containers),
+                    "free_seams": sorted({
+                        seam for key in (containers or {""})
+                        for seam in model.seams_for(key,
+                                                    reachable_only)}),
+                }
+            if increments:
+                refcount_seams[where] = {
+                    "increments": increments,
+                    "containers": sorted(containers),
+                    "free_seams": sorted({
+                        seam for key in containers
+                        for seam in model.seams_for(key,
+                                                    reachable_only)}),
+                }
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and \
+                        call_tail(node) in ("pop", "clear") and \
+                        isinstance(node.func, ast.Attribute):
+                    key = leak_pass._container_key(node.func.value)
+                    if key in OWNED_TABLES:
+                        removal_seams[where] = {
+                            "table": key,
+                            "op": call_tail(node),
+                        }
+    return {
+        "owner_modules": list(OWNER_MODULES),
+        "alloc_sites": {k: alloc_sites[k] for k in sorted(alloc_sites)},
+        "refcount_seams": {k: refcount_seams[k]
+                           for k in sorted(refcount_seams)},
+        "removal_seams": {k: removal_seams[k]
+                          for k in sorted(removal_seams)},
+        "free_seams": {
+            key: model.seams_for(key, reachable_only)
+            for key in sorted({s.key for s in model.seams})
+        },
+    }
+
+
+def render_report(ctx) -> str:
+    payload = report_payload(ctx)
+    lines = ["OWNERSHIP ledger — alloc sites -> containers -> "
+             "statically-reachable free seams", ""]
+    for where, rec in payload["alloc_sites"].items():
+        lines.append(f"{where}")
+        lines.append(f"  pools:      {', '.join(rec['pools'])}")
+        lines.append(f"  containers: "
+                     f"{', '.join(rec['containers']) or '(none)'}")
+        for seam in rec["free_seams"]:
+            lines.append(f"  freed by:   {seam}")
+        lines.append("")
+    lines.append("refcount seams:")
+    for where, rec in payload["refcount_seams"].items():
+        seams = ", ".join(rec["free_seams"]) or "NONE"
+        lines.append(f"  {where}: +{rec['increments']} into "
+                     f"{', '.join(rec['containers']) or '?'} "
+                     f"(freed by {seams})")
+    lines.append("")
+    lines.append("removal seams:")
+    for where, rec in payload["removal_seams"].items():
+        lines.append(f"  {where}: {rec['table']}.{rec['op']}()")
+    return "\n".join(lines)
+
+
+#: (rule, one-line contract, example) — rendered by `--rules-md`.
+RULES = (
+    ("OWN001", "mutation of the ownership surface (`.ref_count`, a "
+     "pool's `._free`, a block manager's `block_tables` map) outside "
+     "the owner modules without a `# owner-ok: <reason>` pragma — "
+     "the boundary every future cache kind must implement",
+     "`seq.ref_count += 1` in an executor helper"),
+    ("OWN002", "raw PhysicalTokenBlock objects escaping owner scope: "
+     "non-owner code calling a pool's `.allocate()` or reading/"
+     "iterating a block manager's `block_tables` values — only "
+     "`block_number` ints may cross into executor/metadata",
+     "`mgr.block_tables[seq_id]` read from the scheduler instead of "
+     "`block_numbers(seq_id)`"),
+)

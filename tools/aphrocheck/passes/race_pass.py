@@ -200,6 +200,9 @@ def _epoch_compare_fns(ctx) -> Set[str]:
     guard carriers RACE002 recognizes (directly or one call away)."""
     out: Set[str] = set()
     for module in ctx.modules:
+        if "epoch" not in module.text:
+            # text prefilter: no epoch mentions, no guard carriers
+            continue
         for name, defs in _defs_of(module).items():
             for fn in defs:
                 if _has_epoch_compare(fn):
