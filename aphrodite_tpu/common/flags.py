@@ -201,6 +201,13 @@ _register(Flag(
     "padded (batch, head-block) grid for A/B runs."))
 
 _register(Flag(
+    "APHRODITE_ATTN_AMLA", "bool", True,
+    "AMLA mul-by-add online-softmax rescale in the decode-attention "
+    "kernels (base-2 scores, integer running max, exponent-bias adds "
+    "on the accumulator; arxiv 2509.25224); 0 pins the classic "
+    "per-chunk rescale multiply for A/B runs."))
+
+_register(Flag(
     "APHRODITE_W4A8", "bool", False,
     "GPTQ/AWQ int8-activation MXU path (weights stay int4 at rest; "
     "per-row activation rounding is the only approximation). The "

@@ -327,19 +327,21 @@ class QuipLinearMethod(LinearMethod):
         xr = x.reshape(-1, in_features) * params["SU"][None, :]
         xr = matmul_hadU(xr.astype(jnp.float32), had_l, k_l, q_in,
                          transpose=True)
-        # Wscale stays a traced multiply — float(tracer) would fail
-        # under jit.
-        # perf-known: FOLD001 the Wscale multiply + cast feed the LUT
-        # kernel straight from HBM; folding it into the kernel's x
-        # prologue would drop one activation round trip (QuiP is not
-        # a headline path — fold when the kernel is next touched).
-        xr = xr * params["Wscale"].astype(jnp.float32)
+        # Wscale is a SCALAR that commutes through the linear chain:
+        # instead of one full-activation multiply+cast pass feeding
+        # the kernel from HBM (the retired FOLD001 finding), it folds
+        # into the weight-side constants — the [q_out, 16] lookup
+        # table / the [q_out] int8 scale row — which the kernels read
+        # per tile anyway. (It stays a traced multiply on the tiny
+        # operand — float(tracer) would fail under jit; the param is
+        # declared f32 in create_weights, so no cast is needed.)
+        ws = params["Wscale"]
         if "qweight" in params:
             # 4-bit LUT codes at rest (see create_weights).
             from aphrodite_tpu.ops.pallas.quant_matmul import (
                 squeezellm_matmul, squeezellm_supported)
             qw = params["qweight"]
-            lut = params["lookup_table"]
+            lut = params["lookup_table"] * ws
             if jax.default_backend() == "tpu" and \
                     squeezellm_supported(q_in, q_out):
                 # x stays f32 (the kernel dots in x's dtype): the int8
@@ -363,11 +365,11 @@ class QuipLinearMethod(LinearMethod):
             if jax.default_backend() == "tpu" and \
                     int8_supported(q_in, q_out):
                 out = int8_matmul(
-                    xr, w, jnp.full((q_out,), 0.25, jnp.float32))
+                    xr, w, jnp.full((q_out,), 0.25, jnp.float32) * ws)
             else:
-                out = xr @ (w.astype(jnp.float32) * 0.25)
+                out = xr @ (w.astype(jnp.float32) * (0.25 * ws))
         else:
-            out = xr @ w.astype(jnp.float32)      # [m, q_out]
+            out = (xr * ws) @ w.astype(jnp.float32)   # [m, q_out]
         out = matmul_hadU(out, had_r, k_r, q_out)[..., :out_features]
         out = out * params["SV"][None, :].astype(jnp.float32)
         out = out.astype(x.dtype).reshape(*lead, out_features)

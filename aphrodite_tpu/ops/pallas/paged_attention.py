@@ -68,6 +68,17 @@ cache is zero-initialized, so garbage pages never produce NaNs.
 int8/fp8 KV pages dequant in-kernel: the scale folds into the score
 scale (q·k·S == (q·S)·k) and the output epilogue; fused writes
 quantize the injected token into stored units first.
+
+AMLA rescale (round 7, arxiv 2509.25224 — the FOLD002 closure):
+scores ride the base-2 domain (log2(e) folds into the static q scale)
+and the online-softmax running max quantizes UP to an integer, so the
+per-chunk correction 2^(m_prev - m_new) is an exact power of two. The
+default path applies it to the l and [rows, d] accumulator planes as
+an exponent-bias ADD (`_mul_pow2`: bitcast, integer add, bitcast) —
+the per-chunk VPU multiplies FOLD002 flagged are gone. The classic
+multiply survives only as the APHRODITE_ATTN_AMLA=0 A/B arm; the two
+are bit-identical away from underflow (the correction is an exact
+power of two either way).
 """
 from __future__ import annotations
 
@@ -83,6 +94,36 @@ from jax.experimental.pallas import tpu as pltpu
 from aphrodite_tpu.common import flags
 
 _NEG_INF = -2.0**30  # large-but-finite: avoids inf-inf NaNs in corrections
+
+#: log2(e): scores ride the BASE-2 domain (folded into the static q
+#: scale), so the online-softmax weights are exp2 and the running max
+#: quantizes to an integer — the AMLA precondition (arxiv 2509.25224).
+_LOG2E = 1.4426950408889634
+
+
+def amla_enabled() -> bool:
+    """APHRODITE_ATTN_AMLA=0 pins the classic online-softmax rescale
+    multiply (the A/B fallback); default on — the rescale runs as
+    exponent-bias adds (see _mul_pow2)."""
+    return flags.get_bool("APHRODITE_ATTN_AMLA")
+
+
+def _mul_pow2(x, delta):
+    """x * 2^delta as an exponent-bias ADD — AMLA's mul-by-add rescale
+    (arxiv 2509.25224): bitcast f32 -> int32, add delta << 23, bitcast
+    back. `delta` is integer-valued f32 <= 0 (the online-softmax
+    running max never decreases). Entries whose biased exponent would
+    underflow — including x == 0 and denormals — map to exactly 0.0,
+    which is what the multiply rounds to on TPU (denormals flush);
+    finite normals cannot overflow since delta <= 0. Assumes finite x
+    (the accumulators are bounded by construction: p <= 1 and chunks
+    are <= 512 tokens)."""
+    bits = jax.lax.bitcast_convert_type(x, jnp.int32)
+    d = jnp.maximum(delta, -254.0).astype(jnp.int32)
+    shifted = jax.lax.bitcast_convert_type(bits + (d << 23),
+                                           jnp.float32)
+    exp_field = jax.lax.shift_right_logical(bits, 23) & 0xFF
+    return jnp.where(exp_field + d > 0, shifted, 0.0)
 
 # Fused-write writeback ring depth: write n reuses slot n % _WB_SLOTS and
 # waits write n-_WB_SLOTS's DMA, so deeper rings hide more write latency.
@@ -228,6 +269,7 @@ def _decode_kernel_tm(
     has_alibi: bool = False,
     single_chunk: bool = False,
     fused_write: bool = False,
+    amla: bool = True,
 ):
     refs = list(refs)
     q_ref, k_hbm, v_hbm = refs[:3]
@@ -292,7 +334,10 @@ def _decode_kernel_tm(
     # kv head hh = r // group of this cell's block) carries q in lanes
     # [hh*d, (hh+1)*d) and zeros elsewhere, so the single
     # [rows, hb*d] x [hb*d, chunk] dot yields exact per-head scores.
-    q = q_ref[0, 0].astype(jnp.float32) * (scale * kv_scale)  # [rows, d]
+    # log2(e) folds into the static scale: scores land in the BASE-2
+    # domain the AMLA rescale needs (an exact-power-of-two correction).
+    q = q_ref[0, 0].astype(jnp.float32) * \
+        (scale * kv_scale * _LOG2E)                  # [rows, d]
     q_rep = jax.lax.concatenate([q] * hb, 1)                  # [rows, hb*d]
     lane_head = jax.lax.broadcasted_iota(
         jnp.int32, (rows, hb * d), 1) // d
@@ -428,23 +473,31 @@ def _decode_kernel_tm(
             jnp.int32, s.shape, 1)
         if slopes_ref is not None:
             # ALiBi bias grows with kv absolute position (reference
-            # make_alibi_bias, layers/attention.py:196).
-            s = s + slopes_ref[0, :, :1] * pos.astype(jnp.float32)
+            # make_alibi_bias, layers/attention.py:196); scores are
+            # base-2, so the slopes carry the log2(e) factor too.
+            s = s + (slopes_ref[0, :, :1] * _LOG2E) * \
+                pos.astype(jnp.float32)
         live = pos < ctx
         s = jnp.where(live, s, _NEG_INF)
 
         m_prev = m_scr[:, :1]                        # [rows, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        corr = jnp.exp(m_prev - m_new)
-        p_exp = jnp.where(live, jnp.exp(s - m_new), 0.0)
+        # The running max quantizes UP to an integer, so the chunk
+        # correction 2^(m_prev - m_new) is an exact power of two:
+        # applied as an exponent-bias ADD (amla, the default) or as
+        # the classic VPU multiply (the pinned A/B arm) — bit-equal
+        # away from underflow.
+        m_new = jnp.maximum(m_prev, jnp.ceil(m_cur))
+        delta = m_prev - m_new                       # integer, <= 0
+        p_exp = jnp.where(live, jnp.exp2(s - m_new), 0.0)
         l_prev = l_scr[:, :1]
-        # perf-known: FOLD002 the online-softmax rescale multiplies
-        # (l and the [rows, d] accumulator below) are the VPU work
-        # AMLA's mul-by-add rewrite (arxiv 2509.25224) eliminates —
-        # ROADMAP item 2's attention follow-up, targets pre-identified
-        # here by the linter.
-        l_new = l_prev * corr + jnp.sum(p_exp, axis=1, keepdims=True)
+        if amla:
+            l_new = _mul_pow2(l_prev, delta) + \
+                jnp.sum(p_exp, axis=1, keepdims=True)
+        else:
+            corr = jnp.exp2(delta)
+            l_new = l_prev * corr + jnp.sum(p_exp, axis=1,
+                                            keepdims=True)
 
         v = v_buf[slot]                              # [chunk, hb*d]
         if v.dtype != jnp.bfloat16:                  # int8/fp8 KV dequant
@@ -459,7 +512,10 @@ def _decode_kernel_tm(
         for h in range(hb):
             pv_sel = pv_sel + jnp.where(rh == h,
                                         pv[:, h * d:(h + 1) * d], 0.0)
-        acc_scr[...] = acc_scr[...] * corr + pv_sel
+        if amla:
+            acc_scr[...] = _mul_pow2(acc_scr[...], delta) + pv_sel
+        else:
+            acc_scr[...] = acc_scr[...] * corr + pv_sel
         m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
 
@@ -526,6 +582,7 @@ def _decode_kernel_ragged(
     chunk_slots: int,
     has_alibi: bool = False,
     fused_write: bool = False,
+    amla: bool = True,
 ):
     refs = list(refs)
     q_ref, k_hbm, v_hbm = refs[:3]
@@ -608,8 +665,10 @@ def _decode_kernel_ragged(
         nc = cell + pf_depth
         start_cell(nc, nc // nw, jax.lax.rem(nc, nw))
 
-    # Block-diagonal q packing (see _decode_kernel_tm).
-    q = q_ref[0, 0].astype(jnp.float32) * (scale * kv_scale)  # [rows, d]
+    # Block-diagonal q packing (see _decode_kernel_tm); log2(e) folds
+    # into the static scale — base-2 scores for the AMLA rescale.
+    q = q_ref[0, 0].astype(jnp.float32) * \
+        (scale * kv_scale * _LOG2E)                  # [rows, d]
     q_rep = jax.lax.concatenate([q] * hb, 1)                  # [rows, hb*d]
     lane_head = jax.lax.broadcasted_iota(
         jnp.int32, (rows, hb * d), 1) // d
@@ -701,20 +760,27 @@ def _decode_kernel_ragged(
         pos = c * chunk_tokens + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1)
         if slopes_ref is not None:
-            s = s + slopes_ref[0, :, :1] * pos.astype(jnp.float32)
+            s = s + (slopes_ref[0, :, :1] * _LOG2E) * \
+                pos.astype(jnp.float32)
         live = pos < ctx
         s = jnp.where(live, s, _NEG_INF)
 
         m_prev = m_scr[:, :1]                        # [rows, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        corr = jnp.exp(m_prev - m_new)
-        p_exp = jnp.where(live, jnp.exp(s - m_new), 0.0)
+        # Integer-quantized running max -> exact-power-of-two chunk
+        # correction: exponent-bias ADD (amla) or classic multiply
+        # (the pinned A/B arm). See _decode_kernel_tm.
+        m_new = jnp.maximum(m_prev, jnp.ceil(m_cur))
+        delta = m_prev - m_new                       # integer, <= 0
+        p_exp = jnp.where(live, jnp.exp2(s - m_new), 0.0)
         l_prev = l_scr[:, :1]
-        # perf-known: FOLD002 same AMLA mul-by-add candidate as the
-        # classic kernel (arxiv 2509.25224; ROADMAP item 2) — the
-        # ragged grid is where the rewrite will actually land.
-        l_new = l_prev * corr + jnp.sum(p_exp, axis=1, keepdims=True)
+        if amla:
+            l_new = _mul_pow2(l_prev, delta) + \
+                jnp.sum(p_exp, axis=1, keepdims=True)
+        else:
+            corr = jnp.exp2(delta)
+            l_new = l_prev * corr + jnp.sum(p_exp, axis=1,
+                                            keepdims=True)
 
         v = v_buf[slot]                              # [chunk, hb*d]
         if v.dtype != jnp.bfloat16:                  # int8/fp8 KV dequant
@@ -727,7 +793,10 @@ def _decode_kernel_ragged(
         for h in range(hb):
             pv_sel = pv_sel + jnp.where(rh == h,
                                         pv[:, h * d:(h + 1) * d], 0.0)
-        acc_scr[...] = acc_scr[...] * corr + pv_sel
+        if amla:
+            acc_scr[...] = _mul_pow2(acc_scr[...], delta) + pv_sel
+        else:
+            acc_scr[...] = acc_scr[...] * corr + pv_sel
         m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
 
@@ -779,11 +848,11 @@ def _ring_slots(pf_depth: int, chunk_tokens: int, lane_bytes: int) -> int:
 @functools.partial(
     jax.jit,
     static_argnames=("scale", "kv_scale", "pages_per_chunk", "pf_depth",
-                     "interpret"))
+                     "amla", "interpret"))
 def _paged_decode_impl(
     q, k_pages, v_pages, block_tables, context_lens, wi_seq, wi_chunk,
     alibi_slopes, knew, vnew, *, scale, kv_scale, pages_per_chunk,
-    pf_depth, interpret,
+    pf_depth, amla, interpret,
 ):
     batch, num_q_heads, head_dim = q.shape
     num_pages, page_size, hd = k_pages.shape
@@ -822,7 +891,8 @@ def _paged_decode_impl(
             pages_per_chunk=pages_per_chunk, page_size=page_size,
             scale=scale, kv_scale=kv_scale,
             pf_depth=min(pf_depth, n_slots - 2), chunk_slots=n_slots,
-            has_alibi=alibi_slopes is not None, fused_write=fused_write)
+            has_alibi=alibi_slopes is not None, fused_write=fused_write,
+            amla=amla)
         grid = (n_hb, nw)
 
         def qmap(j, w, tbl, cl, ws, wc):
@@ -845,7 +915,8 @@ def _paged_decode_impl(
             else pf_depth,
             chunk_slots=n_slots,
             has_alibi=alibi_slopes is not None,
-            single_chunk=single_chunk, fused_write=fused_write)
+            single_chunk=single_chunk, fused_write=fused_write,
+            amla=amla)
         grid = (batch, n_hb)
 
         def qmap(b, j, *_):
@@ -959,6 +1030,7 @@ def paged_decode_attention(
     kv_scale: float = 1.0,
     pages_per_chunk: int = 8,
     work_items=None,          # (wi_seq [NW+1], wi_chunk [NW]) int32
+    amla=None,                # pin the rescale variant (A/B hook)
     interpret: bool = False,
 ):
     """Token-major flash-decoding attention (see module docstring).
@@ -977,7 +1049,11 @@ def paged_decode_attention(
 
     pages_per_chunk is clamped DOWN to the largest divisor of the
     table width, so callers need not pre-pad block tables to a chunk
-    multiple."""
+    multiple.
+
+    `amla` pins the online-softmax rescale variant: True = AMLA
+    exponent-bias adds, False = the classic per-chunk multiply (A/B);
+    None reads APHRODITE_ATTN_AMLA (default on)."""
     batch, num_q_heads, head_dim = q.shape
     num_pages, page_size, hd = k_pages.shape
     if hd % head_dim != 0:
@@ -999,8 +1075,9 @@ def paged_decode_attention(
                 f"{wi_seq.shape[0]=} != {wi_chunk.shape[0]=} + 1")
     else:
         wi_seq = wi_chunk = None
+    use_amla = amla_enabled() if amla is None else bool(amla)
     return _paged_decode_impl(
         q, k_pages, v_pages, block_tables, context_lens, wi_seq,
         wi_chunk, alibi_slopes, knew, vnew, scale=scale,
         kv_scale=kv_scale, pages_per_chunk=ppc, pf_depth=pf_depth,
-        interpret=interpret)
+        amla=use_amla, interpret=interpret)

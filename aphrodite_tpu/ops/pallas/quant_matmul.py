@@ -65,6 +65,20 @@ classic 2048). Default at m <= 64; `APHRODITE_QMM_STREAM=0` pins the
 classic grid for A/B runs. Composes with deferred rescale: the int32
 group accumulators ride as kernel scratch and the scale rows still
 apply once at k-flush.
+
+Round-7 closures of the two machine-flagged residuals (ROADMAP item
+1): (1) the streamed grid's f32 accumulator is now TWO column-parity
+planes — the run-final flush epilogue writes the parity plane while
+the next column block initializes and accumulates the other, so the
+k-run-boundary bubble ROOF003 flagged (flush + output write
+serializing with the next run's first ring wait) is covered by the
+ring like every other cell; (2) the FOLD001 activation-quantization
+chain is folded out of the launchers — streamed a8 calls take the
+RAW activation block and quantize it in the kernel prologue (x is
+VMEM-resident for the whole call, so HBM never sees an int8 copy),
+and the classic grids quantize through the fused one-pass
+`_quant8_kernel` instead of the two-pass XLA reduce+elementwise
+chain.
 """
 from __future__ import annotations
 
@@ -248,7 +262,10 @@ def _cell_bytes(block_k: int, *, layout: str, block_m: int,
     at a candidate block_k — the _clamp_k_vmem cost model. Classic
     grid: compiler-managed input blocks count twice (double
     buffering); streamed grid: the explicit ring replaces the weight
-    blocks and x is resident whole."""
+    blocks, x is resident whole, the f32 accumulator is TWO
+    column-parity planes (the double-buffered flush), and a8 calls
+    additionally hold the in-kernel-quantized int8 copy of x plus the
+    row-scale plane."""
     gpt = block_k // gs
     if layout == "awq":
         qw = block_k * (block_n // 8) * 4
@@ -260,11 +277,13 @@ def _cell_bytes(block_k: int, *, layout: str, block_m: int,
         temp = block_k * block_n * x_bytes if a16 \
             else gs * block_n * 4
     zs = gpt * block_n * (4 + s_bytes)
-    acc = block_m * block_n * 4
     planes = gpt * block_m * block_n * 4 if deferred else 0
     if stream_slots:
+        acc = 2 * block_m * block_n * 4       # parity planes
+        quant = (block_m * K + block_m * 128 * 4) if not a16 else 0
         return (stream_slots * (qw + zs) + 2 * block_m * K * x_bytes +
-                acc + planes + temp)
+                acc + planes + temp + quant)
+    acc = block_m * block_n * 4
     return 2 * (block_m * block_k * x_bytes + qw + zs) + acc + \
         planes + temp
 
@@ -294,8 +313,21 @@ def _stream_kernel(*refs, layout: str, bits: int, k_tiles: int,
     cells ago by the ring), start the item n_slots-1 ahead, then
     dequant+dot against the RESIDENT activation block. k is the inner
     run: the f32 accumulator persists in scratch across a column
-    block's k items (reset at k == 0, output written at the last k —
-    the out index map revisits the same block for the whole run).
+    block's k items, slot-indexed by COLUMN PARITY — the plane for
+    column n+1 is initialized and accumulated while column n's flush
+    epilogue + output write are still draining, so the run-boundary
+    flush no longer serializes with the next run's first ring wait
+    (the ROOF003 k-run bubble; parity needed ~620 GB/s effective vs
+    the single-plane ~560). Output is written at the last k — the out
+    index map revisits the same block for the whole run.
+
+    a8 calls take the RAW activation block and quantize it in the
+    w == 0 prologue (per-row absmax over the full resident K — the
+    row scale is permutation-invariant, so quantizing the permuted
+    block equals permuting the quantized block): x8 and the row
+    scales live in scratch for the whole call and HBM never sees an
+    int8 activation copy (the FOLD001 fold — Zen-Attention applied
+    to the quantization chain).
 
     The ring protocol is the ragged-attention cross-cell prefetch
     applied to weights: cell 0 seeds the first n_slots items' copies;
@@ -305,14 +337,19 @@ def _stream_kernel(*refs, layout: str, bits: int, k_tiles: int,
     nothing stays in flight past the kernel."""
     refs = list(refs)
     x_ref = refs.pop(0)         # [k_tiles, block_m, block_k] resident
-    xs_ref = refs.pop(0) if a8 else None          # [block_m, 1]
     qw_hbm, z_hbm, s_hbm, o_ref = refs[:4]
     qw_ring, z_ring, s_ring, sems, acc_ref = refs[4:9]
-    g32_ref = refs[9] if deferred else None
+    refs = refs[9:]
+    x8_scr = refs.pop(0) if a8 else None   # [k_tiles, block_m, block_k]
+    xs_scr = refs.pop(0) if a8 else None   # [block_m, 128] row scales
+    g32_ref = refs.pop(0) if deferred else None
 
     w = pl.program_id(0)
     total = n_tiles * k_tiles
     k = jax.lax.rem(w, k_tiles)
+    # Column parity selects this run's accumulator plane (the flushed
+    # plane of column n stays untouched while column n+1 accumulates).
+    par = jax.lax.rem(w // k_tiles, 2)
 
     gs = group_size
     pack = 32 // bits
@@ -356,13 +393,34 @@ def _stream_kernel(*refs, layout: str, bits: int, k_tiles: int,
         start_item(nxt // k_tiles, jax.lax.rem(nxt, k_tiles),
                    jax.lax.rem(nxt, n_slots))
 
+    if a8:
+        @pl.when(w == 0)
+        def _quantize():
+            # Folded activation quantization: per-row absmax over the
+            # whole resident block, then div/round/clip/cast into the
+            # int8 scratch — all VPU time under the first item's
+            # weight-DMA wait. Scratch persists across every cell.
+            absmax = jnp.max(jnp.abs(x_ref[0].astype(jnp.float32)),
+                             axis=1, keepdims=True)
+            for kt in range(1, k_tiles):
+                absmax = jnp.maximum(
+                    absmax,
+                    jnp.max(jnp.abs(x_ref[kt].astype(jnp.float32)),
+                            axis=1, keepdims=True))
+            xs = jnp.maximum(absmax, 1e-8) / 127.0       # [block_m, 1]
+            xs_scr[...] = jnp.broadcast_to(xs, xs_scr.shape)
+            for kt in range(k_tiles):
+                x8_scr[kt] = jnp.clip(
+                    jnp.round(x_ref[kt].astype(jnp.float32) / xs),
+                    -127, 127).astype(jnp.int8)
+
     slot = jax.lax.rem(w, n_slots)
     for dma in item_dmas(w // k_tiles, k, slot):
         dma.wait()
 
     @pl.when(k == 0)
     def _init():
-        acc_ref[...] = jnp.zeros_like(acc_ref)
+        acc_ref[par] = jnp.zeros(acc_ref.shape[1:], acc_ref.dtype)
 
     qw_t = qw_ring[slot]              # [qw_rows, qw_cols] int32
     if layout == "awq":
@@ -381,7 +439,7 @@ def _stream_kernel(*refs, layout: str, bits: int, k_tiles: int,
             return w_pm[g * gs:(g + 1) * gs]
         return _unpack_planes(qw_t[g * rpg:(g + 1) * rpg], bits)
 
-    x_tile = x_ref[k]                 # [block_m, block_k]
+    x_tile = x8_scr[k] if a8 else x_ref[k]    # [block_m, block_k]
     if a8 and deferred:
         for g in range(gpt):
             w8 = (w_codes(g) - z_ring[slot, g]).astype(jnp.int8)
@@ -389,7 +447,7 @@ def _stream_kernel(*refs, layout: str, bits: int, k_tiles: int,
                 x_tile[:, g * gs:(g + 1) * gs], w8,
                 (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.int32)
-        acc_ref[...] += jnp.sum(
+        acc_ref[par] += jnp.sum(
             g32_ref[...].astype(jnp.float32) *
             s_ring[slot].astype(jnp.float32), axis=0)
     elif a8:
@@ -399,7 +457,7 @@ def _stream_kernel(*refs, layout: str, bits: int, k_tiles: int,
                 x_tile[:, g * gs:(g + 1) * gs], w8,
                 (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.int32)
-            acc_ref[...] += d.astype(jnp.float32) * \
+            acc_ref[par] += d.astype(jnp.float32) * \
                 s_ring[slot, g].astype(jnp.float32)
     else:
         chunks = []
@@ -410,35 +468,32 @@ def _stream_kernel(*refs, layout: str, bits: int, k_tiles: int,
                 ((w_codes(g) - z).astype(jnp.float32) *
                  s).astype(x_tile.dtype))
         wt = chunks[0] if gpt == 1 else jax.lax.concatenate(chunks, 0)
-        acc_ref[...] += jnp.dot(x_tile, wt,
+        acc_ref[par] += jnp.dot(x_tile, wt,
                                 preferred_element_type=jnp.float32)
 
     @pl.when(k == k_tiles - 1)
     def _flush():
+        # Run-boundary epilogue off the PARITY plane: the next column
+        # block initializes and accumulates the other plane while this
+        # write drains, so no ring wait serializes behind it.
         if a8:
-            # perf-known: ROOF003 the LATENCY_r06 bs=1 residual — at
-            # k-run boundaries this single-plane flush + output write
-            # serialize with the next column block's first ring wait
-            # (parity needs ~620 GB/s effective vs the measured ~560);
-            # the fix is double-buffering the accumulator/output
-            # planes, tracked as ROADMAP item 2.
-            o_ref[...] = (acc_ref[...] *
-                          xs_ref[...].astype(jnp.float32)
-                          ).astype(o_ref.dtype)
+            o_ref[...] = (acc_ref[par] *
+                          xs_scr[:, :1]).astype(o_ref.dtype)
         else:
-            # perf-known: ROOF003 same k-run flush serialization as
-            # the a8 arm above (ROADMAP item 2).
-            o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+            o_ref[...] = acc_ref[par].astype(o_ref.dtype)
 
 
-def _stream_call(x, xs, qweight, z3, s3, *, layout: str, bits: int,
+def _stream_call(x, qweight, z3, s3, *, layout: str, bits: int,
                  gs: int, block_m: int, block_n: int, block_k: int,
-                 padded_m: int, N: int, n_slots: int, deferred: bool,
-                 out_dtype, interpret: bool):
+                 padded_m: int, N: int, n_slots: int, a8: bool,
+                 deferred: bool, out_dtype, interpret: bool):
     """Launch _stream_kernel: x [padded_m, K] (already permuted and
-    padded) goes resident as [k_tiles, block_m, block_k]; qweight and
-    the [G, 1, N] zero/scale rows stay in HBM (memory_space=ANY) and
-    stream through the ring. Returns [padded_m, N] (plane-major
+    padded; RAW model dtype even for a8 — the kernel quantizes it in
+    its prologue) goes resident as [k_tiles, block_m, block_k];
+    qweight and the [G, 1, N] zero/scale rows stay in HBM
+    (memory_space=ANY) and stream through the ring. The f32
+    accumulator is two column-parity planes (the ROOF003
+    double-buffered flush). Returns [padded_m, N] (plane-major
     columns for awq — callers un-permute as usual)."""
     if padded_m != block_m:
         raise ValueError(
@@ -448,7 +503,6 @@ def _stream_call(x, xs, qweight, z3, s3, *, layout: str, bits: int,
     k_tiles = K // block_k
     n_tiles = N // block_n
     gpt = block_k // gs
-    a8 = xs is not None
     if layout == "awq":
         qw_rows, qw_cols = block_k, block_n // 8
     else:
@@ -458,21 +512,24 @@ def _stream_call(x, xs, qweight, z3, s3, *, layout: str, bits: int,
     in_specs = [
         pl.BlockSpec((k_tiles, block_m, block_k),
                      lambda w: (0, 0, 0)),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
     ]
-    inputs = [x_t]
-    if a8:
-        in_specs.append(pl.BlockSpec((block_m, 1), lambda w: (0, 0)))
-        inputs.append(xs)
-    in_specs.extend([pl.BlockSpec(memory_space=pl.ANY)] * 3)
-    inputs.extend([qweight, z3, s3])
+    inputs = [x_t, qweight, z3, s3]
 
     scratch = [
         pltpu.VMEM((n_slots, qw_rows, qw_cols), jnp.int32),
         pltpu.VMEM((n_slots, gpt, 1, block_n), jnp.int32),
         pltpu.VMEM((n_slots, gpt, 1, block_n), s3.dtype),
         pltpu.SemaphoreType.DMA((n_slots, 3)),
-        pltpu.VMEM((block_m, block_n), jnp.float32),
+        pltpu.VMEM((2, block_m, block_n), jnp.float32),
     ]
+    if a8:
+        scratch.extend([
+            pltpu.VMEM((k_tiles, block_m, block_k), jnp.int8),
+            pltpu.VMEM((block_m, 128), jnp.float32),
+        ])
     if deferred:
         scratch.append(
             pltpu.VMEM((gpt, block_m, block_n), jnp.int32))
@@ -542,7 +599,8 @@ def gptq_supported(in_features: int, out_features: int, bits: int,
 
 def _gptq_prologue(x, qzeros, scales, N: int, bits: int, gs: int,
                    tile_dtype, k_cap: int = 0, acc_planes: int = 1,
-                   stream_slots: int = 0, deferred: bool = False):
+                   stream_slots: int = 0, deferred: bool = False,
+                   a8: bool = False):
     """Shared GPTQ wrapper prologue (one copy of the layout logic for
     the W4A16 and W4A8 kernels): plane-permute and pad x, unpack the
     zero points (+1, AutoGPTQ convention), lift scales to the [G, 1, N]
@@ -567,7 +625,7 @@ def _gptq_prologue(x, qzeros, scales, N: int, bits: int, gs: int,
             block_n=block_n, gs=gs, pack=pack,
             x_bytes=x.dtype.itemsize, s_bytes=scales.dtype.itemsize,
             K=K, stream_slots=stream_slots, deferred=deferred,
-            a16=x.dtype != jnp.int8),
+            a16=x.dtype != jnp.int8 and not a8),
         tag="gptq")
     # Plane-order unpack (see _unpack_planes): permute x's columns to
     # match — per GROUP, since the kernels unpack each group chunk
@@ -624,11 +682,11 @@ def gptq_matmul(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
 
     if use_stream:
         out = _stream_call(
-            x, None, qweight, z_all, scales3, layout="gptq",
+            x, qweight, z_all, scales3, layout="gptq",
             bits=bits, gs=gs, block_m=block_m, block_n=block_n,
             block_k=block_k, padded_m=padded_m, N=N,
-            n_slots=n_slots, deferred=False, out_dtype=x.dtype,
-            interpret=interpret)
+            n_slots=n_slots, a8=False, deferred=False,
+            out_dtype=x.dtype, interpret=interpret)
         return out[:m] if padded_m != m else out
 
     out = pl.pallas_call(
@@ -704,19 +762,68 @@ def awq_supported(in_features: int, out_features: int,
 
 
 def _quantize_activations_int8(x):
-    """Per-row symmetric int8 activation quantization (shared by the
-    W4A8 kernels). Returns (x8 [m, K] int8, xs [m, 1] f32)."""
+    """Per-row symmetric int8 activation quantization — the jnp
+    REFERENCE path (non-TPU fallback and the parity oracle for the
+    fused forms). Returns (x8 [m, K] int8, xs [m, 1] f32). Hot paths
+    never run this chain: the streamed kernels quantize their
+    resident block in the kernel prologue and the classic grids go
+    through the fused one-pass `_quant8_call` kernel below (the
+    FOLD001 fold — the XLA chain paid a second full-width activation
+    read between the absmax reduce and the elementwise pass)."""
     absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=1,
                      keepdims=True)
     xs = jnp.maximum(absmax, 1e-8) / 127.0
     x8 = jnp.clip(jnp.round(x.astype(jnp.float32) / xs), -127,
                   127).astype(jnp.int8)
-    # perf-known: FOLD001 this div/round/clip/cast chain costs one
-    # HBM round trip of the full activation block before every W4A8
-    # launch; the streamed grid keeps x VMEM-resident for the whole
-    # call, so the quantization belongs in the kernel prologue
-    # (Zen-Attention-style fold; ROADMAP item 2).
     return x8, xs
+
+
+def _quant8_kernel(x_ref, x8_ref, xs_ref):
+    """Fused one-pass activation quantization tile: absmax-reduce and
+    div/round/clip/cast entirely in VMEM — HBM reads the raw rows
+    once and writes only the int8 copy plus the [rows, 1] scales."""
+    xf = x_ref[...].astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=1, keepdims=True)
+    xs = jnp.maximum(absmax, 1e-8) / 127.0
+    x8_ref[...] = jnp.clip(jnp.round(xf / xs), -127,
+                           127).astype(jnp.int8)
+    xs_ref[...] = xs
+
+
+def _quant8_call(x, interpret: bool):
+    """Launch _quant8_kernel over row blocks (whole-K rows per cell —
+    the per-row reduce needs the full contraction width resident)."""
+    m, K = x.shape
+    sublane = 16 if x.dtype == jnp.bfloat16 else 8
+    block_m = min(256, -(-m // sublane) * sublane)
+    # f32 working copy + in/out blocks must fit scoped VMEM.
+    while block_m > sublane and block_m * K * 8 > _QMM_VMEM_BYTES:
+        block_m = max(sublane, block_m // 2 // sublane * sublane)
+    padded_m = -(-m // block_m) * block_m
+    if padded_m != m:
+        x = jnp.pad(x, ((0, padded_m - m), (0, 0)))
+    x8, xs = pl.pallas_call(
+        _quant8_kernel,
+        grid=(padded_m // block_m,),
+        in_specs=[pl.BlockSpec((block_m, K), lambda i: (i, 0))],
+        out_specs=[pl.BlockSpec((block_m, K), lambda i: (i, 0)),
+                   pl.BlockSpec((block_m, 1), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((padded_m, K), jnp.int8),
+                   jax.ShapeDtypeStruct((padded_m, 1), jnp.float32)],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel",)),
+        interpret=interpret,
+    )(x)
+    return (x8[:m], xs[:m]) if padded_m != m else (x8, xs)
+
+
+def quantize_activations_int8(x, *, interpret: bool = False):
+    """Per-row symmetric int8 activation quantization for the classic
+    quant-matmul grids: the fused one-pass kernel on TPU (and under
+    interpret), the jnp reference chain elsewhere."""
+    if interpret or jax.default_backend() == "tpu":
+        return _quant8_call(x, interpret)
+    return _quantize_activations_int8(x)
 
 
 def _awq_zs_plane_major(qzeros, scales, N, n_tiles, block_n, G):
@@ -794,10 +901,10 @@ def awq_matmul(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
 
     if use_stream:
         out_pm = _stream_call(
-            x, None, qweight, z_pm, s_pm, layout="awq", bits=4,
+            x, qweight, z_pm, s_pm, layout="awq", bits=4,
             gs=gs, block_m=block_m, block_n=block_n, block_k=block_k,
-            padded_m=padded_m, N=N, n_slots=n_slots, deferred=False,
-            out_dtype=x.dtype, interpret=interpret)
+            padded_m=padded_m, N=N, n_slots=n_slots, a8=False,
+            deferred=False, out_dtype=x.dtype, interpret=interpret)
         y = _awq_unpermute(out_pm, padded_m, N, n_tiles, block_n,
                            order)
         return y[:m] if padded_m != m else y
@@ -939,16 +1046,12 @@ def awq_matmul_a8(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
         block_k, gs,
         functools.partial(
             _cell_bytes, layout="awq", block_m=block_m,
-            block_n=block_n, gs=gs, pack=8, x_bytes=1,
+            block_n=block_n, gs=gs, pack=8,
+            x_bytes=x.dtype.itemsize if use_stream else 1,
             s_bytes=scales.dtype.itemsize, K=K,
             stream_slots=n_slots, deferred=use_def, a16=False),
         tag="awq_a8")
     groups_per_tile = block_k // gs
-
-    x8, xs = _quantize_activations_int8(x)
-    if padded_m != m:
-        x8 = jnp.pad(x8, ((0, padded_m - m), (0, 0)))
-        xs = jnp.pad(xs, ((0, padded_m - m), (0, 0)))
 
     k_tiles = K // block_k
     n_tiles = N // block_n
@@ -957,14 +1060,23 @@ def awq_matmul_a8(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
                                             n_tiles, block_n, G)
 
     if use_stream:
+        # Raw activations go resident; the kernel prologue quantizes
+        # them in VMEM (the folded FOLD001 chain).
+        xr = jnp.pad(x, ((0, padded_m - m), (0, 0))) \
+            if padded_m != m else x
         out_pm = _stream_call(
-            x8, xs, qweight, z_pm, s_pm, layout="awq", bits=4,
+            xr, qweight, z_pm, s_pm, layout="awq", bits=4,
             gs=gs, block_m=block_m, block_n=block_n, block_k=block_k,
-            padded_m=padded_m, N=N, n_slots=n_slots,
+            padded_m=padded_m, N=N, n_slots=n_slots, a8=True,
             deferred=use_def, out_dtype=x.dtype, interpret=interpret)
         y = _awq_unpermute(out_pm, padded_m, N, n_tiles, block_n,
                            order)
         return y[:m] if padded_m != m else y
+
+    x8, xs = quantize_activations_int8(x, interpret=interpret)
+    if padded_m != m:
+        x8 = jnp.pad(x8, ((0, padded_m - m), (0, 0)))
+        xs = jnp.pad(xs, ((0, padded_m - m), (0, 0)))
 
     kernel = functools.partial(
         _awq_a8_deferred_kernel if use_def else _awq_a8_kernel,
@@ -1282,10 +1394,6 @@ def gptq_matmul_a8(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
         if not _deferred_fits(bm, bn, gpt):
             use_def = False
 
-    # Row scales are permutation-invariant, so quantize before the
-    # shared prologue's column permute.
-    x8, xs = _quantize_activations_int8(x)
-
     # Classic path: small-m decode is grid-cell-count bound (the whole
     # weight streams once per step regardless of m): 2048-deep k-tiles
     # halve the cell count and measured bs=1 96.9 -> 100.8 tok/s
@@ -1303,23 +1411,35 @@ def gptq_matmul_a8(x: jax.Array, qweight: jax.Array, qzeros: jax.Array,
         k_cap = _DEFERRED_K_CAP
     else:
         k_cap = 2048 if m <= 64 else 0
+
+    if use_stream:
+        # RAW activations through the shared prologue (permute+pad):
+        # the kernel prologue quantizes the resident block in VMEM.
+        # Row scales are permutation-invariant, so quantizing the
+        # permuted block equals permuting the quantized block.
+        xq, z_all, scales3, tiles = _gptq_prologue(
+            x, qzeros, scales, N, bits, gs, jnp.bfloat16, k_cap=k_cap,
+            acc_planes=(bk // gs) if use_def else 1,
+            stream_slots=n_slots, deferred=use_def, a8=True)
+        (block_m, block_n, block_k, padded_m, grid,
+         groups_per_tile, k_tiles) = tiles
+        out = _stream_call(
+            xq, qweight, z_all, scales3, layout="gptq",
+            bits=bits, gs=gs, block_m=block_m, block_n=block_n,
+            block_k=block_k, padded_m=padded_m, N=N,
+            n_slots=n_slots, a8=True, deferred=use_def,
+            out_dtype=x.dtype, interpret=interpret)
+        return out[:m] if padded_m != m else out
+
+    x8, xs = quantize_activations_int8(x, interpret=interpret)
     x8, z_all, scales3, tiles = _gptq_prologue(
         x8, qzeros, scales, N, bits, gs, jnp.bfloat16, k_cap=k_cap,
         acc_planes=(bk // gs) if use_def else 1,
-        stream_slots=n_slots, deferred=use_def)
+        stream_slots=0, deferred=use_def)
     (block_m, block_n, block_k, padded_m, grid,
      groups_per_tile, k_tiles) = tiles
     if padded_m != m:
         xs = jnp.pad(xs, ((0, padded_m - m), (0, 0)))
-
-    if use_stream:
-        out = _stream_call(
-            x8, xs, qweight, z_all, scales3, layout="gptq",
-            bits=bits, gs=gs, block_m=block_m, block_n=block_n,
-            block_k=block_k, padded_m=padded_m, N=N,
-            n_slots=n_slots, deferred=use_def, out_dtype=x.dtype,
-            interpret=interpret)
-        return out[:m] if padded_m != m else out
 
     kernel = functools.partial(
         _gptq_a8_deferred_kernel if use_def else _gptq_a8_kernel,
@@ -1477,7 +1597,7 @@ def gguf_w8a8_matmul(x: jax.Array, qs: jax.Array, s128: jax.Array, *,
     G = K // 128
     block_k = _tile_k(K, 128)
     block_m, block_n, padded_m = _tile_mn(m, N, jnp.bfloat16)
-    x8, xs = _quantize_activations_int8(x)
+    x8, xs = quantize_activations_int8(x, interpret=interpret)
     if padded_m != m:
         x8 = jnp.pad(x8, ((0, padded_m - m), (0, 0)))
         xs = jnp.pad(xs, ((0, padded_m - m), (0, 0)))

@@ -49,7 +49,11 @@ def stream_roofline_static(m: int, K: int, N: int, gs: int = GROUP):
     bytes_total_hi, flops, floor_us) — flops is the analytic
     2*m*K*N (the kernel body is a *refs kernel the static binder
     cannot see into), floor_us the static byte floor at the v5e
-    ~820 GB/s spec."""
+    ~820 GB/s spec. Round 7: the estimator distinguishes the
+    slot-indexed column-parity accumulator planes (literal-2 lead)
+    from the DMA ring slots, so the bound stays the RING traffic even
+    when the binding resolves the ring depth to the same small
+    integer."""
     import jax.numpy as jnp
     from aphrodite_tpu.ops.pallas.quant_matmul import (_STREAM_K_CAP,
                                                        _stream_pf,
@@ -254,11 +258,15 @@ def main() -> None:
     # path): per-layer us and effective weight-streaming GB/s over the
     # four per-layer GEMMs at m in {1, 16, 64}. The streamed grid
     # flattens (n, k) into a work list and drives an explicit weight
-    # DMA ring (quant_matmul._stream_kernel); `stream` pins the
-    # variant so both compile at identical shapes. Effective GB/s
-    # counts the int4 qweight + packed zeros + scales actually read
-    # from HBM per layer — the LATENCY_r05 floor argument's ~430
-    # (classic) vs ~620 (parity) GB/s metric. ---
+    # DMA ring (quant_matmul._stream_kernel) — since round 7 with the
+    # DOUBLE-BUFFERED column-parity accumulator (the ROOF003 closure:
+    # the run-final flush no longer serializes with the next run's
+    # first ring wait) and the activation quantization folded into
+    # the kernel prologue; `stream` pins the variant so both compile
+    # at identical shapes. Effective GB/s counts the int4 qweight +
+    # packed zeros + scales actually read from HBM per layer, printed
+    # against the ~820 GB/s v5e floor — the LATENCY_r05 floor
+    # argument's ~430 (classic) vs ~620 (parity) GB/s metric. ---
     if want("qmm"):
         from aphrodite_tpu.ops.pallas.quant_matmul import gptq_matmul_a8
         layer_weight_bytes = sum(
@@ -289,16 +297,19 @@ def main() -> None:
                     row(f"QMM A/B {label} {name} m={M}", s * 1e3,
                         LAYERS, "")
             stream_rows.append((M, us["classic"], us["streamed"]))
-        print(f"\n=== streamed-vs-classic W4A8 skinny-m A/B "
-              f"(us/layer over the 4 GEMMs; effective weight GB/s) ===")
+        from tools.aphrocheck.passes.roofline_pass import HBM_GBPS
+        print(f"\n=== streamed(double-buffered)-vs-classic W4A8 "
+              f"skinny-m A/B (us/layer over the 4 GEMMs; effective "
+              f"weight GB/s vs the {HBM_GBPS:.0f} GB/s floor) ===")
         print(f"{'m':>4s} {'classic':>12s} {'streamed':>12s} "
-              f"{'speedup':>8s}")
+              f"{'speedup':>8s} {'of-floor':>9s}")
         for M, c_us, s_us in stream_rows:
             c_gbs = layer_weight_bytes / (c_us * 1e-6) / 1e9
             s_gbs = layer_weight_bytes / (s_us * 1e-6) / 1e9
             print(f"{M:4d} {c_us:7.1f}us {c_gbs:4.0f}GB/s "
                   f"{s_us:7.1f}us {s_gbs:4.0f}GB/s "
-                  f"{c_us / s_us:7.2f}x")
+                  f"{c_us / s_us:7.2f}x "
+                  f"{s_gbs / HBM_GBPS * 100:7.0f}%")
 
     # --- roofline calibration: the aphrocheck static estimates next
     # to measured us/layer + effective GB/s, so estimate-vs-reality
@@ -496,11 +507,15 @@ def main() -> None:
         row(f"decode_attn ragged b={B} ctx={ctx}", s * 1e3, LAYERS,
             f"{kv_bytes / s / 1e9:.0f} GB/s KV")
 
-        # Classic-vs-ragged A/B at the bench page-32 geometry (mirrors
-        # the W4A8 `--only ab` table): ctx 128 is the bench point
+        # Classic-vs-ragged grid A/B plus the round-7 AMLA-vs-classic
+        # RESCALE A/B at the bench page-32 geometry (mirrors the W4A8
+        # `--only ab` table): ctx 128 is the bench point
         # (single-chunk), 512 and 2000 are the multi-chunk serving
         # shapes the ragged grid targets. Batch shrinks with ctx so
-        # the KV pool stays within HBM.
+        # the KV pool stays within HBM. The amla column pins the
+        # exponent-bias-add rescale against the classic multiply on
+        # the same ragged grid (APHRODITE_ATTN_AMLA's two settings),
+        # with effective KV GB/s against the 820 GB/s floor.
         ab_rows = []
         PAGE32 = 32
         for ab_ctx, ab_b in ((128, 512), (512, 256), (2000, 64)):
@@ -523,13 +538,16 @@ def main() -> None:
                 [-(-ab_ctx // PAGE32)] * ab_b, ab_ppc)
             ab_kv = 2 * ab_b * KV_HEADS * ab_ctx * HEAD_DIM * 2
             us = {}
-            for label, wk in (("classic", None), ("ragged", ab_work)):
+            for label, wk, use_amla in (
+                    ("classic", None, True),
+                    ("ragged", ab_work, True),
+                    ("ragged-mulrescale", ab_work, False)):
                 def abstep(c, i, kpp=kp32, vpp=vp32, tb=tb32,
-                           cl=cl32, wk=wk, ppc=ab_ppc):
+                           cl=cl32, wk=wk, ppc=ab_ppc, am=use_amla):
                     qq = c
                     o = paged_decode_attention(
                         qq, kpp, vpp, tb, cl, None, scale=0.0884,
-                        pages_per_chunk=ppc, work_items=wk)
+                        pages_per_chunk=ppc, work_items=wk, amla=am)
                     return qq + o * jnp.bfloat16(1e-30)
                 s, rtt = device_bench(abstep, q32)
                 rtts.append(rtt)
@@ -537,14 +555,22 @@ def main() -> None:
                 row(f"ATTN A/B {label} b={ab_b} ctx={ab_ctx} "
                     f"page={PAGE32}", s * 1e3, LAYERS,
                     f"{ab_kv / s / 1e9:.0f} GB/s KV")
-            ab_rows.append((ab_b, ab_ctx, us["classic"], us["ragged"]))
-        print(f"\n=== decode attention A/B "
-              f"(page {PAGE32}, us/layer, lower is better) ===")
+            ab_rows.append((ab_b, ab_ctx, ab_kv, us))
+        from tools.aphrocheck.passes.roofline_pass import HBM_GBPS
+        print(f"\n=== decode attention A/B (page {PAGE32}, us/layer; "
+              f"amla = exponent-bias-add rescale vs the classic "
+              f"multiply on the SAME ragged grid; KV GB/s vs the "
+              f"{HBM_GBPS:.0f} GB/s floor) ===")
         print(f"{'batch':>6s} {'ctx':>6s} {'classic':>10s} "
-              f"{'ragged':>10s} {'speedup':>9s}")
-        for ab_b, ab_ctx, c_us, r_us in ab_rows:
-            print(f"{ab_b:6d} {ab_ctx:6d} {c_us:10.1f} {r_us:10.1f} "
-                  f"{c_us / r_us:8.2f}x")
+              f"{'ragged':>10s} {'mul-resc':>10s} {'amla-x':>7s} "
+              f"{'KV-GB/s':>8s} {'of-floor':>9s}")
+        for ab_b, ab_ctx, ab_kv, us in ab_rows:
+            gbs = ab_kv / (us["ragged"] * 1e-6) / 1e9
+            print(f"{ab_b:6d} {ab_ctx:6d} {us['classic']:10.1f} "
+                  f"{us['ragged']:10.1f} "
+                  f"{us['ragged-mulrescale']:10.1f} "
+                  f"{us['ragged-mulrescale'] / us['ragged']:6.2f}x "
+                  f"{gbs:8.0f} {gbs / HBM_GBPS * 100:7.0f}%")
 
     # --- KV page write ---
     fk = jax.random.normal(key, (B, KV_HEADS, HEAD_DIM),

@@ -81,9 +81,9 @@ def test_repo_is_clean():
 def test_repo_clean_without_allowlist():
     """The stronger form of the gate: all 17 pass families produce
     ZERO findings with no allowlist at all — every real finding the
-    new passes surfaced was fixed in-tree or registered in source
-    (perf-known pragmas for the ROOF/FOLD motivating findings), so
-    the allowlist ships empty."""
+    passes surfaced was fixed in-tree (the ROOF/FOLD motivating
+    findings closed in round 7; their perf-known pragmas are gone),
+    so the allowlist ships empty."""
     report = run(allowlist_path=None)
     assert not report.findings, \
         "aphrocheck findings without allowlist:\n" + \

@@ -28,7 +28,7 @@ from aphrodite_tpu.modeling.layers.quantization.gptq import (
 from aphrodite_tpu.ops.pallas.quant_matmul import (
     _cell_bytes, _clamp_k_vmem, _quantize_activations_int8,
     _resolve_stream, _stream_pf, awq_matmul, awq_matmul_a8,
-    gptq_matmul, gptq_matmul_a8)
+    gptq_matmul, gptq_matmul_a8, quantize_activations_int8)
 
 rs = np.random.RandomState(11)
 
@@ -264,3 +264,90 @@ def test_oversized_block_k_env_clamps(monkeypatch):
         x, params["qweight"], params["qzeros"], params["scales"],
         bits=4, group_size=128, interpret=True, stream=False))
     assert _rel(oracle, got) < 2e-2
+
+
+# ------------------- double-buffered flush + folded prologue (r7) --
+
+@pytest.mark.parametrize("m", [1, 8, 64])
+@pytest.mark.parametrize("layout", ["gptq", "awq"])
+@pytest.mark.parametrize("a8,deferred", [(False, False), (True, False),
+                                         (True, True)])
+def test_parity_plane_flush_multi_column(m, layout, a8, deferred):
+    """The ISSUE-14 flush-parity matrix at a >= 3 x 3 (n, k) work
+    list: the column-parity accumulator planes alternate across >= 3
+    column runs (plane reuse, not just ping-pong once) over the K=384
+    tail (three single-group k-tiles), for gptq AND awq, a16 and a8,
+    deferred rescale on and off."""
+    gs, K = 128, 384
+    if layout == "gptq":
+        N = 384                      # block_n 128 -> 3 column runs
+        params, x = make_gptq(4, gs, K, N, m)
+        ref = np.asarray(x @ _gptq_dequant(params, gs))
+        if a8:
+            ref = _a8_oracle(x, _gptq_dequant(params, gs))
+            fn = lambda stream: gptq_matmul_a8(
+                x, params["qweight"], params["qzeros"],
+                params["scales"], bits=4, group_size=gs,
+                interpret=True, deferred=deferred, stream=stream)
+        else:
+            fn = lambda stream: gptq_matmul(
+                x, params["qweight"], params["qzeros"],
+                params["scales"], bits=4, group_size=gs,
+                interpret=True, stream=stream)
+    else:
+        N = 3072                     # block_n 1024 -> 3 column runs
+        params, x = make_awq(gs, K, N, m)
+        method = AWQLinearMethod(AWQConfig(4, gs))
+        ref = np.asarray(x @ method.dequantize(params, jnp.float32))
+        if a8:
+            ref = _a8_oracle(x, method.dequantize(params, jnp.float32))
+            fn = lambda stream: awq_matmul_a8(
+                x, params["qweight"], params["qzeros"],
+                params["scales"], group_size=gs, interpret=True,
+                deferred=deferred, stream=stream)
+        else:
+            fn = lambda stream: awq_matmul(
+                x, params["qweight"], params["qzeros"],
+                params["scales"], group_size=gs, interpret=True,
+                stream=stream)
+    tol = 2e-2 if a8 else 2e-5
+    got_c = np.asarray(fn(False))
+    got_s = np.asarray(fn(True))
+    assert _rel(ref, got_c) < tol
+    assert _rel(ref, got_s) < tol
+    assert _rel(got_c, got_s) < 1e-4
+
+
+@pytest.mark.parametrize("m", [1, 8, 64])
+def test_folded_prologue_quantization_parity(m):
+    """The FOLD001 closure contract: the streamed a8 kernel quantizes
+    its RESIDENT activation block in the prologue (absmax over the
+    permuted rows — permutation-invariant, so identical row scales)
+    and must agree with the classic grid fed by the HOST
+    `_quantize_activations_int8` to f32 summation order."""
+    gs, K, N = 128, 384, 256
+    params, x = make_gptq(4, gs, K, N, m)
+    host = np.asarray(gptq_matmul_a8(
+        x, params["qweight"], params["qzeros"], params["scales"],
+        bits=4, group_size=gs, interpret=True, stream=False))
+    folded = np.asarray(gptq_matmul_a8(
+        x, params["qweight"], params["qzeros"], params["scales"],
+        bits=4, group_size=gs, interpret=True, stream=True))
+    assert _rel(host, folded) < 1e-4
+    oracle = _a8_oracle(x, _gptq_dequant(params, gs))
+    assert _rel(oracle, folded) < 2e-2
+
+
+def test_fused_quantize_kernel_matches_reference_chain():
+    """quantize_activations_int8 (the fused one-pass Pallas kernel the
+    classic grids use) reproduces the jnp reference chain: int8 codes
+    exactly, row scales to 1 ulp (the in-kernel divide may lower as a
+    reciprocal multiply) — including the padded-m slice."""
+    for m, K in ((1, 256), (5, 384), (48, 512)):
+        x = jnp.asarray(rs.randn(m, K).astype(np.float32))
+        x8_ref, xs_ref = _quantize_activations_int8(x)
+        x8_k, xs_k = quantize_activations_int8(x, interpret=True)
+        np.testing.assert_array_equal(np.asarray(x8_ref),
+                                      np.asarray(x8_k))
+        np.testing.assert_allclose(np.asarray(xs_ref),
+                                   np.asarray(xs_k), rtol=2e-7)

@@ -1,0 +1,231 @@
+"""Real-weights drift gate for the round-7 W4A8/scale-grid changes
+(VERDICT r5 #6, the standing item): the checked-in tiny REAL-QUANTIZED
+fixture (genuine AutoGPTQ group math over LLM-shaped heavy-tailed
+weights — tests/quantization/fixtures/make_w4a8_real_fixture.py) is
+pushed through every round-7 kernel variant and the drift between the
+NEW default paths (streamed folded-prologue W4A8, AMLA attention) and
+the classic reference paths is asserted in ULPs:
+
+- AMLA vs classic attention rescale: 0 ulp (the correction is an
+  exact power of two in both arms);
+- streamed (in-kernel quantization, parity-plane flush) vs classic
+  (host-quantized) W4A8: bounded max-ulp — the paths share exact
+  integer dots and differ only in f32 summation order plus at most
+  one quantization-boundary code per element (the in-kernel divide
+  may sit 1 ulp off the host chain's);
+- every variant vs the independent numpy dequantization oracle at the
+  W4A8 activation-rounding tolerance.
+
+`python tests/quantization/test_real_weights_drift.py --capture
+W4A8_DRIFT_r06.json` writes the drift artifact (backend recorded).
+Slow-marked: ~40 s of interpret-mode kernels on CPU."""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "..", ".."))     # repo root (--capture)
+
+from aphrodite_tpu.ops.pallas.quant_matmul import (gptq_matmul,  # noqa: E402
+                                                   gptq_matmul_a8)
+from aphrodite_tpu.ops.pallas.paged_attention import (
+    build_decode_work_list, paged_decode_attention)
+
+GS = 128
+FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "fixtures", "w4a8_real_tiny.npz")
+
+pytestmark = pytest.mark.slow
+
+
+def _unpack4_rows(packed: np.ndarray) -> np.ndarray:
+    """[r, c] int32, 8 nibbles along rows -> [r*8, c] int64 codes —
+    an INDEPENDENT unpack (not the kernel helpers), so the oracle
+    cannot inherit a transcription bug."""
+    u = packed.view(np.uint32).astype(np.uint64)
+    rows = []
+    for p in range(8):
+        rows.append((u >> np.uint64(4 * p)) & np.uint64(0xF))
+    out = np.empty((packed.shape[0] * 8, packed.shape[1]), np.int64)
+    for p in range(8):
+        out[p::8] = rows[p]
+    return out
+
+
+def _dequant_oracle(qweight, qzeros, scales) -> np.ndarray:
+    """[K, N] f32 from the AutoGPTQ v1 tensors: w = (q - (z+1)) * s."""
+    q = _unpack4_rows(qweight)                          # [K, N]
+    # qzeros packs along COLUMNS: unpack nibbles of each int32 word
+    u = qzeros.view(np.uint32).astype(np.uint64)        # [G, N/8]
+    z = np.empty((qzeros.shape[0], qzeros.shape[1] * 8), np.int64)
+    for p in range(8):
+        z[:, p::8] = (u >> np.uint64(4 * p)) & np.uint64(0xF)
+    s = scales.astype(np.float32)                       # [G, N]
+    K = q.shape[0]
+    zr = np.repeat(z + 1, GS, axis=0)[:K]
+    sr = np.repeat(s, GS, axis=0)[:K]
+    return ((q - zr) * sr).astype(np.float32)
+
+
+def _ulp(a: np.ndarray, b: np.ndarray) -> int:
+    """Max ULP distance between two f32 arrays (ordered-int mapping)."""
+    def ordered(x):
+        bits = x.astype(np.float32).view(np.int32).astype(np.int64)
+        return np.where(bits >= 0, bits, np.int64(0x80000000) - bits)
+    return int(np.abs(ordered(a) - ordered(b)).max())
+
+
+def _layers():
+    data = np.load(FIXTURE)
+    for name in ("qkv", "down"):
+        yield (name,
+               jnp.asarray(data[f"{name}.qweight"]),
+               jnp.asarray(data[f"{name}.qzeros"]),
+               jnp.asarray(data[f"{name}.scales"]),
+               jnp.asarray(data[f"{name}.x"]),
+               _dequant_oracle(data[f"{name}.qweight"],
+                               data[f"{name}.qzeros"],
+                               data[f"{name}.scales"]))
+
+
+def drift_report() -> dict:
+    """All drift measurements over the fixture — shared by the test
+    assertions and the --capture artifact."""
+    report = {"fixture": os.path.basename(FIXTURE),
+              "backend": jax.default_backend(), "layers": {}}
+    for name, qw, qz, sc, x, deq in _layers():
+        xs_oracle = np.maximum(
+            np.abs(np.asarray(x)).max(1, keepdims=True), 1e-8) / 127.0
+        x8_oracle = np.clip(np.round(np.asarray(x) / xs_oracle),
+                            -127, 127)
+        oracle = (x8_oracle * xs_oracle) @ deq
+
+        a16 = np.asarray(gptq_matmul(
+            x, qw, qz, sc, bits=4, group_size=GS, interpret=True,
+            stream=True))
+        classic = np.asarray(gptq_matmul_a8(
+            x, qw, qz, sc, bits=4, group_size=GS, interpret=True,
+            stream=False))
+        streamed = np.asarray(gptq_matmul_a8(
+            x, qw, qz, sc, bits=4, group_size=GS, interpret=True,
+            stream=True))
+        str_def = np.asarray(gptq_matmul_a8(
+            x, qw, qz, sc, bits=4, group_size=GS, interpret=True,
+            stream=True, deferred=True))
+
+        def rel(a, b):
+            return float(np.abs(a - b).max() /
+                         (np.abs(a).max() + 1e-9))
+        report["layers"][name] = {
+            "w4a16_stream_vs_dense_oracle_rel":
+                rel(np.asarray(x) @ deq, a16),
+            "w4a8_classic_vs_oracle_rel": rel(oracle, classic),
+            "w4a8_streamed_vs_oracle_rel": rel(oracle, streamed),
+            "streamed_vs_classic_max_ulp": _ulp(streamed, classic),
+            "streamed_vs_classic_rel": rel(classic, streamed),
+            "streamed_deferred_vs_classic_max_ulp":
+                _ulp(str_def, classic),
+        }
+
+    # AMLA vs classic attention over fixture-derived KV pages: pages
+    # filled from the down-projection dequant rows (real weight
+    # statistics), ragged ctx mix.
+    rs = np.random.RandomState(7)
+    data = np.load(FIXTURE)
+    deq = _dequant_oracle(data["down.qweight"], data["down.qzeros"],
+                          data["down.scales"])
+    pages, page_size, hd = 48, 8, 4 * 128
+    flat = np.resize(deq.astype(np.float32) * 4.0,
+                     pages * page_size * hd)
+    kp = flat.reshape(pages, page_size, hd)
+    vp = np.roll(flat, 7).reshape(pages, page_size, hd)
+    batch, pps = 5, 6
+    bt = rs.randint(0, pages, (batch, pps)).astype(np.int32)
+    ctx = np.array([1, 0, 17, 48, 33], np.int32)
+    q = (np.resize(deq, batch * 8 * 128)
+         .reshape(batch, 8, 128) * 3.0).astype(np.float32)
+    work = build_decode_work_list([-(-int(c) // page_size)
+                                   for c in ctx], 2)
+    outs = {}
+    for label, amla in (("amla", True), ("classic", False)):
+        outs[label] = np.asarray(paged_decode_attention(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(bt), jnp.asarray(ctx), scale=0.0884,
+            pages_per_chunk=2, work_items=work, amla=amla,
+            interpret=True))
+    report["attention"] = {
+        "amla_vs_classic_max_ulp": _ulp(outs["amla"],
+                                        outs["classic"]),
+        "ragged_ctx": ctx.tolist(),
+    }
+    return report
+
+
+@pytest.fixture(scope="module")
+def report():
+    return drift_report()
+
+
+def test_amla_rescale_zero_ulp_on_real_weights(report):
+    """The AMLA exponent-bias add and the classic multiply are
+    bit-identical on real-weight-derived KV (the correction is an
+    exact power of two either way)."""
+    assert report["attention"]["amla_vs_classic_max_ulp"] == 0
+
+
+def test_streamed_folded_w4a8_bounded_ulp_drift(report):
+    """Streamed (in-kernel quantization + parity-plane flush) vs the
+    classic host-quantized W4A8 path: the integer dots are exact and
+    shared, so the only drift sources are f32 summation order and a
+    possible 1-ulp row-scale difference (in-kernel divide vs host
+    chain). Measured 0-1 ulp on the real fixture (W4A8_DRIFT_r06);
+    bounded at 64 ulp / 1e-4 relative for lowering-variation
+    headroom."""
+    for name, stats in report["layers"].items():
+        assert stats["streamed_vs_classic_max_ulp"] <= 64, (
+            name, stats)
+        assert stats["streamed_vs_classic_rel"] < 1e-4, (name, stats)
+        assert stats["streamed_deferred_vs_classic_max_ulp"] <= 64, (
+            name, stats)
+
+
+def test_all_paths_within_w4a8_tolerance_of_oracle(report):
+    """Every variant stays inside the W4A8 activation-rounding budget
+    vs the independent numpy dequantization oracle, and the bit-exact
+    W4A16 streamed path stays at f32-accumulation tolerance."""
+    for name, stats in report["layers"].items():
+        assert stats["w4a16_stream_vs_dense_oracle_rel"] < 2e-5, (
+            name, stats)
+        assert stats["w4a8_classic_vs_oracle_rel"] < 2e-2, (name, stats)
+        assert stats["w4a8_streamed_vs_oracle_rel"] < 2e-2, (
+            name, stats)
+
+
+def main() -> None:
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--capture", type=str, required=True,
+                    help="write the drift artifact JSON here")
+    args = ap.parse_args()
+    rep = drift_report()
+    rep["comment"] = (
+        "Round-7 real-weights drift gate (VERDICT r5 #6): checked-in "
+        "tiny AutoGPTQ-math fixture through the streamed folded-"
+        "prologue W4A8 / parity-plane flush / AMLA attention paths vs "
+        "the classic references; asserted by tests/quantization/"
+        "test_real_weights_drift.py (slow marker). ULP = ordered-int "
+        "f32 distance.")
+    with open(args.capture, "w", encoding="utf-8") as f:
+        json.dump(rep, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {args.capture}")
+
+
+if __name__ == "__main__":
+    main()

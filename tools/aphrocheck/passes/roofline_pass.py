@@ -267,7 +267,13 @@ def _ring_slot_bytes(module: Module, ev: IntervalEvaluator,
     idiom every kernel in this repo uses), one SLOT's bytes move per
     cell. Dim matching is by resolved value OR by expression identity
     (`n_slots` as a helper parameter resolves to no exact int, but a
-    VMEM lead spelled with the same expression IS the same ring).
+    VMEM lead spelled with the same expression IS the same ring) —
+    EXCEPT for integer-literal leads, which only match literal sem
+    leads: a slot-indexed accumulator plane (`(2, bm, bn)` — the
+    double-buffered-flush idiom ROOF003 prescribes) is compute
+    scratch, not a DMA landing slot, and must not count as ring
+    traffic when a calibration binding resolves the ring depth to the
+    same small integer.
     Returns (bytes, has_ring, deepest resolved depth or None)."""
     entries = _scratch_entries(module, site, variant)
     sem_entries = []
@@ -295,7 +301,10 @@ def _ring_slot_bytes(module: Module, ev: IntervalEvaluator,
             continue
         lead_node = entry.args[0].elts[0]
         lead_exact = ev.eval(lead_node, entry).exact
-        if ast.dump(lead_node) not in sem_dumps and \
+        if isinstance(lead_node, ast.Constant):
+            if ast.dump(lead_node) not in sem_dumps:
+                continue            # literal lead: parity/compute plane
+        elif ast.dump(lead_node) not in sem_dumps and \
                 (lead_exact is None or lead_exact not in sem_exacts):
             continue
         width = dtype_bytes(entry.args[1]) if len(entry.args) > 1 \
