@@ -449,6 +449,23 @@ _register(Flag(
     minimum=0))
 
 _register(Flag(
+    "APHRODITE_ROUTER_JOURNAL_TOKENS", "int", 4096,
+    "Fleet router per-stream journal bound: max emitted token ids "
+    "journaled for one in-flight stream (the state a mid-stream "
+    "failover resumes from). A stream that outgrows the bound stops "
+    "journaling and falls back to truthful truncation on replica "
+    "death. 0 disables stream journaling entirely.",
+    minimum=0))
+
+_register(Flag(
+    "APHRODITE_ROUTER_JOURNAL_STREAMS", "int", 256,
+    "Fleet router fleet-wide journal bound: max concurrently "
+    "journaled streams. Streams past the cap are proxied without a "
+    "journal (mid-stream replica death truncates truthfully instead "
+    "of resuming).",
+    minimum=0))
+
+_register(Flag(
     "APHRODITE_PREEMPT_BUDGET", "int", 4,
     "Max RECOMPUTE/SWAP preemptions per scheduling round; decode "
     "rows that still lack a free page past the budget skip the round "

@@ -48,6 +48,8 @@ class RequestOutput:
         prompt_logprobs: Optional[PromptLogprobs],
         outputs: List[CompletionOutput],
         finished: bool,
+        resumed_tokens: int = 0,
+        resumed_text: str = "",
     ) -> None:
         self.request_id = request_id
         self.prompt = prompt
@@ -55,6 +57,12 @@ class RequestOutput:
         self.prompt_logprobs = prompt_logprobs
         self.outputs = outputs
         self.finished = finished
+        # Continuation baseline (engine resume seam): output tokens /
+        # text already delivered by a prior incarnation of this
+        # request — `outputs[].token_ids`/`.text` INCLUDE them, and a
+        # resuming frontend streams only what lies beyond.
+        self.resumed_tokens = resumed_tokens
+        self.resumed_text = resumed_text
 
     @classmethod
     def from_seq_group(cls, seq_group: SequenceGroup) -> "RequestOutput":
@@ -91,6 +99,8 @@ class RequestOutput:
             prompt_logprobs=seq_group.prompt_logprobs,
             outputs=outputs,
             finished=seq_group.is_finished(),
+            resumed_tokens=seq_group.resumed_tokens,
+            resumed_text=seq_group.resumed_text,
         )
 
     def __repr__(self) -> str:

@@ -218,6 +218,13 @@ class SequenceGroup:
         # the scheduler expires the group if it is still waiting,
         # never computed, past this instant. None = no deadline.
         self.deadline = deadline
+        # Mid-stream continuation (engine resume seam): how many
+        # output tokens were already emitted to the client by a prior
+        # incarnation of this request, and the text they detokenized
+        # to — frontends resume their delta stream from this baseline
+        # instead of re-emitting the spliced prefix.
+        self.resumed_tokens: int = 0
+        self.resumed_text: str = ""
         self.prompt_logprobs: Optional[PromptLogprobs] = None
         # Latency stamps (reference RequestMetrics): written by the
         # engine as tokens arrive, drained by _get_stats.

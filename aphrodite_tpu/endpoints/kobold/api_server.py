@@ -21,7 +21,10 @@ from aphrodite_tpu.common.utils import random_uuid
 from aphrodite_tpu.endpoints.kobold.protocol import KAIGenerationInputSchema
 from aphrodite_tpu.endpoints.utils import (install_lifecycle,
                                            request_disconnected,
-                                           retry_after_headers)
+                                           resume_denied,
+                                           resume_token_ids,
+                                           retry_after_headers,
+                                           stream_journal)
 from aphrodite_tpu.engine.args_tools import AsyncEngineArgs
 from aphrodite_tpu.engine.async_aphrodite import AsyncAphrodite
 from aphrodite_tpu.processing.admission import (EngineDrainingError,
@@ -207,34 +210,56 @@ class KoboldServer:
     async def generate_stream(self,
                               request: web.Request) -> web.StreamResponse:
         try:
-            payload = await self._parse(request)
+            raw_body = await request.json()
+            payload = KAIGenerationInputSchema(**raw_body)
             sampling_params, input_tokens = self._prepare(payload)
+            emitted = resume_token_ids(raw_body)
         except (ValidationError, ValueError) as e:
             return web.json_response({"detail": str(e)}, status=422)
+        if emitted is not None:
+            # Continuation (router-internal): admin-key-gated,
+            # single-sequence only.
+            denied = resume_denied(request, self.admin_keys)
+            if denied is not None:
+                return denied
+            if (payload.n or 1) != 1:
+                return web.json_response(
+                    {"detail": "aphrodite_resume supports "
+                               "single-sequence requests only"},
+                    status=422)
 
         # Admit before the SSE prelude so sheds are real 429s.
         try:
             stream = await self.engine.add_request(
                 payload.genkey, None, sampling_params,
-                prompt_token_ids=input_tokens)
+                prompt_token_ids=input_tokens,
+                emitted_token_ids=emitted)
         except RequestRejectedError as e:
             return _overloaded(e)
         except EngineDrainingError as e:
             return _draining(e)
+        journal = stream_journal(request,
+                                 resumed_tokens=len(emitted or ()))
         response = web.StreamResponse(headers={
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
             "Connection": "keep-alive",
         })
         await response.prepare(request)
-        previous_output = ""
+        previous_output = None
         try:
             async for res in stream:
                 if await request_disconnected(request):
                     stream.cancel()
                     return response
+                if previous_output is None:
+                    previous_output = res.resumed_text if emitted else ""
                 new_chunk = res.outputs[0].text[len(previous_output):]
                 previous_output = res.outputs[0].text
+                if journal is not None:
+                    await response.write(journal.record(
+                        res.outputs[0].token_ids,
+                        res.outputs[0].finish_reason))
                 await response.write(b"event: message\n")
                 await response.write(
                     f"data: "

@@ -20,7 +20,10 @@ from aphrodite_tpu.common.sampling_params import SamplingParams
 from aphrodite_tpu.common.utils import random_uuid
 from aphrodite_tpu.endpoints.utils import (install_lifecycle,
                                            request_disconnected,
-                                           retry_after_headers)
+                                           resume_denied,
+                                           resume_token_ids,
+                                           retry_after_headers,
+                                           stream_journal)
 from aphrodite_tpu.engine.args_tools import AsyncEngineArgs
 from aphrodite_tpu.engine.async_aphrodite import AsyncAphrodite
 from aphrodite_tpu.processing.admission import (EngineDrainingError,
@@ -66,6 +69,23 @@ class OobaServer:
             return web.json_response({"detail": "prompt is required"},
                                      status=422)
         stream = body.pop("stream", False)
+        try:
+            emitted = resume_token_ids(body)
+        except ValueError as e:
+            return web.json_response({"detail": str(e)}, status=422)
+        body.pop("aphrodite_resume", None)
+        if emitted is not None:
+            # Continuation (router-internal): admin-key-gated,
+            # streaming + single-sequence only.
+            denied = resume_denied(request, self.admin_keys)
+            if denied is not None:
+                return denied
+            if not stream or (body.get("n") or 1) != 1 or \
+                    (body.get("best_of") or 1) > 1 or \
+                    body.get("use_beam_search"):
+                return web.json_response(
+                    {"detail": "aphrodite_resume requires a streamed "
+                               "single-sequence request"}, status=422)
 
         # Ooba field aliases (reference :59-68).
         if "stopping_strings" in body:
@@ -101,13 +121,16 @@ class OobaServer:
             # Admit before streaming starts so sheds are real 429s.
             try:
                 out_stream = await self.engine.add_request(
-                    request_id, prompt, sampling_params)
+                    request_id, prompt, sampling_params,
+                    emitted_token_ids=emitted)
             except RequestRejectedError as e:
                 return web.json_response(
                     {"detail": str(e)}, status=429,
                     headers=retry_after_headers(e.retry_after_s))
             except EngineDrainingError as e:
                 return _draining(e)
+            journal = stream_journal(request,
+                                     resumed_tokens=len(emitted or ()))
             response = web.StreamResponse()
             await response.prepare(request)
             try:
@@ -115,9 +138,12 @@ class OobaServer:
                     if await request_disconnected(request):
                         out_stream.cancel()
                         return response
+                    outs = request_output.outputs
+                    if journal is not None and len(outs) == 1:
+                        await response.write(journal.record(
+                            outs[0].token_ids, outs[0].finish_reason))
                     ret = {"results": [{"text": out.text}
-                                       for out in
-                                       request_output.outputs]}
+                                       for out in outs]}
                     await response.write(
                         (json.dumps(ret) + "\n\n").encode())
             except (RequestTimeoutError, EngineDrainingError) as e:

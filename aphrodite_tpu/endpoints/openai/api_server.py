@@ -43,7 +43,10 @@ from aphrodite_tpu.endpoints.openai.protocol import (
     ModelPermission, TokenizeRequest, TokenizeResponse, UsageInfo)
 from aphrodite_tpu.endpoints.utils import (install_lifecycle,
                                            request_disconnected,
-                                           retry_after_headers)
+                                           resume_denied,
+                                           resume_token_ids,
+                                           retry_after_headers,
+                                           stream_journal)
 from aphrodite_tpu.engine.args_tools import AsyncEngineArgs
 from aphrodite_tpu.engine.async_aphrodite import AsyncAphrodite
 from aphrodite_tpu.processing.admission import (EngineDrainingError,
@@ -221,6 +224,30 @@ class OpenAIServer:
                           "model_not_found", 404)
         return None
 
+    def _check_resume(self, request: web.Request, req):
+        """(emitted_token_ids, None) for a valid continuation request,
+        (None, None) for a plain one, (None, error response) when the
+        resume extension is unauthorized or malformed. The extension
+        is router-internal: admin-key-gated, streaming-only,
+        single-sequence-only."""
+        if req.aphrodite_resume is None:
+            return None, None
+        denied = resume_denied(request, self.admin_keys)
+        if denied is not None:
+            return None, denied
+        try:
+            emitted = resume_token_ids(
+                {"aphrodite_resume": req.aphrodite_resume})
+        except ValueError as e:
+            return None, _error(str(e))
+        if not req.stream:
+            return None, _error("aphrodite_resume requires stream=true")
+        if (req.n or 1) != 1 or (req.best_of or 1) > 1 or \
+                req.use_beam_search:
+            return None, _error("aphrodite_resume supports "
+                                "single-sequence requests only")
+        return emitted, None
+
     async def _build_processors(self, req) -> Optional[list]:
         processors = []
         if req.logit_bias:
@@ -282,10 +309,15 @@ class OpenAIServer:
         except ValueError as e:
             return _error(str(e))
 
+        emitted, err = self._check_resume(request, req)
+        if err is not None:
+            return err
+
         request_id = f"cmpl-{random_uuid()}"
         if req.stream:
             return await self._stream_completion(
-                request, req, sampling_params, prompts[0], request_id)
+                request, req, sampling_params, prompts[0], request_id,
+                emitted=emitted)
 
         async def consume(i: int, prompt) -> Optional[RequestOutput]:
             """Drain one generator; all prompts run CONCURRENTLY so the
@@ -345,7 +377,8 @@ class OpenAIServer:
         return web.json_response(resp.model_dump())
 
     async def _stream_completion(self, request, req, sampling_params,
-                                 prompt, request_id) -> web.StreamResponse:
+                                 prompt, request_id,
+                                 emitted=None) -> web.StreamResponse:
         kwargs = dict(prompt_token_ids=prompt) \
             if isinstance(prompt, list) else dict()
         text = None if isinstance(prompt, list) else prompt
@@ -354,11 +387,14 @@ class OpenAIServer:
         # event stream.
         try:
             stream = await self.engine.add_request(
-                request_id, text, sampling_params, **kwargs)
+                request_id, text, sampling_params,
+                emitted_token_ids=emitted, **kwargs)
         except RequestRejectedError as e:
             return _overloaded(e)
         except EngineDrainingError as e:
             return _draining(e)
+        journal = stream_journal(request,
+                                 resumed_tokens=len(emitted or ()))
         response = _sse_response()
         await response.prepare(request)
         previous_texts = {}
@@ -370,9 +406,16 @@ class OpenAIServer:
                     stream.cancel()
                     return response
                 for out in output.outputs:
-                    prev = previous_texts.get(out.index, "")
+                    prev = previous_texts.get(out.index)
+                    if prev is None:
+                        # A continuation's baseline was already
+                        # delivered by the pre-failover replica.
+                        prev = output.resumed_text if emitted else ""
                     delta = out.text[len(prev):]
                     previous_texts[out.index] = out.text
+                    if journal is not None and len(output.outputs) == 1:
+                        await response.write(journal.record(
+                            out.token_ids, out.finish_reason))
                     chunk = CompletionStreamResponse(
                         id=request_id, model=req.model,
                         choices=[CompletionResponseStreamChoice(
@@ -437,10 +480,15 @@ class OpenAIServer:
         except ValueError as e:
             return _error(str(e))
 
+        emitted, resume_err = self._check_resume(request, req)
+        if resume_err is not None:
+            return resume_err
+
         request_id = f"chatcmpl-{random_uuid()}"
         if req.stream:
             return await self._stream_chat(request, req, sampling_params,
-                                           prompt, request_id)
+                                           prompt, request_id,
+                                           emitted=emitted)
 
         final: Optional[RequestOutput] = None
         try:
@@ -475,22 +523,29 @@ class OpenAIServer:
         return web.json_response(resp.model_dump())
 
     async def _stream_chat(self, request, req, sampling_params, prompt,
-                           request_id) -> web.StreamResponse:
+                           request_id, emitted=None) -> web.StreamResponse:
         # Admit before the SSE prelude so sheds are real 429s.
         try:
             stream = await self.engine.add_request(
-                request_id, prompt, sampling_params)
+                request_id, prompt, sampling_params,
+                emitted_token_ids=emitted)
         except RequestRejectedError as e:
             return _overloaded(e)
         except EngineDrainingError as e:
             return _draining(e)
+        journal = stream_journal(request,
+                                 resumed_tokens=len(emitted or ()))
         response = _sse_response()
         await response.prepare(request)
-        first = ChatCompletionStreamResponse(
-            id=request_id, model=req.model,
-            choices=[ChatCompletionResponseStreamChoice(
-                index=0, delta=DeltaMessage(role=self.response_role))])
-        await _sse_send(response, first.model_dump(exclude_unset=True))
+        if not emitted:
+            # A continuation splices into a stream whose client
+            # already received the role prelude — never re-send it.
+            first = ChatCompletionStreamResponse(
+                id=request_id, model=req.model,
+                choices=[ChatCompletionResponseStreamChoice(
+                    index=0,
+                    delta=DeltaMessage(role=self.response_role))])
+            await _sse_send(response, first.model_dump(exclude_unset=True))
         previous_texts = {}
         try:
             async for output in stream:
@@ -498,9 +553,14 @@ class OpenAIServer:
                     stream.cancel()
                     return response
                 for out in output.outputs:
-                    prev = previous_texts.get(out.index, "")
+                    prev = previous_texts.get(out.index)
+                    if prev is None:
+                        prev = output.resumed_text if emitted else ""
                     delta = out.text[len(prev):]
                     previous_texts[out.index] = out.text
+                    if journal is not None and len(output.outputs) == 1:
+                        await response.write(journal.record(
+                            out.token_ids, out.finish_reason))
                     chunk = ChatCompletionStreamResponse(
                         id=request_id, model=req.model,
                         choices=[ChatCompletionResponseStreamChoice(

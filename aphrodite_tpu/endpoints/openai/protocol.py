@@ -155,6 +155,10 @@ class ChatCompletionRequest(_SamplerFields):
     echo: Optional[bool] = False
     temperature: Optional[float] = 0.7
     grammar: Optional[str] = None
+    # Aphrodite extension (router-internal, admin-key-gated): resume a
+    # journaled stream mid-generation on this replica. See
+    # endpoints/utils.resume_token_ids for the shape.
+    aphrodite_resume: Optional[Dict[str, object]] = None
 
 
 class CompletionRequest(_SamplerFields):
@@ -165,6 +169,9 @@ class CompletionRequest(_SamplerFields):
     max_tokens: Optional[int] = 16
     echo: Optional[bool] = False
     grammar: Optional[str] = None
+    # Aphrodite extension (router-internal, admin-key-gated): resume a
+    # journaled stream mid-generation on this replica.
+    aphrodite_resume: Optional[Dict[str, object]] = None
 
 
 class LogProbs(BaseModel):

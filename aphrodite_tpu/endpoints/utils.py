@@ -22,15 +22,31 @@ way:
 from __future__ import annotations
 
 import asyncio
+import datetime
+import email.utils
+import json
 import math
 import signal
-from typing import List, Optional
+from typing import List, Optional, Sequence
 
 from aiohttp import web
 
 from aphrodite_tpu.common.logger import init_logger
 
 logger = init_logger(__name__)
+
+#: Request header the fleet router sets on proxied token streams to
+#: ask the frontend for journal records (see :class:`StreamJournal`).
+JOURNAL_HEADER = "X-Aphrodite-Stream-Journal"
+#: Request header carrying the admin key that authorizes the
+#: continuation (resume) extension — deliberately separate from the
+#: client-facing ``Authorization`` header, which is proxied verbatim.
+RESUME_KEY_HEADER = "X-Aphrodite-Resume-Key"
+#: Wire prefix of a journal record line. SSE clients ignore ":"
+#: comment lines by spec, and the router strips them before any byte
+#: reaches the client, so the records are invisible on every frontend
+#: protocol (including Ooba's bare newline-delimited JSON).
+JOURNAL_LINE_PREFIX = b": aphrodite-journal "
 
 _SIGTERM_INSTALLED = web.AppKey("aphrodite_sigterm_installed", bool)
 #: The in-flight SIGTERM drain task, retained on the app so it cannot
@@ -59,15 +75,118 @@ def retry_after_headers(seconds: float) -> dict:
 def parse_retry_after(headers) -> Optional[float]:
     """Inverse of :func:`retry_after_headers`: the `Retry-After` value
     of a response header mapping as seconds, or None when absent or
-    malformed (HTTP-date forms are not produced by these frontends and
-    parse as None). The fleet router uses this to pace its retries."""
+    malformed. Both RFC 7231 wire forms parse: delta-seconds (what
+    these frontends emit) and HTTP-date (an intermediate proxy can
+    legally rewrite the header to one; it must not silently become
+    "no hint"). The fleet router uses this to pace its retries."""
     raw = headers.get("Retry-After") if headers is not None else None
     if raw is None:
         return None
+    text = str(raw).strip()
     try:
-        return max(0.0, float(str(raw).strip()))
+        return max(0.0, float(text))
     except ValueError:
+        pass
+    try:
+        when = email.utils.parsedate_to_datetime(text)
+    except (TypeError, ValueError):
         return None
+    if when is None:
+        return None
+    if when.tzinfo is None:     # RFC 5322 "-0000": treat as UTC
+        when = when.replace(tzinfo=datetime.timezone.utc)
+    now = datetime.datetime.now(datetime.timezone.utc)
+    return max(0.0, (when - now).total_seconds())
+
+
+# --------------------------------------------------------------------
+# Mid-stream failover: the journal / resume wire contract
+# (router-internal — see README "Fleet · failover semantics").
+#
+# Journaled stream: when a request carries ``JOURNAL_HEADER``, the
+# streaming handler precedes every token-bearing write with ONE
+# journal record line::
+#
+#     : aphrodite-journal {"t":[<new ids>],"n":<joint count>[,"fin":r]}
+#
+# The router commits a record to its per-stream journal only once the
+# record's data line was actually forwarded to the client, so the
+# journal is exactly the set of tokens the client received.
+#
+# Continuation: on mid-stream replica death the router re-issues the
+# ORIGINAL request body plus ``{"aphrodite_resume": {"emitted_token_ids":
+# [...]}}`` (and ``RESUME_KEY_HEADER``) to a healthy peer; the handler
+# rebuilds the request as a continuation (engine resume seam) and
+# streams only the deltas past the resumed baseline.
+# --------------------------------------------------------------------
+
+
+class StreamJournal:
+    """Per-stream journal-record emitter for a frontend's token
+    stream. Tracks how many output tokens have been recorded so each
+    :meth:`record` carries only the NEW ids (a resumed stream starts
+    at its continuation baseline)."""
+
+    def __init__(self, start: int = 0) -> None:
+        self._sent = int(start)
+
+    def record(self, token_ids: Sequence[int],
+               finish_reason: Optional[str] = None) -> bytes:
+        """The journal line to write immediately BEFORE the data
+        chunk that delivers `token_ids[self._sent:]`."""
+        new = [int(t) for t in token_ids[self._sent:]]
+        self._sent = len(token_ids)
+        rec = {"t": new, "n": self._sent}
+        if finish_reason is not None:
+            rec["fin"] = finish_reason
+        return JOURNAL_LINE_PREFIX + json.dumps(
+            rec, separators=(",", ":")).encode() + b"\n"
+
+
+def stream_journal(request: web.Request,
+                   resumed_tokens: int = 0) -> Optional[StreamJournal]:
+    """A :class:`StreamJournal` when the request asked for one (the
+    fleet router's ``JOURNAL_HEADER``), else None."""
+    if request.headers.get(JOURNAL_HEADER, "") not in ("", "0"):
+        return StreamJournal(start=resumed_tokens)
+    return None
+
+
+def resume_token_ids(body) -> Optional[List[int]]:
+    """The continuation extension's emitted token ids from a parsed
+    request body, or None when the body carries no extension. Raises
+    ValueError on a malformed extension (the caller maps it to a 4xx
+    — a garbled resume must never silently restart from scratch)."""
+    if not isinstance(body, dict):
+        return None
+    ext = body.get("aphrodite_resume")
+    if ext is None:
+        return None
+    ids = ext.get("emitted_token_ids") if isinstance(ext, dict) else None
+    if not isinstance(ids, list) or \
+            not all(isinstance(t, int) and not isinstance(t, bool)
+                    for t in ids):
+        raise ValueError(
+            "aphrodite_resume must be "
+            "{\"emitted_token_ids\": [<int>, ...]}")
+    return list(ids)
+
+
+def resume_denied(request: web.Request,
+                  admin_keys: Optional[List[str]]
+                  ) -> Optional[web.Response]:
+    """Gate for the continuation extension: it is router-internal,
+    never public — 403 when the server has no admin keys, 401 when
+    the request's ``RESUME_KEY_HEADER`` does not match. None = allowed."""
+    if not admin_keys:
+        return web.json_response(
+            {"detail": "stream resume is disabled: start the server "
+                       "with --admin-key"}, status=403)
+    key = request.headers.get(RESUME_KEY_HEADER, "").strip()
+    if key not in admin_keys:
+        return web.json_response({"detail": "invalid resume key"},
+                                 status=401)
+    return None
 
 
 def probe_body(engine) -> dict:

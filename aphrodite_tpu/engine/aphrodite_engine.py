@@ -163,6 +163,14 @@ class AphroditeEngine:
         # that is atomic under the GIL; the two writers never run
         # concurrently.
         self._step_faults: List[Tuple[str, Exception]] = []
+        # Continuations whose emitted output already satisfied a stop
+        # condition on arrival: finished groups whose RequestOutput
+        # the next step delivers without scheduling any device work.
+        # thread-safe: same sequencing as _step_faults — the loop
+        # appends (add_request) strictly BETWEEN the awaits that run
+        # step(), and step() drains via an atomic list swap; the two
+        # writers never run concurrently.
+        self._arrival_finished: List[SequenceGroup] = []
         # SchedulerOutputs committed by the current step (several when
         # the step pipelines builder rounds) — the crash barrier's
         # rollback scope.
@@ -236,9 +244,36 @@ class AphroditeEngine:
         arrival_time: Optional[float] = None,
         prefix_pos: Optional[int] = None,
         lora_request=None,
+        emitted_token_ids: Optional[List[int]] = None,
     ) -> None:
         """Tokenize, build the seq group, hand to the scheduler
-        (reference add_request :387-469)."""
+        (reference add_request :387-469).
+
+        `emitted_token_ids` is the CONTINUATION form (the mid-stream
+        failover resume seam): the request previously generated these
+        output tokens on another replica (or a prior incarnation) and
+        must continue from them. The tokens enter the sequence as
+        already-sampled OUTPUT tokens, so:
+
+        - chunked prefill rebuilds their KV exactly like a RECOMPUTE-
+          preempted request (the "prompt" is original + generated, and
+          prefix-cache hits make the rebuild cheap);
+        - the sampler's seeded per-row PRNG salt — derived from the
+          OUTPUT length (`sampler._key_parts`) — continues at position
+          n, so seeded requests resume bit-identically;
+        - `max_tokens`, stop strings, EOS, and length penalties are
+          evaluated over the JOINT output (baseline text included, so
+          a stop string may span the splice boundary);
+        - incremental detokenization replays the emitted tokens
+          through the same per-token path the original stream took,
+          so the continuation resumes mid-word cleanly and
+          `resumed_text` is byte-equal to what the client already
+          received.
+
+        A continuation whose emitted output already satisfies a stop
+        condition is resolved on arrival (its finished RequestOutput
+        is delivered by the next step without scheduling any work).
+        """
         if lora_request is not None and not self.lora_config:
             raise ValueError("LoRA is not enabled (set enable_lora).")
         if arrival_time is None:
@@ -252,6 +287,21 @@ class AphroditeEngine:
         seq = Sequence(seq_id, prompt, prompt_token_ids, block_size,
                        lora_request=lora_request)
 
+        if emitted_token_ids:
+            if (sampling_params.n > 1 or sampling_params.best_of > 1
+                    or sampling_params.use_beam_search):
+                raise ValueError(
+                    "continuation (emitted_token_ids) supports "
+                    "single-sequence requests only (n=1, best_of=1, "
+                    "no beam search)")
+            # Replay the emitted tokens through the exact per-token
+            # append + incremental-detok path the original stream
+            # took: identical detok state evolution means identical
+            # text, so the resumed deltas splice mid-word cleanly.
+            for tid in emitted_token_ids:
+                seq.append_token_id(int(tid), {int(tid): 0.0})
+                self._decode_sequence(seq, sampling_params)
+
         prefix = None
         if prefix_pos is not None:
             prefix = self.scheduler.prefix_pool.intern(
@@ -262,6 +312,24 @@ class AphroditeEngine:
                                   lora_request=lora_request,
                                   deadline=self._deadline_of(
                                       sampling_params, arrival_time))
+        if emitted_token_ids:
+            seq_group.resumed_tokens = len(emitted_token_ids)
+            # The joint output may already satisfy a stop condition
+            # (the original replica died between its last token and
+            # the stream's closing writes): resolve on arrival
+            # instead of scheduling a round that would overrun the
+            # stop. The baseline text is captured AFTER the stop
+            # check, which strips a matched stop string exactly like
+            # the original stream did before the client saw it.
+            self._check_stop(seq, sampling_params)
+            seq_group.resumed_text = seq.output_text
+            if not seq.is_finished() and \
+                    sampling_params.max_tokens is not None and \
+                    seq.get_output_len() >= sampling_params.max_tokens:
+                seq.status = SequenceStatus.FINISHED_LENGTH_CAPPED
+            if seq.is_finished():
+                self._arrival_finished.append(seq_group)
+                return
         self.scheduler.add_seq_group(seq_group)
 
     @staticmethod
@@ -355,10 +423,14 @@ class AphroditeEngine:
         return self.model_config
 
     def get_num_unfinished_requests(self) -> int:
-        return self.scheduler.get_num_unfinished_seq_groups()
+        # Arrival-resolved continuations count until step() delivers
+        # their outputs (a caller looping on this must keep stepping).
+        return (self.scheduler.get_num_unfinished_seq_groups() +
+                len(self._arrival_finished))
 
     def has_unfinished_requests(self) -> bool:
-        return self.scheduler.has_unfinished_seqs()
+        return bool(self._arrival_finished) or \
+            self.scheduler.has_unfinished_seqs()
 
     # -- the step --
 
@@ -384,10 +456,24 @@ class AphroditeEngine:
         seq_group_metadata_list, scheduler_outputs = \
             self.scheduler.schedule()
         self._inflight_rounds.append(scheduler_outputs)
+        # Continuations resolved on arrival (emitted output already at
+        # a stop): deliver their finished outputs ahead of the round.
+        # Drained only once scheduling succeeded, so a mid-schedule
+        # crash retries with them still stashed.
+        resolved: List[SequenceGroup] = []
+        if self._arrival_finished:
+            resolved, self._arrival_finished = self._arrival_finished, []
         try:
-            return self._execute_round(seq_group_metadata_list,
-                                       scheduler_outputs)
+            outputs = self._execute_round(seq_group_metadata_list,
+                                          scheduler_outputs)
+            if resolved:
+                outputs = [RequestOutput.from_seq_group(g)
+                           for g in resolved] + outputs
+            return outputs
         except Exception as exc:
+            # Re-stash arrival-resolved outputs so a retried step (or
+            # the reincarnation restore) still delivers them.
+            self._arrival_finished = resolved + self._arrival_finished
             if self._step_tls.epoch != self._epoch:
                 # The engine reincarnated under this step (a watchdog-
                 # abandoned thread waking up): the rounds it holds

@@ -260,7 +260,8 @@ class RequestTracker:
         self._pending_new += 1
         self._pending_tokens += AsyncAphrodite._estimate_prompt_tokens(
             engine_add_request_kwargs.get("prompt"),
-            engine_add_request_kwargs.get("prompt_token_ids"))
+            engine_add_request_kwargs.get("prompt_token_ids"),
+            engine_add_request_kwargs.get("emitted_token_ids"))
         if self.new_requests_event is not None:
             self.new_requests_event.set()
         return stream
@@ -567,6 +568,7 @@ class AsyncAphrodite:
         prompt_token_ids: Optional[List[int]] = None,
         arrival_time: Optional[float] = None,
         prefix_pos: Optional[int] = None,
+        emitted_token_ids: Optional[List[int]] = None,
     ) -> AsyncStream:
         if self.log_requests:
             max_len = self.max_log_len if self.max_log_len is not None \
@@ -607,7 +609,8 @@ class AsyncAphrodite:
             try:
                 self.engine.try_admit(
                     self._estimate_prompt_tokens(prompt,
-                                                 prompt_token_ids),
+                                                 prompt_token_ids,
+                                                 emitted_token_ids),
                     sampling_params, extra_depth=pending_depth,
                     extra_tokens=pending_tokens)
             except RequestRejectedError:
@@ -628,7 +631,8 @@ class AsyncAphrodite:
             sampling_params=sampling_params,
             prompt_token_ids=prompt_token_ids,
             arrival_time=arrival_time or time.monotonic(),
-            prefix_pos=prefix_pos)
+            prefix_pos=prefix_pos,
+            emitted_token_ids=emitted_token_ids)
         self._idle_event.clear()     # no longer idle: work arrived
         return stream
 
@@ -639,12 +643,14 @@ class AsyncAphrodite:
         request_id: str,
         prompt_token_ids: Optional[List[int]] = None,
         prefix_pos: Optional[int] = None,
+        emitted_token_ids: Optional[List[int]] = None,
     ) -> AsyncIterator[RequestOutput]:
         """Stream RequestOutputs for one request (reference `:469`)."""
         try:
             stream = await self.add_request(
                 request_id, prompt, sampling_params,
-                prompt_token_ids=prompt_token_ids, prefix_pos=prefix_pos)
+                prompt_token_ids=prompt_token_ids, prefix_pos=prefix_pos,
+                emitted_token_ids=emitted_token_ids)
             async for request_output in stream:
                 yield request_output
         except GeneratorExit:
@@ -760,15 +766,18 @@ class AsyncAphrodite:
 
     @staticmethod
     def _estimate_prompt_tokens(prompt: Optional[str],
-                                prompt_token_ids: Optional[List[int]]
-                                ) -> int:
+                                prompt_token_ids: Optional[List[int]],
+                                emitted_token_ids: Optional[List[int]]
+                                = None) -> int:
         """Admission-sizing estimate (tokenization happens later, on
         the engine loop): exact for token-id prompts, ~4 chars/token
-        for text. Admission caps are coarse backlog bounds, so the
+        for text. A continuation's emitted tokens prefill too, so they
+        count. Admission caps are coarse backlog bounds, so the
         estimate only needs to be the right order of magnitude."""
+        emitted = len(emitted_token_ids or ())
         if prompt_token_ids is not None:
-            return len(prompt_token_ids)
-        return max(1, len(prompt or "") // 4)
+            return len(prompt_token_ids) + emitted
+        return max(1, len(prompt or "") // 4) + emitted
 
     async def get_model_config(self) -> ModelConfig:
         return self.engine.get_model_config()

@@ -31,12 +31,30 @@ thread) composing four behaviors:
    total time capped by the request's ``ttft_slo_s``. The retry is
    idempotent by construction: rejection happens before any tokens
    stream (the client response is not even prepared until the first
-   upstream chunk arrives). Once streaming has begun the router
-   completes-or-fails that request truthfully and never re-issues
-   it. Replicas that fail at the connection level (or report DEAD)
-   are circuit-broken out of rotation for
+   upstream chunk arrives). Replicas that fail at the connection
+   level (or report DEAD) are circuit-broken out of rotation for
    ``APHRODITE_ROUTER_CB_WINDOW_S`` and re-admitted when their
    ``/health`` recovers.
+
+3b. **Mid-stream failover (journal + splice).** Once streaming has
+   begun a plain re-issue is forbidden (it would double-bill tokens
+   or splice two different generations) — so generation streams are
+   JOURNALED instead: the router asks the replica for interleaved
+   journal records (one ``: aphrodite-journal {...}`` comment line
+   per token-bearing chunk, stripped before any byte reaches the
+   client) and commits each record only once its data chunk was
+   actually forwarded, making the journal exactly the set of tokens
+   the client received. When the upstream dies mid-stream, the
+   router re-issues the ORIGINAL request plus the admin-key-gated
+   ``aphrodite_resume`` continuation extension to a healthy peer;
+   the peer rebuilds the context through chunked prefill (seeded
+   sampling continues bit-identically — the per-row PRNG salt is the
+   output position) and the router splices the new stream into the
+   client response EXACTLY ONCE, deduping on emitted count. Bounds:
+   ``APHRODITE_ROUTER_JOURNAL_TOKENS`` per stream and
+   ``APHRODITE_ROUTER_JOURNAL_STREAMS`` fleet-wide; past either (or
+   past the retry budget) the stream falls back to today's truthful
+   truncation, counted in ``truncated_client_streams``.
 
 4. **Zero-downtime rolling deploy.** Authed ``POST /admin/rollout``
    walks the fleet one replica at a time: cordon (no new picks) →
@@ -58,6 +76,7 @@ import asyncio
 import dataclasses
 import hashlib
 import json
+import os
 import time
 from typing import Any, Dict, Iterable, List, Optional, Sequence
 
@@ -66,7 +85,10 @@ from aiohttp import web
 
 from aphrodite_tpu.common import flags
 from aphrodite_tpu.common.logger import init_logger
-from aphrodite_tpu.endpoints.utils import (parse_retry_after,
+from aphrodite_tpu.endpoints.utils import (JOURNAL_HEADER,
+                                           JOURNAL_LINE_PREFIX,
+                                           RESUME_KEY_HEADER,
+                                           parse_retry_after,
                                            retry_after_headers)
 from aphrodite_tpu.fleet.replica import (ROUTABLE_STATES, ReplicaHandle,
                                          ReplicaSnapshot)
@@ -108,6 +130,8 @@ class RouterStats:
     served_streaming: int = 0
     served_buffered: int = 0
     failed_mid_stream: int = 0
+    resumed_mid_stream: int = 0
+    truncated_client_streams: int = 0
     rejected_no_replica: int = 0
     exhausted_relayed: int = 0
     rollouts_total: int = 0
@@ -149,6 +173,112 @@ def _rendezvous_score(key: str, name: str) -> int:
     return int.from_bytes(digest, "big")
 
 
+#: Proxied paths whose streamed responses are token streams the
+#: router journals for mid-stream failover (OpenAI completions/chat,
+#: Kobold's SSE stream, Ooba's newline-JSON stream).
+_GENERATION_PATHS = frozenset({
+    "/v1/completions", "/v1/chat/completions",
+    "/api/extra/generate/stream",
+    "/api/v1/generate", "/api/latest/generate",
+})
+
+
+@dataclasses.dataclass
+class _JournalContext:
+    """Everything a mid-stream failover needs to re-issue a request
+    as a continuation: the parsed original body, the target path, the
+    affinity key, and the per-stream token bound."""
+    body: Dict[str, Any]
+    rel_url: str
+    key: Optional[str]
+    max_tokens: int
+
+
+class _JournalTail:
+    """Router-side journal state + line parser for one proxied token
+    stream.
+
+    Splits the upstream byte stream into complete lines, siphons off
+    ``: aphrodite-journal`` records, and returns everything else for
+    verbatim forwarding. A record is COMMITTED (its token ids join
+    the journal) only when its data line is actually forwarded, so
+    ``tokens`` is exactly what the client received — the state a
+    continuation resumes from. Partial trailing lines are held back:
+    a mid-line death never leaks a torn event to the client, and the
+    regenerated token re-emits the event whole.
+
+    Exactly-once dedupe: a record whose cumulative count ``n`` is not
+    past the committed journal re-delivers tokens the client already
+    has (a replayed continuation); its data lines are suppressed.
+    """
+
+    def __init__(self, max_tokens: int) -> None:
+        # bounded-by: max_tokens (APHRODITE_ROUTER_JOURNAL_TOKENS);
+        # overflow flips `overflowed` and journaling stops.
+        self.tokens: List[int] = []
+        self.fin: Optional[str] = None
+        self.active = False          # >=1 committed record seen
+        self.overflowed = False
+        self.max_tokens = max_tokens
+        self._pending: Optional[Dict[str, Any]] = None
+        self._buf = b""
+        self._suppress = False
+
+    def feed(self, chunk: bytes) -> bytes:
+        self._buf += chunk
+        out = bytearray()
+        while True:
+            cut = self._buf.find(b"\n")
+            if cut < 0:
+                break
+            line, self._buf = self._buf[:cut + 1], self._buf[cut + 1:]
+            if line.startswith(JOURNAL_LINE_PREFIX):
+                self._on_record(line)
+                continue
+            if self._suppress and line.strip():
+                continue            # already delivered before failover
+            out += line
+            stripped = line.lstrip()
+            if self._pending is not None and (
+                    stripped.startswith(b"data:")
+                    or stripped.startswith(b"{")):
+                self._commit(self._pending)
+                self._pending = None
+        return bytes(out)
+
+    def flush(self) -> bytes:
+        """Any held-back tail (clean EOF without a final newline)."""
+        tail, self._buf = self._buf, b""
+        return tail if not self._suppress else b""
+
+    def _on_record(self, line: bytes) -> None:
+        try:
+            rec = json.loads(line[len(JOURNAL_LINE_PREFIX):])
+        except ValueError:
+            return                  # malformed record: ignore
+        if not isinstance(rec, dict):
+            return
+        if rec.get("t") and int(rec.get("n", 0)) <= len(self.tokens):
+            # Replayed tokens (the continuation overlapped the
+            # journal): drop their data lines — exactly-once splice.
+            self._suppress = True
+            self._pending = None
+            return
+        self._suppress = False
+        self._pending = rec
+
+    def _commit(self, rec: Dict[str, Any]) -> None:
+        self.active = True
+        try:
+            self.tokens.extend(int(t) for t in rec.get("t") or ())
+        except (TypeError, ValueError):
+            pass
+        if rec.get("fin"):
+            self.fin = str(rec["fin"])
+        if len(self.tokens) > self.max_tokens:
+            self.overflowed = True
+
+
 class FleetRouter:
     """Async HTTP router over N replica servers. Single-event-loop
     object: construct, ``await start()``, serve ``build_app()``."""
@@ -157,7 +287,8 @@ class FleetRouter:
                  admin_keys: Optional[List[str]] = None,
                  restart_cb=None,
                  prefix_key_chars: int = 256,
-                 prefix_key_tokens: int = 64) -> None:
+                 prefix_key_tokens: int = 64,
+                 name: Optional[str] = None) -> None:
         self._replicas: List[ReplicaHandle] = [
             r if isinstance(r, ReplicaHandle) else ReplicaHandle(r)
             for r in replicas]
@@ -168,9 +299,18 @@ class FleetRouter:
         self._restart_cb = restart_cb
         self._prefix_key_chars = prefix_key_chars
         self._prefix_key_tokens = prefix_key_tokens
+        #: Router identity for the deterministic poll phase offsets
+        #: (two routers with different names probe a given replica at
+        #: different points of the poll interval).
+        self._name = name or f"router-{os.getpid()}"
         self.stats = RouterStats()
         self._session: Optional[aiohttp.ClientSession] = None
-        self._poll_task: Optional[asyncio.Task] = None
+        # bounded-by: one poll task per replica, created once in
+        # start() and cancelled in stop()
+        self._poll_tasks: List[asyncio.Task] = []
+        #: Streams currently carrying a journal, capped fleet-wide by
+        #: APHRODITE_ROUTER_JOURNAL_STREAMS.
+        self._journals_active = 0
         self._closed = False
         self._rollout_lock = asyncio.Lock()
 
@@ -186,19 +326,20 @@ class FleetRouter:
                 timeout=aiohttp.ClientTimeout(
                     total=None, sock_connect=CONNECT_TIMEOUT_S))
         loop = asyncio.get_running_loop()
-        task = loop.create_task(self._poll_loop())
-        task.add_done_callback(_log_poll_exit)
-        self._poll_task = task
+        for replica in self._replicas:
+            task = loop.create_task(self._poll_replica_loop(replica))
+            task.add_done_callback(_log_poll_exit)
+            self._poll_tasks.append(task)
 
     async def stop(self) -> None:
         self._closed = True
-        task = self._poll_task
-        self._poll_task = None
-        if task is not None:
+        tasks, self._poll_tasks = self._poll_tasks, []
+        for task in tasks:
             task.cancel()
+        if tasks:
             # gather(return_exceptions) swallows the CancelledError we
             # caused without an except clause that could mask others.
-            await asyncio.gather(task, return_exceptions=True)
+            await asyncio.gather(*tasks, return_exceptions=True)
         session = self._session
         self._session = None
         if session is not None:
@@ -222,23 +363,48 @@ class FleetRouter:
             return None
 
     async def _poll_once(self) -> None:
+        """Probe every replica NOW (rollout readiness, tests); the
+        background cadence lives in the per-replica loops."""
         cb_window = flags.get_float("APHRODITE_ROUTER_CB_WINDOW_S")
         bodies = await asyncio.gather(
             *(self._probe(r) for r in self._replicas))
         for replica, body in zip(self._replicas, bodies):
-            if body is None:
-                replica.record_failure(cb_window)
-            else:
-                replica.record_health(
-                    ReplicaSnapshot.from_probe(body), cb_window)
+            self._record_probe(replica, body, cb_window)
 
-    async def _poll_loop(self) -> None:
+    @staticmethod
+    def _record_probe(replica: ReplicaHandle,
+                      body: Optional[Dict[str, Any]],
+                      cb_window: float) -> None:
+        if body is None:
+            replica.record_failure(cb_window)
+        else:
+            replica.record_health(
+                ReplicaSnapshot.from_probe(body), cb_window)
+
+    def poll_phase(self, replica: ReplicaHandle) -> float:
+        """Deterministic per-(router, replica) phase offset in [0, 1)
+        poll intervals. N routers polling M replicas each probe at a
+        different point of the interval instead of firing a
+        synchronized ``/health?probe=1`` storm at every tick — same
+        aggregate rate, no thundering herd on any replica."""
+        return (_rendezvous_score(self._name, replica.name)
+                % 4096) / 4096.0
+
+    async def _poll_replica_loop(self, replica: ReplicaHandle) -> None:
+        """One replica's health-poll cadence: fire at this replica's
+        deterministic phase offset within each poll interval."""
+        await asyncio.sleep(
+            self.poll_phase(replica) *
+            flags.get_float("APHRODITE_ROUTER_POLL_S"))
         while not self._closed:
             try:
-                await self._poll_once()
+                cb_window = flags.get_float(
+                    "APHRODITE_ROUTER_CB_WINDOW_S")
+                self._record_probe(replica, await self._probe(replica),
+                                   cb_window)
             except Exception as e:
-                logger.warning("fleet health poll failed: %s: %s",
-                               type(e).__name__, e)
+                logger.warning("fleet health poll of %s failed: %s: %s",
+                               replica.name, type(e).__name__, e)
             await asyncio.sleep(
                 flags.get_float("APHRODITE_ROUTER_POLL_S"))
 
@@ -417,13 +583,47 @@ class FleetRouter:
                 # fail fast, not crawl the whole fleet.
                 deadline = time.monotonic() + float(slo)
         self.stats.requests_total += 1
+        journal_ctx = self._journal_context(request, body_json, key)
         return await self._proxy_with_retry(request, raw, key,
-                                            deadline)
+                                            deadline, journal_ctx)
+
+    def _journal_context(self, request: web.Request, body_json,
+                         key: Optional[str]
+                         ) -> Optional[_JournalContext]:
+        """A :class:`_JournalContext` when this request's stream is
+        journaled for mid-stream failover: a single-sequence
+        generation stream, within the per-stream and fleet-wide
+        journal bounds. None = plain relay (mid-stream death falls
+        back to truthful truncation)."""
+        if request.method != "POST" or not isinstance(body_json, dict):
+            return None
+        path = request.path
+        if path not in _GENERATION_PATHS:
+            return None
+        if path != "/api/extra/generate/stream" and \
+                not body_json.get("stream"):
+            return None
+        if (body_json.get("n") or 1) != 1 or \
+                (body_json.get("best_of") or 1) > 1 or \
+                body_json.get("use_beam_search"):
+            return None             # resume is single-sequence only
+        if "aphrodite_resume" in body_json:
+            return None             # never wrap a continuation again
+        max_tokens = flags.get_int("APHRODITE_ROUTER_JOURNAL_TOKENS")
+        if max_tokens <= 0:
+            return None
+        if self._journals_active >= \
+                flags.get_int("APHRODITE_ROUTER_JOURNAL_STREAMS"):
+            return None
+        return _JournalContext(body=body_json,
+                               rel_url=str(request.rel_url),
+                               key=key, max_tokens=max_tokens)
 
     async def _proxy_with_retry(self, request: web.Request,
                                 raw: bytes, key: Optional[str],
-                                deadline: Optional[float]
-                                ) -> web.StreamResponse:
+                                deadline: Optional[float],
+                                journal_ctx: Optional[_JournalContext]
+                                = None) -> web.StreamResponse:
         retries = flags.get_int("APHRODITE_ROUTER_RETRIES")
         backoff = flags.get_float("APHRODITE_ROUTER_BACKOFF_S")
         headers = self._upstream_headers(request.headers)
@@ -439,7 +639,7 @@ class FleetRouter:
             if replica is None:
                 break
             result = await self._attempt(request, replica, raw,
-                                         headers)
+                                         headers, journal_ctx)
             if result.response is not None:
                 return result.response
             last = result
@@ -481,9 +681,14 @@ class FleetRouter:
 
     async def _attempt(self, request: web.Request,
                        replica: ReplicaHandle, raw: bytes,
-                       headers: Dict[str, str]) -> _Attempt:
+                       headers: Dict[str, str],
+                       journal_ctx: Optional[_JournalContext] = None
+                       ) -> _Attempt:
         cb_window = flags.get_float("APHRODITE_ROUTER_CB_WINDOW_S")
         url = replica.url + str(request.rel_url)
+        if journal_ctx is not None:
+            headers = dict(headers)
+            headers[JOURNAL_HEADER] = "1"
         try:
             upstream = await self._session.request(
                 request.method, url, data=raw if raw else None,
@@ -494,14 +699,16 @@ class FleetRouter:
             return _Attempt(kind="conn")
         try:
             return await self._relay(request, replica, upstream,
-                                     cb_window)
+                                     cb_window, journal_ctx)
         finally:
             upstream.release()
 
     async def _relay(self, request: web.Request,
                      replica: ReplicaHandle,
                      upstream: aiohttp.ClientResponse,
-                     cb_window: float) -> _Attempt:
+                     cb_window: float,
+                     journal_ctx: Optional[_JournalContext] = None
+                     ) -> _Attempt:
         status = upstream.status
         if status in _RETRYABLE_STATUSES:
             retry_after = parse_retry_after(upstream.headers)
@@ -539,8 +746,13 @@ class FleetRouter:
         # Unbounded (streaming) body — SSE token streams. The client
         # response is NOT prepared until the first upstream chunk
         # arrives: a replica that dies before its first token leaves
-        # the request fully retryable; after the first chunk the
-        # stream is completed-or-failed truthfully, never re-issued.
+        # the request fully retryable. After the first chunk a plain
+        # re-issue stays forbidden; journaled generation streams
+        # failover via the continuation splice instead.
+        if journal_ctx is not None:
+            return await self._relay_journaled(request, replica,
+                                               upstream, cb_window,
+                                               journal_ctx)
         try:
             first = await upstream.content.readany()
         except aiohttp.ClientError:
@@ -564,6 +776,7 @@ class FleetRouter:
             # Mid-stream upstream failure AFTER tokens reached the
             # client: truthful truncation (no silent re-issue).
             truncated = True
+            self.stats.truncated_client_streams += 1
             logger.warning(
                 "stream from %s truncated mid-flight: %s: %s",
                 replica.name, type(e).__name__, e)
@@ -581,6 +794,163 @@ class FleetRouter:
             except (ConnectionResetError, OSError):
                 pass
         return _Attempt(response=response)
+
+    # -- mid-stream failover: journal + splice ------------------------
+
+    async def _relay_journaled(self, request: web.Request,
+                               replica: ReplicaHandle,
+                               upstream: aiohttp.ClientResponse,
+                               cb_window: float,
+                               ctx: _JournalContext) -> _Attempt:
+        """Relay a journaled generation stream, splicing in
+        continuations from healthy peers on mid-stream replica death.
+
+        The journal commits a token only once its data line was
+        forwarded, so a continuation resumes from exactly what the
+        client received; dedupe on emitted count makes the splice
+        exactly-once even against a replaying upstream. Truthful
+        truncation survives only as the post-retry-budget (or
+        journal-overflow / non-journaling-upstream) fallback.
+        """
+        tail = _JournalTail(max_tokens=ctx.max_tokens)
+        response: Optional[web.StreamResponse] = None
+        current, cur_replica = upstream, replica
+        opened: List[aiohttp.ClientResponse] = []
+        self._journals_active += 1
+        try:
+            while True:
+                upstream_died = False
+                try:
+                    while True:
+                        chunk = await current.content.readany()
+                        if not chunk:
+                            break
+                        out = tail.feed(chunk)
+                        if not out:
+                            continue
+                        if response is None:
+                            response = web.StreamResponse(
+                                status=current.status,
+                                headers=self._relay_headers(
+                                    current.headers))
+                            await response.prepare(request)
+                        await response.write(out)
+                except aiohttp.ClientError as e:
+                    upstream_died = True
+                    logger.warning(
+                        "journaled stream from %s died mid-flight "
+                        "(%d tokens delivered): %s: %s",
+                        cur_replica.name, len(tail.tokens),
+                        type(e).__name__, e)
+                except (ConnectionResetError, OSError):
+                    # The CLIENT hung up; nothing further to deliver
+                    # (and nothing to retry — the final response may
+                    # never reach anyone).
+                    cur_replica.proxied_failed += 1
+                    self.stats.failed_mid_stream += 1
+                    return _Attempt(response=response if response
+                                    is not None else
+                                    web.Response(status=204))
+                if not upstream_died:
+                    break
+                cur_replica.record_failure(cb_window)
+                cur_replica.proxied_failed += 1
+                if response is None:
+                    # Died before anything reached the client: the
+                    # whole request is still retryable upstream of us.
+                    return _Attempt(kind="conn")
+                self.stats.failed_mid_stream += 1
+                resumed = None
+                if tail.active and not tail.overflowed:
+                    resumed = await self._issue_continuation(
+                        ctx, tail, exclude=[cur_replica],
+                        cb_window=cb_window)
+                if resumed is None:
+                    self.stats.truncated_client_streams += 1
+                    logger.warning(
+                        "stream could not be resumed; truthful "
+                        "truncation after %d tokens", len(tail.tokens))
+                    return _Attempt(response=response)
+                cur_replica, current = resumed
+                opened.append(current)
+                self.stats.resumed_mid_stream += 1
+            # Clean upstream EOF.
+            cur_replica.proxied_ok += 1
+            self.stats.served_streaming += 1
+            try:
+                left = tail.flush()
+                if response is None:
+                    response = web.StreamResponse(
+                        status=current.status,
+                        headers=self._relay_headers(current.headers))
+                    await response.prepare(request)
+                if left:
+                    await response.write(left)
+                await response.write_eof()
+            except (ConnectionResetError, OSError):
+                pass
+            return _Attempt(response=response)
+        finally:
+            self._journals_active -= 1
+            for resp in opened:
+                resp.release()
+
+    async def _issue_continuation(self, ctx: _JournalContext,
+                                  tail: _JournalTail,
+                                  exclude: List[ReplicaHandle],
+                                  cb_window: float):
+        """Re-issue the journaled request as a continuation on a
+        healthy peer: original body + the admin-key-gated
+        ``aphrodite_resume`` extension carrying the delivered token
+        ids. Returns (replica, streaming upstream) or None once the
+        retry budget / fleet is exhausted."""
+        body = dict(ctx.body)
+        body["aphrodite_resume"] = {
+            "emitted_token_ids": list(tail.tokens)}
+        raw = json.dumps(body).encode()
+        retries = flags.get_int("APHRODITE_ROUTER_RETRIES")
+        backoff = flags.get_float("APHRODITE_ROUTER_BACKOFF_S")
+        tried: List[ReplicaHandle] = list(exclude)
+        for attempt in range(retries + 1):
+            replica = self.pick(ctx.key, exclude=tried)
+            if replica is None and len(tried) > len(exclude):
+                # Every peer tried once; allow a repeat pick (a drain
+                # may have finished) rather than truncating early.
+                replica = self.pick(ctx.key, exclude=exclude)
+            if replica is None:
+                return None
+            headers = {"Content-Type": "application/json",
+                       JOURNAL_HEADER: "1"}
+            if replica.admin_key:
+                headers[RESUME_KEY_HEADER] = replica.admin_key
+            try:
+                upstream = await self._session.request(
+                    "POST", replica.url + ctx.rel_url, data=raw,
+                    headers=headers)
+            except aiohttp.ClientError:
+                replica.record_failure(cb_window)
+                tried.append(replica)
+                await asyncio.sleep(backoff * (2 ** attempt))
+                continue
+            if upstream.status != 200:
+                if upstream.status == 503:
+                    replica.mark_draining_seen()
+                else:
+                    replica.record_failure(cb_window)
+                body_text = b""
+                try:
+                    body_text = await upstream.read()
+                except aiohttp.ClientError:
+                    pass
+                logger.warning(
+                    "continuation on %s rejected with %d: %s",
+                    replica.name, upstream.status, body_text[:200])
+                upstream.release()
+                tried.append(replica)
+                await asyncio.sleep(backoff * (2 ** attempt))
+                continue
+            return replica, upstream
+        return None
 
     # -- rolling deploy ----------------------------------------------
 

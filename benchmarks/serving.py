@@ -507,7 +507,16 @@ async def run_fleet(args) -> dict:
     SIGKILL of one replica (`--chaos-kill` / `--kill-at`). Reports
     fleet goodput, TTFT percentiles, prefix-affinity hit rate, retry
     counters, per-replica accounting, rollout-window continuity, and
-    the zero-lost invariant (`requests_unaccounted == 0`)."""
+    the zero-lost invariant (`requests_unaccounted == 0`).
+
+    With `--chaos-kill` the SIGKILL is deliberately MID-STREAM (the
+    victim is the replica with the most in-flight streams, killed
+    only once it has some) and every request is SEEDED: the run
+    first drives the identical workload kill-free as a control, then
+    asserts in the JSON `failover` section that the router resumed
+    every interrupted stream (`truncated_client_streams == 0`,
+    `resumed_mid_stream >= 1`) and that each chaos-run stream's
+    spliced text is BIT-EQUAL to its kill-free control."""
     import tempfile
 
     import aiohttp
@@ -604,41 +613,72 @@ async def run_fleet(args) -> dict:
     await site.start()
     base = f"http://127.0.0.1:{app_runner.addresses[0][1]}"
 
+    # Seeded requests in chaos-kill mode: per-request seeds make the
+    # bit-equality proof non-trivial (random sampling, not greedy) —
+    # a resumed continuation only matches the control if the PRNG
+    # salt really continues at the splice position.
+    seeded = bool(getattr(args, "chaos_kill", False))
+
+    def body_for(i: int) -> dict:
+        _, prompt = prompts[i]
+        body = {"model": "fleet", "prompt": prompt,
+                "max_tokens": args.output_len, "temperature": 0.0,
+                "ignore_eos": True, "stream": True}
+        if seeded:
+            body["temperature"] = 0.8
+            body["seed"] = 9000 + i
+        return body
+
     outcomes = {"served": 0, "failed_mid_stream": 0,
                 "client_5xx_prestream": 0, "rejected_429": 0,
                 "rejected_other": 0, "transport_errors": 0}
     ttfts, e2es = [], []
     completions = []            # perf_counter stamps of served reqs
 
-    async def one(i: int) -> None:
-        _, prompt = prompts[i]
-        body = {"model": "fleet", "prompt": prompt,
-                "max_tokens": args.output_len, "temperature": 0.0,
-                "ignore_eos": True, "stream": True}
+    def _sse_text(raw: bytes) -> str:
+        text = ""
+        for line in raw.split(b"\n"):
+            if not line.startswith(b"data: "):
+                continue
+            payload = line[len(b"data: "):]
+            if payload.strip() == b"[DONE]":
+                continue
+            try:
+                text += json.loads(payload)["choices"][0]["text"]
+            except (ValueError, KeyError, IndexError):
+                pass
+        return text
+
+    async def one(i: int, outcomes: dict, ttfts: list, e2es: list,
+                  completions: list,
+                  texts=None) -> None:
+        body = body_for(i)
         t0 = time.perf_counter()
         try:
             async with http.post(base + "/v1/completions",
                                  json=body) as resp:
                 if resp.status == 200:
                     first = None
-                    done = False
+                    buf = bytearray()
                     try:
                         async for chunk in resp.content.iter_any():
                             if first is None and chunk:
                                 first = time.perf_counter()
-                            if b"[DONE]" in chunk:
-                                done = True
+                            buf += chunk
                     except aiohttp.ClientError:
                         pass
                     t1 = time.perf_counter()
-                    if done:
+                    if b"[DONE]" in bytes(buf):
                         outcomes["served"] += 1
                         ttfts.append((first or t1) - t0)
                         e2es.append(t1 - t0)
                         completions.append(t1)
+                        if texts is not None:
+                            texts[i] = _sse_text(bytes(buf))
                     else:
-                        # Mid-stream casualty: truthful truncation,
-                        # never silently re-issued.
+                        # Mid-stream casualty past the resume budget:
+                        # truthful truncation, never a silent
+                        # re-issue.
                         outcomes["failed_mid_stream"] += 1
                     return
                 await resp.read()
@@ -673,33 +713,78 @@ async def run_fleet(args) -> dict:
             rollout_result["error"] = f"{type(e).__name__}: {e}"
         rollout_result["window"] = (t0r, time.perf_counter())
 
+    def pick_victim() -> int:
+        """The replica with the most in-flight streams (freshest
+        snapshots) — a SIGKILL there is guaranteed MID-STREAM."""
+        best, best_inflight = n - 1, -1
+        for idx, h in enumerate(router.replicas):
+            s = h.snapshot
+            if s is not None and s.inflight > best_inflight:
+                best, best_inflight = idx, s.inflight
+        return best
+
     kill_info = None
     rollout_task = None
     kill_index = (int(kill_at * args.num_requests)
                   if kill_at >= 0 else None)
     rollout_index = (int(rollout_at * args.num_requests)
                      if rollout_at >= 0 else None)
-    arrival_rng = np.random.RandomState(1234)
-    tasks = []
-    t_start = time.perf_counter()
-    async for i in poisson_arrivals(args.num_requests,
-                                    args.request_rate, arrival_rng):
-        if kill_index is not None and i == kill_index:
-            victim = n - 1
-            launcher.kill(victim)
-            kill_info = {"replica": f"replica-{victim}",
-                         "at_request": i,
-                         "at_s": round(
-                             time.perf_counter() - t_start, 3)}
-            logger_warn("fleet: chaos SIGKILL of replica-%d at "
-                        "request %d", victim, i)
-        if rollout_index is not None and i == rollout_index:
-            logger_warn("fleet: firing mid-run rolling deploy at "
-                        "request %d", i)
-            rollout_task = asyncio.create_task(fire_rollout())
-        tasks.append(asyncio.create_task(one(i)))
-    await asyncio.gather(*tasks)
-    wall = time.perf_counter() - t_start
+
+    async def drive_pass(outcomes: dict, ttfts: list, e2es: list,
+                         completions: list,
+                         texts=None,
+                         with_events: bool = False) -> float:
+        nonlocal kill_info, rollout_task
+        arrival_rng = np.random.RandomState(1234)
+        tasks = []
+        t_start = time.perf_counter()
+        kill_pending = with_events and kill_index is not None
+        async for i in poisson_arrivals(args.num_requests,
+                                        args.request_rate,
+                                        arrival_rng):
+            if kill_pending and i >= kill_index:
+                victim = pick_victim()
+                vs = router.replicas[victim].snapshot
+                if (vs is not None and vs.inflight > 0) or \
+                        i >= args.num_requests - 1:
+                    launcher.kill(victim)
+                    kill_pending = False
+                    kill_info = {
+                        "replica": f"replica-{victim}",
+                        "at_request": i,
+                        "victim_inflight": (vs.inflight
+                                            if vs is not None else
+                                            None),
+                        "at_s": round(
+                            time.perf_counter() - t_start, 3)}
+                    logger_warn(
+                        "fleet: chaos SIGKILL of replica-%d "
+                        "(inflight=%s) at request %d", victim,
+                        kill_info["victim_inflight"], i)
+            if with_events and rollout_index is not None and \
+                    i == rollout_index:
+                logger_warn("fleet: firing mid-run rolling deploy at "
+                            "request %d", i)
+                rollout_task = asyncio.create_task(fire_rollout())
+            tasks.append(asyncio.create_task(one(
+                i, outcomes, ttfts, e2es, completions, texts)))
+        await asyncio.gather(*tasks)
+        return time.perf_counter() - t_start, t_start
+
+    # Kill-free CONTROL pass first (chaos-kill mode): the seeded
+    # texts every chaos-run stream must match bit-for-bit.
+    control_texts = None
+    if seeded and kill_index is not None:
+        logger_warn("fleet: driving the kill-free seeded control "
+                    "pass")
+        control_texts = {}
+        await drive_pass({k: 0 for k in outcomes}, [], [], [],
+                         texts=control_texts, with_events=False)
+
+    chaos_texts = {} if control_texts is not None else None
+    wall, t_start = await drive_pass(outcomes, ttfts, e2es,
+                                     completions, texts=chaos_texts,
+                                     with_events=True)
     if rollout_task is not None:
         await rollout_task
 
@@ -758,6 +843,28 @@ async def run_fleet(args) -> dict:
         "chaos_kill": kill_info,
         "replica_logs": log_dir,
     }
+    # Mid-stream failover proof: the router journal/splice counters
+    # plus the seeded bit-equality check against the kill-free
+    # control pass (every stream served in BOTH passes must match
+    # byte-for-byte — a resumed splice that lost, duplicated, or
+    # diverged a token fails here).
+    failover = {
+        "failed_mid_stream": stats.failed_mid_stream,
+        "resumed_mid_stream": stats.resumed_mid_stream,
+        "truncated_client_streams": stats.truncated_client_streams,
+    }
+    if control_texts is not None:
+        both = sorted(set(control_texts) & set(chaos_texts or {}))
+        mismatches = [i for i in both
+                      if control_texts[i] != chaos_texts[i]]
+        failover["seeded_control"] = {
+            "control_served": len(control_texts),
+            "chaos_served": len(chaos_texts or {}),
+            "compared": len(both),
+            "bit_equal": not mismatches,
+            "mismatched_requests": mismatches[:8],
+        }
+    detail["failover"] = failover
 
     await http.close()
     await app_runner.cleanup()

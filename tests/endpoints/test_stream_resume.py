@@ -1,0 +1,318 @@
+"""Mid-stream failover seam at the serving surface: the journal wire
+contract (``X-Aphrodite-Stream-Journal`` → interleaved
+``: aphrodite-journal`` records) and the admin-key-gated
+``aphrodite_resume`` continuation extension, over a real aiohttp app
+on each frontend.
+
+The invariants, mirroring the fleet router's splice:
+
+- journal records carry exactly the NEW token ids of each data chunk,
+  and appear only when the router asked for them;
+- a continuation resumed from the first k journaled tokens streams
+  ONLY the remaining deltas — spliced text/tokens are byte-equal to
+  the unbroken stream (seeded sampling included);
+- the extension is router-internal: no admin key configured → 403,
+  wrong key → 401, and it never leaks into the public surface.
+"""
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from aphrodite_tpu.endpoints.utils import (JOURNAL_HEADER,
+                                           RESUME_KEY_HEADER)
+from aphrodite_tpu.engine.args_tools import AsyncEngineArgs
+from aphrodite_tpu.engine.async_aphrodite import AsyncAphrodite
+
+ADMIN_KEY = "resume-key"
+MODEL_KEY = "tiny"
+
+PROMPT = "the quick brown fox jumps over the lazy dog"
+
+
+@pytest.fixture(scope="module")
+def resume_ctx(tiny_model_dir):
+    """One engine + one app per frontend, all sharing the engine."""
+    from aphrodite_tpu.endpoints.kobold.api_server import \
+        build_app as kobold_app
+    from aphrodite_tpu.endpoints.ooba.api_server import \
+        build_app as ooba_app
+    from aphrodite_tpu.endpoints.openai.api_server import \
+        build_app as openai_app
+
+    loop = asyncio.new_event_loop()
+
+    async def setup():
+        engine = AsyncAphrodite.from_engine_args(AsyncEngineArgs(
+            model=tiny_model_dir, load_format="dummy", dtype="float32",
+            max_model_len=256, max_num_seqs=8, swap_space=0.01,
+            disable_log_stats=True, disable_log_requests=True))
+        clients = {}
+        for name, build in (("openai", openai_app),
+                            ("kobold", kobold_app),
+                            ("ooba", ooba_app)):
+            client = TestClient(TestServer(build(
+                engine, MODEL_KEY, admin_keys=[ADMIN_KEY])))
+            await client.start_server()
+            clients[name] = client
+        return clients
+
+    clients = loop.run_until_complete(setup())
+    yield loop, clients
+
+    async def teardown():
+        for client in clients.values():
+            await client.close()
+
+    loop.run_until_complete(teardown())
+    loop.close()
+
+
+def run(resume_ctx, coro_fn):
+    loop, clients = resume_ctx
+    return loop.run_until_complete(coro_fn(clients))
+
+
+def parse_sse(raw: bytes):
+    """(journal records, data payload lines) of one SSE/stream body."""
+    records, datas = [], []
+    for line in raw.split(b"\n"):
+        if line.startswith(b": aphrodite-journal "):
+            records.append(json.loads(
+                line[len(b": aphrodite-journal "):]))
+        elif line.startswith(b"data: "):
+            datas.append(line[len(b"data: "):])
+        elif line.startswith(b"{"):            # ooba newline-JSON
+            datas.append(line)
+    return records, datas
+
+
+def openai_text(datas):
+    text = ""
+    for d in datas:
+        if d.strip() == b"[DONE]":
+            continue
+        payload = json.loads(d)
+        if "error" in payload:
+            raise AssertionError(payload)
+        text += payload["choices"][0]["text"]
+    return text
+
+
+def test_openai_journal_and_resume_bit_equal(resume_ctx):
+    """The headline seam test: a seeded stream's journal replays as a
+    continuation whose spliced output is bit-equal to the unbroken
+    run, with no re-emitted tokens or text."""
+    async def go(clients):
+        client = clients["openai"]
+        body = {"model": MODEL_KEY, "prompt": PROMPT,
+                "max_tokens": 8, "ignore_eos": True, "stream": True,
+                "temperature": 1.0, "seed": 777}
+        # Unbroken journaled run: full token ids + full text.
+        r = await client.post("/v1/completions", json=body,
+                              headers={JOURNAL_HEADER: "1"})
+        assert r.status == 200
+        records, datas = parse_sse(await r.read())
+        full_text = openai_text(datas)
+        tokens = [t for rec in records for t in rec["t"]]
+        assert len(tokens) == 8
+        assert records[-1]["n"] == 8
+        assert records[-1]["fin"] == "length"
+        # Journal counts are cumulative and strictly increasing.
+        assert [r0["n"] for r0 in records] == \
+            sorted({r0["n"] for r0 in records})
+
+        # Continuation from the first 3 journaled tokens.
+        cont = dict(body)
+        cont["aphrodite_resume"] = {"emitted_token_ids": tokens[:3]}
+        r = await client.post(
+            "/v1/completions", json=cont,
+            headers={JOURNAL_HEADER: "1", RESUME_KEY_HEADER: ADMIN_KEY})
+        assert r.status == 200
+        rec2, datas2 = parse_sse(await r.read())
+        resumed_tokens = [t for rec in rec2 for t in rec["t"]]
+        # Exactly the remaining tokens, journal counts continuing at 3.
+        assert resumed_tokens == tokens[3:]
+        assert rec2[0]["n"] > 3 and rec2[-1]["n"] == 8
+        # The spliced text equals the unbroken text: nothing
+        # re-emitted, nothing lost (mid-word resume included).
+        delta_text = openai_text(datas2)
+        assert delta_text != ""
+        prefix = full_text[:len(full_text) - len(delta_text)]
+        assert prefix + delta_text == full_text
+
+        # A continuation whose emitted output is already complete
+        # resolves immediately: finish chunk + [DONE], zero tokens.
+        done = dict(body)
+        done["aphrodite_resume"] = {"emitted_token_ids": tokens}
+        r = await client.post(
+            "/v1/completions", json=done,
+            headers={JOURNAL_HEADER: "1", RESUME_KEY_HEADER: ADMIN_KEY})
+        assert r.status == 200
+        rec3, datas3 = parse_sse(await r.read())
+        assert [t for rec in rec3 for t in rec["t"]] == []
+        assert openai_text(datas3) == ""
+        assert datas3[-1].strip() == b"[DONE]"
+
+    run(resume_ctx, go)
+
+
+def test_journal_absent_without_header(resume_ctx):
+    async def go(clients):
+        client = clients["openai"]
+        r = await client.post("/v1/completions", json={
+            "model": MODEL_KEY, "prompt": PROMPT, "max_tokens": 4,
+            "ignore_eos": True, "stream": True})
+        assert r.status == 200
+        raw = await r.read()
+        assert b"aphrodite-journal" not in raw
+
+    run(resume_ctx, go)
+
+
+def test_resume_gating(resume_ctx):
+    """The extension is router-internal: wrong key 401, non-stream
+    400, multi-sequence 400; and on a server WITHOUT admin keys, 403."""
+    async def go(clients):
+        client = clients["openai"]
+        body = {"model": MODEL_KEY, "prompt": PROMPT, "max_tokens": 4,
+                "stream": True,
+                "aphrodite_resume": {"emitted_token_ids": [1, 2]}}
+        r = await client.post("/v1/completions", json=body)
+        assert r.status == 401
+        r = await client.post(
+            "/v1/completions", json=body,
+            headers={RESUME_KEY_HEADER: "wrong"})
+        assert r.status == 401
+        no_stream = dict(body, stream=False)
+        r = await client.post(
+            "/v1/completions", json=no_stream,
+            headers={RESUME_KEY_HEADER: ADMIN_KEY})
+        assert r.status == 400
+        multi = dict(body, n=2, best_of=2)
+        r = await client.post(
+            "/v1/completions", json=multi,
+            headers={RESUME_KEY_HEADER: ADMIN_KEY})
+        assert r.status == 400
+        malformed = dict(body)
+        malformed["aphrodite_resume"] = {"emitted_token_ids": ["x"]}
+        r = await client.post(
+            "/v1/completions", json=malformed,
+            headers={RESUME_KEY_HEADER: ADMIN_KEY})
+        assert r.status == 400
+
+    run(resume_ctx, go)
+
+
+def test_resume_403_without_admin_keys(tiny_model_dir):
+    from aphrodite_tpu.endpoints.openai.api_server import build_app
+
+    async def go():
+        engine = AsyncAphrodite.from_engine_args(AsyncEngineArgs(
+            model=tiny_model_dir, load_format="dummy", dtype="float32",
+            max_model_len=256, max_num_seqs=4, swap_space=0.01,
+            disable_log_stats=True, disable_log_requests=True))
+        client = TestClient(TestServer(build_app(engine, MODEL_KEY)))
+        await client.start_server()
+        try:
+            r = await client.post(
+                "/v1/completions", json={
+                    "model": MODEL_KEY, "prompt": PROMPT,
+                    "max_tokens": 2, "stream": True,
+                    "aphrodite_resume": {"emitted_token_ids": [1]}},
+                headers={RESUME_KEY_HEADER: "anything"})
+            assert r.status == 403
+        finally:
+            await client.close()
+
+    asyncio.run(go())
+
+
+def test_chat_resume_skips_role_prelude(resume_ctx):
+    async def go(clients):
+        client = clients["openai"]
+        body = {"model": MODEL_KEY,
+                "messages": [{"role": "user", "content": PROMPT}],
+                "max_tokens": 6, "ignore_eos": True, "stream": True,
+                "temperature": 0.0}
+        r = await client.post("/v1/chat/completions", json=body,
+                              headers={JOURNAL_HEADER: "1"})
+        assert r.status == 200
+        records, datas = parse_sse(await r.read())
+        tokens = [t for rec in records for t in rec["t"]]
+        assert len(tokens) == 6
+        roles = [d for d in datas if b'"role":"assistant"' in d]
+        assert len(roles) == 1          # exactly one prelude
+
+        cont = dict(body)
+        cont["aphrodite_resume"] = {"emitted_token_ids": tokens[:2]}
+        r = await client.post(
+            "/v1/chat/completions", json=cont,
+            headers={JOURNAL_HEADER: "1", RESUME_KEY_HEADER: ADMIN_KEY})
+        assert r.status == 200
+        rec2, datas2 = parse_sse(await r.read())
+        assert [t for rec in rec2 for t in rec["t"]] == tokens[2:]
+        # The spliced continuation never re-sends the role prelude.
+        assert not any(b'"role":"assistant"' in d for d in datas2)
+
+    run(resume_ctx, go)
+
+
+def test_kobold_and_ooba_journal_and_resume(resume_ctx):
+    """The same seam on the other two frontends: journaled token
+    stream, continuation resumes with only the remaining text."""
+    async def go(clients):
+        # -- kobold ---------------------------------------------------
+        kob = clients["kobold"]
+        body = {"prompt": PROMPT, "max_length": 6,
+                "max_context_length": 128, "temperature": 0.0}
+        r = await kob.post("/api/extra/generate/stream", json=body,
+                           headers={JOURNAL_HEADER: "1"})
+        assert r.status == 200
+        records, datas = parse_sse(await r.read())
+        tokens = [t for rec in records for t in rec["t"]]
+        assert len(tokens) == 6
+        full = "".join(json.loads(d)["token"] for d in datas)
+
+        cont = dict(body)
+        cont["aphrodite_resume"] = {"emitted_token_ids": tokens[:2]}
+        r = await kob.post(
+            "/api/extra/generate/stream", json=cont,
+            headers={JOURNAL_HEADER: "1", RESUME_KEY_HEADER: ADMIN_KEY})
+        assert r.status == 200
+        rec2, datas2 = parse_sse(await r.read())
+        assert [t for rec in rec2 for t in rec["t"]] == tokens[2:]
+        delta = "".join(json.loads(d)["token"] for d in datas2)
+        assert full.endswith(delta) and delta
+        # Unauthorized resume is rejected before any stream starts.
+        r = await kob.post("/api/extra/generate/stream", json=cont)
+        assert r.status == 401
+
+        # -- ooba -----------------------------------------------------
+        oob = clients["ooba"]
+        body = {"prompt": PROMPT, "max_new_tokens": 6,
+                "ban_eos_token": True, "stream": True,
+                "temperature": 0.0}
+        r = await oob.post("/api/v1/generate", json=body,
+                           headers={JOURNAL_HEADER: "1"})
+        assert r.status == 200
+        records, datas = parse_sse(await r.read())
+        tokens = [t for rec in records for t in rec["t"]]
+        assert len(tokens) == 6
+        # Ooba streams CUMULATIVE text; the last chunk is the answer.
+        full = json.loads(datas[-1])["results"][0]["text"]
+
+        cont = dict(body)
+        cont["aphrodite_resume"] = {"emitted_token_ids": tokens[:2]}
+        r = await oob.post(
+            "/api/v1/generate", json=cont,
+            headers={JOURNAL_HEADER: "1", RESUME_KEY_HEADER: ADMIN_KEY})
+        assert r.status == 200
+        rec2, datas2 = parse_sse(await r.read())
+        assert [t for rec in rec2 for t in rec["t"]] == tokens[2:]
+        assert json.loads(datas2[-1])["results"][0]["text"] == full
+        r = await oob.post("/api/v1/generate", json=cont)
+        assert r.status == 401
+
+    run(resume_ctx, go)

@@ -252,6 +252,110 @@ def test_reincarnate_clears_stale_prefix_pins(tiny_model_dir):
         get_num_free_gpu_blocks() == free0
 
 
+def test_seeded_partial_request_restores_bit_equal(tiny_model_dir):
+    """Seeded-sampling determinism through reincarnation restore: a
+    seeded request killed MID-GENERATION (several tokens already
+    emitted) and restored must emit its remaining tokens bit-equal to
+    the fault-free run — the restored outputs re-enter as output
+    tokens, so the sampler's output-position PRNG salt continues at n
+    (the same seam mid-stream failover resumes through)."""
+    sp = SamplingParams(temperature=1.0, seed=31337, max_tokens=12,
+                        ignore_eos=True)
+
+    def run(kill_at_output_len):
+        engine = _sync_engine(tiny_model_dir,
+                              skip_tokenizer_init=False)
+        engine.add_request("seeded", None, sp,
+                           prompt_token_ids=_prompt(0))
+        emissions = []          # token_ids of every emitted output
+        killed = False
+        final = None
+        while engine.has_unfinished_requests():
+            if not killed and kill_at_output_len is not None:
+                groups = list(engine.scheduler.running)
+                if groups and groups[0].get_seqs()[0].get_output_len() \
+                        >= kill_at_output_len:
+                    outcome = engine.reincarnate()
+                    assert outcome.restored == 1
+                    assert outcome.lost == []
+                    killed = True
+                    continue
+            for out in engine.step():
+                emissions.append(list(out.outputs[0].token_ids))
+                if out.finished:
+                    final = out
+        assert killed == (kill_at_output_len is not None)
+        return final, emissions
+
+    clean, _ = run(None)
+    faulty, emissions = run(4)
+    assert list(faulty.outputs[0].token_ids) == \
+        list(clean.outputs[0].token_ids)
+    assert faulty.outputs[0].text == clean.outputs[0].text
+    # No duplicate emission across the rebuild: every successive
+    # output's token_ids strictly extend the previous one's (the
+    # restore continues from the emitted tokens; it never re-emits).
+    for prev, cur in zip(emissions, emissions[1:]):
+        assert cur[:len(prev)] == prev
+        assert len(cur) > len(prev)
+
+
+def test_async_restore_no_duplicate_chunks(tiny_model_dir,
+                                           monkeypatch):
+    """The stream-level half of the same invariant: a FATAL fault
+    mid-generation reincarnates the engine, and the client stream's
+    successive RequestOutputs never regress or re-deliver a token —
+    the delta stream a frontend derives has no duplicate chunks."""
+    monkeypatch.setenv("APHRODITE_REINCARNATIONS", "1")
+    monkeypatch.setenv("APHRODITE_REINCARNATION_BACKOFF_S", "0.01")
+    from aphrodite_tpu.common.faultinject import InjectedFatalFault
+
+    async def go():
+        engine = _async_engine(tiny_model_dir)
+        sp = SamplingParams(temperature=1.0, seed=7, max_tokens=12,
+                            ignore_eos=True)
+        armed = {"fire": False, "fired": False}
+        real = engine.engine.executor.execute_model
+
+        def maybe_fail(*a, **kw):
+            # One-shot fatal, armed by the watcher once tokens have
+            # streamed (same executor object: survives until the
+            # rebuild replaces it).
+            if armed["fire"] and not armed["fired"]:
+                armed["fired"] = True
+                raise InjectedFatalFault("mid-generation kill")
+            return real(*a, **kw)
+
+        engine.engine.executor.execute_model = maybe_fail
+        emissions = []
+        async for out in engine.generate(None, sp, "r0",
+                                         prompt_token_ids=_prompt(0)):
+            emissions.append(list(out.outputs[0].token_ids))
+            if len(emissions[-1]) >= 4:
+                armed["fire"] = True
+        assert armed["fired"], "the mid-generation fault never fired"
+        assert engine.health.reincarnations_total == 1
+        assert len(emissions[-1]) == 12
+        for prev, cur in zip(emissions, emissions[1:]):
+            assert cur[:len(prev)] == prev, \
+                "stream re-delivered tokens after the rebuild"
+        return emissions[-1]
+
+    faulty = asyncio.run(go())
+
+    async def clean_go():
+        engine = _async_engine(tiny_model_dir)
+        sp = SamplingParams(temperature=1.0, seed=7, max_tokens=12,
+                            ignore_eos=True)
+        final = None
+        async for out in engine.generate(None, sp, "r0",
+                                         prompt_token_ids=_prompt(0)):
+            final = out
+        return list(final.outputs[0].token_ids)
+
+    assert faulty == asyncio.run(clean_go())
+
+
 def test_stale_step_cannot_commit_after_reincarnation(tiny_model_dir,
                                                       monkeypatch):
     """The epoch guard: a step that was in flight when reincarnate()

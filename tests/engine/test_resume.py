@@ -1,0 +1,191 @@
+"""Engine resume seam: the continuation form of ``add_request``
+(``emitted_token_ids``) that mid-stream failover and reincarnation
+ride.
+
+Invariants:
+
+- a continuation's joint output is BIT-EQUAL to the unbroken run —
+  seeded sampling included, because the sampler's per-row PRNG salt
+  is the output position and the emitted tokens enter as outputs;
+- ``max_tokens``/stop conditions evaluate over the JOINT output
+  (a continuation never overruns, and a stop string already present
+  finishes on arrival);
+- incremental detokenization replays the emitted tokens, so
+  ``resumed_text`` is exactly the text the original stream delivered
+  and the continuation's deltas splice mid-word cleanly.
+"""
+import pytest
+
+from aphrodite_tpu.common.sampling_params import SamplingParams
+
+
+def _sync_engine(tiny_model_dir, **kw):
+    from aphrodite_tpu.engine.args_tools import EngineArgs
+    from aphrodite_tpu.engine.aphrodite_engine import AphroditeEngine
+    defaults = dict(model=tiny_model_dir, load_format="dummy",
+                    dtype="float32", block_size=16, max_model_len=256,
+                    max_num_seqs=8, swap_space=0.01,
+                    disable_log_stats=True)
+    defaults.update(kw)
+    return AphroditeEngine(
+        *EngineArgs(**defaults).create_engine_configs())
+
+
+def _drain(engine):
+    finals = {}
+    while engine.has_unfinished_requests():
+        for out in engine.step():
+            if out.finished:
+                finals[out.request_id] = out
+    return finals
+
+
+PROMPT = [5 + (i * 7) % 90 for i in range(12)]
+
+
+@pytest.fixture(scope="module")
+def engine(tiny_model_dir):
+    return _sync_engine(tiny_model_dir)
+
+
+def _full_run(engine, sp, rid="full"):
+    engine.add_request(rid, None, sp, prompt_token_ids=list(PROMPT))
+    return _drain(engine)[rid]
+
+
+def test_seeded_continuation_bit_equal(engine):
+    """Continuation from k emitted tokens produces the same joint
+    token ids AND text as the unbroken seeded run, for every split
+    point — the sampler's output-position salt continues at n."""
+    sp = SamplingParams(temperature=1.0, seed=4242, max_tokens=10,
+                        ignore_eos=True)
+    full = _full_run(engine, sp, "seeded-full")
+    ids = list(full.outputs[0].token_ids)
+    assert len(ids) == 10
+
+    for k in (1, 4, 9):
+        engine.add_request(f"cont-{k}", None, sp,
+                           prompt_token_ids=list(PROMPT),
+                           emitted_token_ids=ids[:k])
+        out = _drain(engine)[f"cont-{k}"]
+        assert list(out.outputs[0].token_ids) == ids, f"split {k}"
+        assert out.outputs[0].text == full.outputs[0].text
+        assert out.resumed_tokens == k
+        assert full.outputs[0].text.startswith(out.resumed_text)
+
+
+def test_continuation_respects_joint_max_tokens(engine):
+    """max_tokens counts the JOINT output: a continuation with k
+    emitted generates exactly max_tokens - k more, and an
+    already-complete continuation resolves on arrival with zero
+    device work and the right finish reason."""
+    sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+    full = _full_run(engine, sp, "len-full")
+    ids = list(full.outputs[0].token_ids)
+
+    engine.add_request("len-cont", None, sp,
+                       prompt_token_ids=list(PROMPT),
+                       emitted_token_ids=ids[:4])
+    out = _drain(engine)["len-cont"]
+    assert len(out.outputs[0].token_ids) == 6
+    assert out.outputs[0].finish_reason == "length"
+
+    # Emitted == max_tokens: finished before any scheduling.
+    free0 = engine.scheduler.block_manager.get_num_free_gpu_blocks()
+    engine.add_request("len-done", None, sp,
+                       prompt_token_ids=list(PROMPT),
+                       emitted_token_ids=ids)
+    assert engine.has_unfinished_requests()
+    outs = engine.step()
+    assert [o.request_id for o in outs if o.finished] == ["len-done"]
+    (done,) = [o for o in outs if o.finished]
+    assert list(done.outputs[0].token_ids) == ids
+    assert done.outputs[0].finish_reason == "length"
+    assert not engine.has_unfinished_requests()
+    assert engine.scheduler.block_manager.get_num_free_gpu_blocks() \
+        == free0                    # no pages were ever allocated
+
+
+def test_continuation_stop_string_spans_splice(engine):
+    """Stop strings evaluate over the joint TEXT: a continuation
+    resumed just before the stop completes and stops at exactly the
+    same place as the unbroken run, and one whose emitted text
+    already contains the stop finishes on arrival."""
+    base = SamplingParams(temperature=0.0, max_tokens=8,
+                          ignore_eos=True)
+    full = _full_run(engine, base, "stop-full")
+    full_text = full.outputs[0].text
+    ids = list(full.outputs[0].token_ids)
+    # Use the tail of the greedy text as the stop string, so it is
+    # only complete at the very end (possibly spanning tokens).
+    stop = full_text[-3:]
+    assert stop
+    sp = SamplingParams(temperature=0.0, max_tokens=8,
+                        ignore_eos=True, stop=[stop])
+    stopped = _full_run(engine, sp, "stop-ref")
+    ref_text = stopped.outputs[0].text
+    assert not ref_text.endswith(stop) or \
+        stopped.outputs[0].finish_reason == "stop"
+
+    engine.add_request("stop-cont", None, sp,
+                       prompt_token_ids=list(PROMPT),
+                       emitted_token_ids=ids[:3])
+    out = _drain(engine)["stop-cont"]
+    assert out.outputs[0].text == ref_text
+    assert out.outputs[0].finish_reason == \
+        stopped.outputs[0].finish_reason
+
+    # Emitted output that already satisfies the stop: arrival-
+    # resolved, text stripped exactly like the original stream's.
+    engine.add_request("stop-done", None, sp,
+                       prompt_token_ids=list(PROMPT),
+                       emitted_token_ids=list(
+                           stopped.outputs[0].token_ids))
+    out = _drain(engine)["stop-done"]
+    assert out.outputs[0].finish_reason == \
+        stopped.outputs[0].finish_reason
+    assert out.outputs[0].text == ref_text
+    assert out.resumed_text == ref_text
+
+
+def test_continuation_eos_on_last_emitted(engine):
+    """A kill between the EOS token and the closing writes: the
+    continuation sees EOS as its last emitted token and finishes on
+    arrival with reason 'stop'."""
+    eos = engine.tokenizer.get_lora_tokenizer().eos_token_id
+    sp = SamplingParams(temperature=0.0, max_tokens=8)
+    engine.add_request("eos-done", None, sp,
+                       prompt_token_ids=list(PROMPT),
+                       emitted_token_ids=[33, eos])
+    out = _drain(engine)["eos-done"]
+    assert out.outputs[0].finish_reason == "stop"
+    assert list(out.outputs[0].token_ids) == [33, eos]
+
+
+def test_continuation_rejects_multi_sequence(engine):
+    sp = SamplingParams(temperature=1.0, n=2, best_of=2, max_tokens=4)
+    with pytest.raises(ValueError, match="single-sequence"):
+        engine.add_request("multi", None, sp,
+                           prompt_token_ids=list(PROMPT),
+                           emitted_token_ids=[1, 2])
+
+
+def test_continuation_detok_resumes_mid_word(engine):
+    """resumed_text equals the incremental-detok text of the emitted
+    prefix (what the original stream delivered), even when the split
+    lands mid-word/mid-BPE-merge, and the deltas past it reconstruct
+    the unbroken text exactly."""
+    sp = SamplingParams(temperature=1.0, seed=99, max_tokens=12,
+                        ignore_eos=True)
+    full = _full_run(engine, sp, "detok-full")
+    ids = list(full.outputs[0].token_ids)
+    text = full.outputs[0].text
+    for k in range(1, 12, 3):
+        engine.add_request(f"detok-{k}", None, sp,
+                           prompt_token_ids=list(PROMPT),
+                           emitted_token_ids=ids[:k])
+        out = _drain(engine)[f"detok-{k}"]
+        # Baseline + remaining deltas == unbroken text, regardless of
+        # where the split fell.
+        assert out.outputs[0].text == text
+        assert text.startswith(out.resumed_text)

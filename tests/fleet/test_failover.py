@@ -1,0 +1,502 @@
+"""Mid-stream failover at the router: journaled token streams,
+the exactly-once continuation splice, and the truthful-truncation
+fallback — driven over real localhost HTTP against fake replicas that
+speak the journal/resume wire contract.
+
+The fakes emit deterministic token streams (token id ``100+i``, text
+``"t<i> "``) with one ``: aphrodite-journal`` record per data chunk,
+die on command after K chunks, and serve continuations from the
+``aphrodite_resume`` extension — so every splice behavior is pinned
+without engine builds.
+"""
+import asyncio
+import json
+
+import aiohttp
+import pytest
+from aiohttp import web
+
+from aphrodite_tpu.endpoints.utils import (JOURNAL_HEADER,
+                                           RESUME_KEY_HEADER)
+from aphrodite_tpu.fleet.replica import ReplicaHandle, ReplicaSnapshot
+from aphrodite_tpu.fleet.router import FleetRouter, _JournalTail
+
+
+def snap(state="RUNNING", depth=0):
+    import time
+    return ReplicaSnapshot(
+        state=state, draining=False, inflight=0, queue_depth=depth,
+        waiting_prefill_tokens=0, ewma_prefill_tok_s=1000.0,
+        polled_at=time.monotonic())
+
+
+class JournalingReplica:
+    """A fake engine server that speaks the journal/resume contract
+    for a deterministic 8-token stream."""
+
+    TOTAL = 8
+
+    def __init__(self, name, admin_key="k", die_after=None,
+                 replay_from_zero=False):
+        self.name = name
+        self.admin_key = admin_key
+        #: Close the socket after emitting this many TOKEN chunks
+        #: (continuations count from their resume point).
+        self.die_after = die_after
+        #: Buggy-upstream mode: a continuation re-emits the WHOLE
+        #: stream from token 0 (the router must dedupe the overlap).
+        self.replay_from_zero = replay_from_zero
+        self.requests = []
+        self.resume_keys = []
+        self.url = None
+        self._runner = None
+
+    async def start(self):
+        app = web.Application()
+        app.router.add_get("/health", self._health)
+        app.router.add_post("/v1/completions", self._completions)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        self.url = f"http://127.0.0.1:{self._runner.addresses[0][1]}"
+
+    async def stop(self):
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    def handle(self):
+        return ReplicaHandle(self.url, name=self.name,
+                             admin_key=self.admin_key)
+
+    async def _health(self, request):
+        return web.json_response({
+            "state": "RUNNING", "draining": False, "inflight": 0,
+            "overload": {"queue_depth": 0,
+                         "waiting_prefill_tokens": 0,
+                         "ewma_prefill_tok_s": 1000.0}})
+
+    async def _completions(self, request):
+        body = await request.json()
+        self.requests.append(body)
+        journaled = request.headers.get(JOURNAL_HEADER) not in (None,
+                                                                "", "0")
+        resume = body.get("aphrodite_resume")
+        start = 0
+        if resume is not None:
+            self.resume_keys.append(
+                request.headers.get(RESUME_KEY_HEADER))
+            start = len(resume["emitted_token_ids"])
+            if self.replay_from_zero:
+                start = 0
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream"})
+        await resp.prepare(request)
+        emitted = 0
+        for i in range(start, self.TOTAL):
+            if self.die_after is not None and emitted >= self.die_after:
+                request.transport.close()
+                return resp
+            fin = ',"fin":"length"' if i == self.TOTAL - 1 else ""
+            if journaled:
+                await resp.write(
+                    f': aphrodite-journal {{"t":[{100 + i}],'
+                    f'"n":{i + 1}{fin}}}\n'.encode())
+            await resp.write(
+                f'data: {{"text": "t{i} ", "replica": '
+                f'"{self.name}"}}\n\n'.encode())
+            emitted += 1
+        await resp.write(b"data: [DONE]\n\n")
+        await resp.write_eof()
+        return resp
+
+
+async def _make_router(fakes, **kw):
+    handles = [f.handle() for f in fakes]
+    router = FleetRouter(handles, name="test-router", **kw)
+    router._session = aiohttp.ClientSession(
+        timeout=aiohttp.ClientTimeout(total=None, sock_connect=5.0))
+    for h in handles:
+        h.snapshot = snap()
+    return router, handles
+
+
+async def _client_for(router):
+    runner = web.AppRunner(router.build_app())
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    return runner, f"http://127.0.0.1:{runner.addresses[0][1]}"
+
+
+def _texts(raw: bytes):
+    """Token texts of the client-visible stream, asserting no journal
+    record ever leaks to the client."""
+    assert b"aphrodite-journal" not in raw
+    texts, done = [], False
+    for line in raw.split(b"\n"):
+        if not line.startswith(b"data: "):
+            continue
+        payload = line[len(b"data: "):]
+        if payload.strip() == b"[DONE]":
+            done = True
+            continue
+        texts.append(json.loads(payload)["text"])
+    return texts, done
+
+
+# Keyless (no prompt → no affinity key) so the load-based pick is
+# what routes, making the "preferred" fake deterministic in tests.
+STREAM_BODY = {"stream": True, "max_tokens": 8}
+
+
+def test_mid_stream_death_resumes_exactly_once():
+    """The headline splice: replica a dies after 3 tokens; the router
+    re-issues a continuation (original body + the journaled ids +
+    the admin resume key) to b and splices — the client sees all 8
+    tokens exactly once and a clean [DONE]."""
+    async def go():
+        a = JournalingReplica("a", die_after=3)
+        b = JournalingReplica("b")
+        await a.start()
+        await b.start()
+        router, handles = await _make_router([a, b])
+        handles[0].snapshot = snap(depth=0)     # a preferred
+        handles[1].snapshot = snap(depth=5)
+        runner, base = await _client_for(router)
+        try:
+            async with aiohttp.ClientSession() as client:
+                resp = await client.post(base + "/v1/completions",
+                                         json=STREAM_BODY)
+                assert resp.status == 200
+                texts, done = _texts(await resp.read())
+            assert texts == [f"t{i} " for i in range(8)]
+            assert done
+            assert router.stats.resumed_mid_stream == 1
+            assert router.stats.truncated_client_streams == 0
+            assert router.stats.failed_mid_stream == 1
+            assert router.stats.served_streaming == 1
+            # The continuation carried exactly the delivered ids and
+            # the replica's admin key, on the original path.
+            cont = b.requests[-1]
+            assert cont["aphrodite_resume"]["emitted_token_ids"] == \
+                [100, 101, 102]
+            assert cont["max_tokens"] == STREAM_BODY["max_tokens"]
+            assert b.resume_keys == ["k"]
+            assert router._journals_active == 0
+        finally:
+            await runner.cleanup()
+            await router.stop()
+            await a.stop()
+            await b.stop()
+
+    asyncio.run(go())
+
+
+def test_double_death_double_resume():
+    """A second mid-stream death resumes again: a → b → c, all
+    spliced into one exactly-once client stream."""
+    async def go():
+        a = JournalingReplica("a", die_after=2)
+        b = JournalingReplica("b", die_after=3)
+        c = JournalingReplica("c")
+        fakes = [a, b, c]
+        for f in fakes:
+            await f.start()
+        router, handles = await _make_router(fakes)
+        handles[0].snapshot = snap(depth=0)
+        handles[1].snapshot = snap(depth=1)
+        handles[2].snapshot = snap(depth=2)
+        runner, base = await _client_for(router)
+        try:
+            async with aiohttp.ClientSession() as client:
+                resp = await client.post(base + "/v1/completions",
+                                         json=STREAM_BODY)
+                assert resp.status == 200
+                texts, done = _texts(await resp.read())
+            assert texts == [f"t{i} " for i in range(8)]
+            assert done
+            assert router.stats.resumed_mid_stream == 2
+            assert router.stats.truncated_client_streams == 0
+        finally:
+            await runner.cleanup()
+            await router.stop()
+            for f in fakes:
+                await f.stop()
+
+    asyncio.run(go())
+
+
+def test_replaying_continuation_dedupes_on_emitted_count():
+    """Exactly-once against a buggy/replaying upstream: the
+    continuation re-emits the whole stream from token 0; the router
+    suppresses every already-delivered record's data lines."""
+    async def go():
+        a = JournalingReplica("a", die_after=3)
+        b = JournalingReplica("b", replay_from_zero=True)
+        await a.start()
+        await b.start()
+        router, handles = await _make_router([a, b])
+        handles[0].snapshot = snap(depth=0)
+        handles[1].snapshot = snap(depth=5)
+        runner, base = await _client_for(router)
+        try:
+            async with aiohttp.ClientSession() as client:
+                resp = await client.post(base + "/v1/completions",
+                                         json=STREAM_BODY)
+                assert resp.status == 200
+                texts, done = _texts(await resp.read())
+            assert texts == [f"t{i} " for i in range(8)]
+            assert done
+            assert router.stats.resumed_mid_stream == 1
+        finally:
+            await runner.cleanup()
+            await router.stop()
+            await a.stop()
+            await b.stop()
+
+    asyncio.run(go())
+
+
+def test_truncation_fallback_when_no_peer():
+    """Retry-budget/fleet exhaustion keeps truthful truncation: with
+    no healthy peer the client sees the delivered prefix and no
+    [DONE], counted in truncated_client_streams."""
+    async def go():
+        a = JournalingReplica("a", die_after=3)
+        await a.start()
+        router, handles = await _make_router([a])
+        runner, base = await _client_for(router)
+        try:
+            async with aiohttp.ClientSession() as client:
+                resp = await client.post(base + "/v1/completions",
+                                         json=STREAM_BODY)
+                assert resp.status == 200
+                try:
+                    raw = await resp.read()
+                except aiohttp.ClientError:
+                    raw = b""
+                texts, done = _texts(raw)
+            # a was re-picked for the continuation and died again
+            # (each attempt re-delivers nothing new past the dedupe);
+            # eventually the budget runs out and the stream truncates.
+            assert not done
+            assert router.stats.truncated_client_streams == 1
+            assert router.stats.served_streaming == 0
+        finally:
+            await runner.cleanup()
+            await router.stop()
+            await a.stop()
+
+    asyncio.run(go())
+
+
+def test_journal_disabled_falls_back_to_truncation(monkeypatch):
+    """APHRODITE_ROUTER_JOURNAL_TOKENS=0 turns the feature off: a
+    mid-stream death truncates exactly like the pre-journal router,
+    and no peer ever sees a continuation."""
+    monkeypatch.setenv("APHRODITE_ROUTER_JOURNAL_TOKENS", "0")
+
+    async def go():
+        a = JournalingReplica("a", die_after=3)
+        b = JournalingReplica("b")
+        await a.start()
+        await b.start()
+        router, handles = await _make_router([a, b])
+        handles[0].snapshot = snap(depth=0)
+        handles[1].snapshot = snap(depth=5)
+        runner, base = await _client_for(router)
+        try:
+            async with aiohttp.ClientSession() as client:
+                resp = await client.post(base + "/v1/completions",
+                                         json=STREAM_BODY)
+                assert resp.status == 200
+                try:
+                    raw = await resp.read()
+                except aiohttp.ClientError:
+                    raw = b""
+                _texts(raw)     # journal lines still never leak
+            assert router.stats.truncated_client_streams == 1
+            assert router.stats.resumed_mid_stream == 0
+            assert len(b.requests) == 0
+        finally:
+            await runner.cleanup()
+            await router.stop()
+            await a.stop()
+            await b.stop()
+
+    asyncio.run(go())
+
+
+def test_journal_overflow_falls_back_to_truncation(monkeypatch):
+    """A stream past the per-stream journal bound stops journaling:
+    replica death then truncates instead of resuming with a partial
+    journal (which would lose tokens)."""
+    monkeypatch.setenv("APHRODITE_ROUTER_JOURNAL_TOKENS", "2")
+
+    async def go():
+        a = JournalingReplica("a", die_after=5)
+        b = JournalingReplica("b")
+        await a.start()
+        await b.start()
+        router, handles = await _make_router([a, b])
+        handles[0].snapshot = snap(depth=0)
+        handles[1].snapshot = snap(depth=5)
+        runner, base = await _client_for(router)
+        try:
+            async with aiohttp.ClientSession() as client:
+                resp = await client.post(base + "/v1/completions",
+                                         json=STREAM_BODY)
+                assert resp.status == 200
+                try:
+                    raw = await resp.read()
+                except aiohttp.ClientError:
+                    raw = b""
+                texts, done = _texts(raw)
+            assert texts == [f"t{i} " for i in range(5)]
+            assert not done
+            assert router.stats.truncated_client_streams == 1
+            assert router.stats.resumed_mid_stream == 0
+            assert len(b.requests) == 0
+        finally:
+            await runner.cleanup()
+            await router.stop()
+            await a.stop()
+            await b.stop()
+
+    asyncio.run(go())
+
+
+def test_multi_sequence_and_nonstream_requests_not_journaled():
+    """Journal eligibility: non-streaming bodies and multi-sequence
+    requests (resume cannot represent them) are never journaled."""
+    async def go():
+        a = JournalingReplica("a")
+        await a.start()
+        router, handles = await _make_router([a])
+        assert router._journal_context(_FakeReq("POST",
+                                                "/v1/completions"),
+                                       {"stream": True}, None) \
+            is not None
+        assert router._journal_context(
+            _FakeReq("POST", "/v1/completions"),
+            {"stream": True, "n": 2}, None) is None
+        assert router._journal_context(
+            _FakeReq("POST", "/v1/completions"),
+            {"stream": True, "best_of": 4}, None) is None
+        assert router._journal_context(
+            _FakeReq("POST", "/v1/completions"), {}, None) is None
+        assert router._journal_context(
+            _FakeReq("POST", "/v1/chat/completions"),
+            {"stream": True, "use_beam_search": True}, None) is None
+        assert router._journal_context(
+            _FakeReq("POST", "/metrics"), {"stream": True}, None) \
+            is None
+        # Kobold's stream path is always a token stream.
+        assert router._journal_context(
+            _FakeReq("POST", "/api/extra/generate/stream"),
+            {"prompt": "x"}, None) is not None
+        # A continuation is never wrapped again.
+        assert router._journal_context(
+            _FakeReq("POST", "/v1/completions"),
+            {"stream": True,
+             "aphrodite_resume": {"emitted_token_ids": []}},
+            None) is None
+        await router.stop()
+        await a.stop()
+
+    asyncio.run(go())
+
+
+class _FakeReq:
+    def __init__(self, method, path):
+        self.method = method
+        self.path = path
+        self.rel_url = path
+
+
+# ------------------------------------------------------------------
+# journal tail parser units
+# ------------------------------------------------------------------
+
+def test_journal_tail_commits_only_forwarded_data():
+    """A record commits only once its data line is forwarded — a kill
+    between record and data line must NOT count the token as
+    delivered (the continuation regenerates it)."""
+    tail = _JournalTail(max_tokens=100)
+    out = tail.feed(b': aphrodite-journal {"t":[7],"n":1}\n')
+    assert out == b""
+    assert tail.tokens == []          # record pending, not committed
+    out = tail.feed(b'data: {"x": 1}\n\n')
+    assert out == b'data: {"x": 1}\n\n'
+    assert tail.tokens == [7]
+    assert tail.active
+
+
+def test_journal_tail_holds_partial_lines():
+    """Torn lines are held back until complete — a mid-line death
+    never leaks a partial event to the client."""
+    tail = _JournalTail(max_tokens=100)
+    assert tail.feed(b'data: {"par') == b""
+    assert tail.feed(b'tial": 1}\n') == b'data: {"partial": 1}\n'
+    tail2 = _JournalTail(max_tokens=100)
+    assert tail2.feed(b': aphrodite-journal {"t":[1],"n"') == b""
+    assert tail2.tokens == []
+
+
+def test_journal_tail_dedupes_replayed_records():
+    tail = _JournalTail(max_tokens=100)
+    tail.feed(b': aphrodite-journal {"t":[1],"n":1}\ndata: a\n\n')
+    tail.feed(b': aphrodite-journal {"t":[2],"n":2}\ndata: b\n\n')
+    assert tail.tokens == [1, 2]
+    # Replay of token 2 (n == already delivered): suppressed.
+    out = tail.feed(
+        b': aphrodite-journal {"t":[2],"n":2}\ndata: b\n\n')
+    assert b"data: b" not in out
+    # The next NEW record resumes forwarding.
+    out = tail.feed(
+        b': aphrodite-journal {"t":[3],"n":3}\ndata: c\n\n')
+    assert b"data: c" in out
+    assert tail.tokens == [1, 2, 3]
+
+
+def test_journal_tail_kobold_event_line_does_not_commit():
+    """Kobold writes 'event: message' before its data line; only the
+    data line commits the pending record."""
+    tail = _JournalTail(max_tokens=100)
+    tail.feed(b': aphrodite-journal {"t":[5],"n":1}\n')
+    out = tail.feed(b"event: message\n")
+    assert out == b"event: message\n"
+    assert tail.tokens == []
+    tail.feed(b'data: {"token": "x"}\n\n')
+    assert tail.tokens == [5]
+
+
+def test_journal_tail_ooba_json_line_commits():
+    tail = _JournalTail(max_tokens=100)
+    tail.feed(b': aphrodite-journal {"t":[9],"n":1,"fin":"stop"}\n')
+    tail.feed(b'{"results": [{"text": "x"}]}\n\n')
+    assert tail.tokens == [9]
+    assert tail.fin == "stop"
+
+
+# ------------------------------------------------------------------
+# health-poll jitter
+# ------------------------------------------------------------------
+
+def test_poll_phase_deterministic_and_spread():
+    """Per-(router, replica) phase offsets are deterministic, lie in
+    [0, 1), and de-synchronize both across replicas and across
+    routers — no fleet-wide /health?probe=1 storm at each tick."""
+    replicas = [ReplicaHandle(f"http://x{i}", name=f"r{i}")
+                for i in range(8)]
+    r1 = FleetRouter(replicas, name="router-A")
+    r2 = FleetRouter(replicas, name="router-A")
+    r3 = FleetRouter(replicas, name="router-B")
+    phases1 = [r1.poll_phase(r) for r in replicas]
+    assert phases1 == [r2.poll_phase(r) for r in replicas]
+    assert all(0.0 <= p < 1.0 for p in phases1)
+    assert len(set(phases1)) >= 6       # spread, not clustered
+    phases3 = [r3.poll_phase(r) for r in replicas]
+    assert phases1 != phases3           # routers de-synchronized

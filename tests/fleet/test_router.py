@@ -446,6 +446,29 @@ def test_probe_parse_retry_after_roundtrip():
     assert parse_retry_after({"Retry-After": "nope"}) is None
 
 
+def test_parse_retry_after_http_date_form():
+    """RFC 7231 allows Retry-After as an HTTP-date, and an
+    intermediate proxy can legally rewrite the delta-seconds form to
+    one — it must parse to the remaining seconds, not None."""
+    import email.utils
+    import time as _time
+    from aphrodite_tpu.endpoints.utils import parse_retry_after
+
+    future = email.utils.formatdate(_time.time() + 30, usegmt=True)
+    got = parse_retry_after({"Retry-After": future})
+    assert got is not None and 25.0 <= got <= 31.0
+    # A date in the past clamps to 0 (retry immediately), like the
+    # numeric form's negative clamp — never None, never negative.
+    past = email.utils.formatdate(_time.time() - 30, usegmt=True)
+    assert parse_retry_after({"Retry-After": past}) == 0.0
+    # Non-GMT zoned dates are legal RFC 5322 and convert correctly.
+    zoned = email.utils.formatdate(_time.time() + 60, localtime=True)
+    got = parse_retry_after({"Retry-After": zoned})
+    assert got is not None and 55.0 <= got <= 61.0
+    # Garbage that is neither form still parses to None.
+    assert parse_retry_after({"Retry-After": "Wed, banana"}) is None
+
+
 def test_affinity_key_extraction():
     router = FleetRouter([ReplicaHandle("http://x", name="r")])
     key_ids = router.affinity_key({}, {"prompt": [1, 2, 3]})
