@@ -473,3 +473,36 @@ _register(Flag(
     "preempted group re-prefills from scratch — an undamped storm "
     "collapses goodput under page pressure).",
     minimum=1))
+
+_register(Flag(
+    "APHRODITE_SPEC", "bool", True,
+    "Self-drafting speculative decoding (n-gram/prompt-lookup "
+    "drafter + multi-token verify on the decode path); 0 pins the "
+    "classic single-token decode path for A/B runs."))
+
+_register(Flag(
+    "APHRODITE_SPEC_K", "int", 4,
+    "Max draft tokens proposed per sequence per speculative round "
+    "(the verify step scores k+1 positions per row in one dispatch).",
+    minimum=1, strict=True))
+
+_register(Flag(
+    "APHRODITE_SPEC_NGRAM_MAX", "int", 4,
+    "Longest suffix n-gram the drafter matches against the "
+    "request's own prompt+output history (tried first; falls back "
+    "to shorter n-grams down to APHRODITE_SPEC_NGRAM_MIN).",
+    minimum=1, strict=True))
+
+_register(Flag(
+    "APHRODITE_SPEC_NGRAM_MIN", "int", 1,
+    "Shortest suffix n-gram the drafter falls back to before "
+    "declaring no proposal for this round.",
+    minimum=1, strict=True))
+
+_register(Flag(
+    "APHRODITE_SPEC_BACKOFF", "float", 0.3,
+    "Acceptance-EWMA back-off threshold: a sequence whose "
+    "accepted/proposed EWMA falls below this drafts a single probe "
+    "token per round until acceptance recovers (adaptive back-off "
+    "on hostile traffic).",
+    minimum=0, strict=True))

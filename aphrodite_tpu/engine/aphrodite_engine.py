@@ -40,6 +40,7 @@ from aphrodite_tpu.executor.executor import TPUExecutor
 from aphrodite_tpu.processing.admission import (AdmissionController,
                                                 AdmissionSnapshot,
                                                 RequestTimeoutError)
+from aphrodite_tpu.processing.drafter import NgramDrafter
 from aphrodite_tpu.processing.scheduler import (Scheduler,
                                                 SchedulerOutputs)
 from aphrodite_tpu.transformers_utils.tokenizer import (
@@ -141,6 +142,11 @@ class AphroditeEngine:
                                     device_config, lora_config)
         self.scheduler = Scheduler(scheduler_config, cache_config,
                                    lora_config)
+        # Self-drafting speculative decoding: host-side prompt-lookup
+        # drafter feeding the widened verify dispatch (_spec_round).
+        # Advisory per-seq acceptance state only — it survives
+        # reincarnation harmlessly (seq_ids never repeat).
+        self.drafter = NgramDrafter()
         # Overload control: throughput EWMAs + shed/expired counters
         # (processing/admission.py). The async frontend consults it
         # via try_admit BEFORE a request touches the tracker.
@@ -575,6 +581,18 @@ class AphroditeEngine:
         n_chunks = len(scheduler_outputs.prompt_chunks)
         prompt_mds = seq_group_metadata_list[:n_chunks]
         decode_mds = seq_group_metadata_list[n_chunks:]
+
+        if decode_mds and not prompt_mds:
+            # Speculative round first: when the drafter has proposals,
+            # one verify dispatch can emit up to k+1 tokens per row —
+            # strictly better amortization of the weight stream than
+            # the burst scan's one token per device step. Falls back
+            # to the classic burst/single-step path (None) whenever
+            # drafting or eligibility fails.
+            spec = self._spec_round(decode_mds, scheduler_outputs)
+            if spec is not None:
+                return spec
+
         burst, extra_cap = (self._burst_steps(decode_mds,
                                               scheduler_outputs)
                             if decode_mds else (1, None))
@@ -765,6 +783,137 @@ class AphroditeEngine:
             groups=scheduler_outputs.decode_groups)
         return 1 << ((1 + granted).bit_length() - 1), extra_cap
 
+    # -- speculative decoding (self-drafting verify rounds) --
+
+    def _spec_eligible(self, decode_mds) -> bool:
+        """Every group must fit the fused-sampler verify dispatch:
+        the burst-scan conditions (single-seq, no beam / custom
+        processors / mirostat-2 / prompt logprobs / history-dependent
+        penalties) PLUS no per-token logprob requests and best_of=1 —
+        the verify step reuses the pinned fast-path program
+        (max_best_of=1, num_topk=0), and a single ineligible row
+        routes the whole round to the classic path."""
+        for md in decode_mds:
+            p = md.sampling_params
+            if (len(md.seq_data) != 1 or p.use_beam_search
+                    or p.logits_processors or p.mirostat_mode == 2
+                    or p.prompt_logprobs is not None
+                    or (p.logprobs or 0) > 0 or p.best_of > 1
+                    or abs(p.presence_penalty) >= 1e-5
+                    or abs(p.frequency_penalty) >= 1e-5
+                    or abs(p.repetition_penalty - 1.0) >= 1e-5):
+                return False
+        return True
+
+    def _spec_round(self, decode_mds,
+                    scheduler_outputs) -> Optional[List[RequestOutput]]:
+        """One speculative decode round, or None for the classic path.
+
+        Drafts per sequence from its own joint (prompt + output) token
+        history, reserves KV pages for the drafted positions through
+        the same watermark-respecting seam as the burst scan, verifies
+        all rows in one widened dispatch, and applies the accepted
+        runs. `APHRODITE_SPEC=0` pins the classic path for A/B."""
+        if not flags.get_bool("APHRODITE_SPEC"):
+            return None
+        if self.model_config.get_sliding_window() is not None:
+            return None
+        if not self._spec_eligible(decode_mds):
+            return None
+
+        k_max = flags.get_int("APHRODITE_SPEC_K")
+        drafts: Dict[int, List[int]] = {}
+        extra_cap: Dict[int, int] = {}
+        for md in decode_mds:
+            (seq_id,) = md.seq_data.keys()
+            data = md.seq_data[seq_id]
+            p = md.sampling_params
+            draft = self.drafter.propose(seq_id, data.get_token_ids(),
+                                         k_max)
+            # Clamp to USEFUL width: the round emits up to k+1 tokens,
+            # and the verify rows write KV at positions L-1+j, so k is
+            # bounded by model-len room and tokens remaining.
+            room = self.scheduler_config.max_model_len - data.get_len()
+            if p.max_tokens is not None:
+                room = min(room,
+                           p.max_tokens - data.get_output_len() - 1)
+            draft = draft[:max(0, room)]
+            drafts[seq_id] = draft
+            extra_cap[seq_id] = len(draft)
+        want = max(extra_cap.values(), default=0)
+        if want <= 0:
+            return None
+
+        # Page reservation for the drafted positions — same seam and
+        # same watermark/preempt-budget discipline as the burst scan
+        # (reserve_decode_burst honors the allocator watermark AND the
+        # admission low-watermark reserve; it shrinks the grant, never
+        # evicts). A zero grant under pressure degrades to classic.
+        self._check_epoch()
+        granted = self.scheduler.reserve_decode_burst(
+            decode_mds, want, extra_cap,
+            groups=scheduler_outputs.decode_groups)
+        if granted < want:
+            drafts = {sid: d[:granted] for sid, d in drafts.items()}
+        if not any(drafts.values()):
+            return None
+
+        results = self.executor.execute_spec_verify(
+            decode_mds, drafts,
+            scheduler_outputs.blocks_to_swap_in,
+            scheduler_outputs.blocks_to_swap_out,
+            scheduler_outputs.blocks_to_copy)
+        return self._process_spec_round(results, scheduler_outputs)
+
+    def _process_spec_round(
+            self, results,
+            scheduler_outputs: SchedulerOutputs) -> List[RequestOutput]:
+        """Apply each group's accepted token run (multi-token append +
+        incremental detok per token; tokens past a stop are dropped)
+        and feed the drafter's acceptance EWMA."""
+        if getattr(self._step_tls, "epoch", self._epoch) != self._epoch:
+            raise StaleEngineStepError(
+                "engine step outlived a reincarnation; its outputs "
+                "are discarded")
+        decode_groups = scheduler_outputs.decode_groups
+        tokens_of = {}
+        failed: set = set()
+        for group, res in zip(decode_groups, results):
+            tokens_of[id(group)] = 0
+            if group.is_finished():
+                continue
+            seq = group.get_seqs(status=SequenceStatus.RUNNING)[0]
+            before = seq.get_output_len()
+            outputs = SequenceGroupOutput(list(res.samples), None)
+            if self._process_group_isolated(group, outputs,
+                                            multi_token=True):
+                tokens_of[id(group)] = seq.get_output_len() - before
+                if res.proposed:
+                    self.drafter.observe(seq.seq_id, res.proposed,
+                                         res.accepted)
+                if seq.is_finished():
+                    self.drafter.forget(seq.seq_id)
+            else:
+                failed.add(id(group))
+        touched = [g for g in decode_groups if id(g) not in failed]
+        self._record_latencies(touched, tokens_of=tokens_of)
+        self.scheduler.free_finished_seq_groups()
+
+        request_outputs = [
+            RequestOutput.from_seq_group(g) for g in touched
+        ]
+        for seq_group in scheduler_outputs.ignored_seq_groups:
+            request_outputs.append(
+                RequestOutput.from_seq_group(seq_group))
+        generation_tokens = sum(tokens_of[id(g)] for g in decode_groups)
+        self.admission.observe_round(
+            scheduler_outputs.num_prefill_tokens, generation_tokens)
+        if self.stat_logger is not None:
+            self.stat_logger.log(self._get_stats(
+                scheduler_outputs,
+                generation_tokens=generation_tokens))
+        return request_outputs
+
     # -- output processing (reference :550-752) --
 
     def _process_round(
@@ -854,7 +1003,8 @@ class AphroditeEngine:
                 self._e2e_samples.append(now - group.arrival_time)
 
     def _process_group_isolated(self, seq_group: SequenceGroup,
-                                outputs: SequenceGroupOutput) -> bool:
+                                outputs: SequenceGroupOutput,
+                                multi_token: bool = False) -> bool:
         """Apply one group's sampled outputs, quarantining request-
         scoped failures (tokenizer/decode errors, per-sequence sampler
         state bugs): the culprit request is aborted, its pages freed,
@@ -863,7 +1013,8 @@ class AphroditeEngine:
         failures re-raise into the crash barrier. Returns True when
         processing succeeded."""
         try:
-            self._process_sequence_group_outputs(seq_group, outputs)
+            self._process_sequence_group_outputs(seq_group, outputs,
+                                                 multi_token=multi_token)
             return True
         except Exception as exc:
             cls = classify_failure(exc, default=FaultClass.REQUEST)
@@ -889,10 +1040,31 @@ class AphroditeEngine:
 
     def _process_sequence_group_outputs(
             self, seq_group: SequenceGroup,
-            outputs: SequenceGroupOutput) -> None:
+            outputs: SequenceGroupOutput,
+            multi_token: bool = False) -> None:
         # Forks/frees below commit against the scheduler; a stale
         # (reincarnation-outlived) step must not touch the rebuilt one.
         self._check_epoch()
+        if multi_token:
+            # Speculative verify: `samples` is an ACCEPTED RUN of
+            # consecutive tokens for ONE sequence (not sibling samples
+            # of a step). Append in order with per-token incremental
+            # detok and stop checks — tokens past the first satisfied
+            # stop are dropped, exactly as a classic round-by-round
+            # decode would never have produced them.
+            params = seq_group.sampling_params
+            (seq,) = seq_group.get_seqs(status=SequenceStatus.RUNNING)
+            for sample in outputs.samples:
+                seq.append_token_id(sample.output_token,
+                                    sample.logprobs)
+                seq.persistent_data = sample.persistent_data
+                self._decode_sequence(seq, params)
+                self._check_stop(seq, params)
+                if seq.is_finished():
+                    break
+            if seq.is_finished():
+                self.scheduler.free_seq(seq)
+            return
         # Prompt logprobs.
         if outputs.prompt_logprobs is not None:
             seq_group.prompt_logprobs = outputs.prompt_logprobs

@@ -272,6 +272,24 @@ class TPUExecutor:
         self.cache_engine.kv_caches = new_caches
         return outputs
 
+    def execute_spec_verify(
+        self,
+        seq_group_metadata_list: List[SequenceGroupMetadata],
+        drafts,
+        blocks_to_swap_in: Dict[int, int],
+        blocks_to_swap_out: Dict[int, int],
+        blocks_to_copy: Dict[int, List[int]],
+    ):
+        """Speculative verify round: k+1 rows per drafted sequence in
+        one dispatch (see ModelRunner.execute_spec_verify)."""
+        self._pre_step(seq_group_metadata_list, blocks_to_swap_in,
+                       blocks_to_swap_out)
+        results, new_caches = self.model_runner.execute_spec_verify(
+            seq_group_metadata_list, self.cache_engine.kv_caches,
+            drafts, blocks_to_copy)
+        self.cache_engine.kv_caches = new_caches
+        return results
+
     def dispatch_prompt_round(
         self,
         prompt_metadata: List[SequenceGroupMetadata],

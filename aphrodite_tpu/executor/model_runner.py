@@ -20,7 +20,7 @@ from __future__ import annotations
 import bisect
 import contextlib
 import functools
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 import jax
@@ -34,6 +34,7 @@ from aphrodite_tpu.common.sampling_params import SamplingType
 from aphrodite_tpu.common.sequence import (SamplerOutput,
                                            SequenceGroupMetadata)
 from aphrodite_tpu.modeling.input_metadata import InputMetadata
+from aphrodite_tpu.modeling.layers.rejection import delta_rejection_length
 from aphrodite_tpu.modeling.layers.sampler import (Sampler, fused_sample,
                                                    _fused_sample_jit)
 from aphrodite_tpu.modeling.sampling_metadata import (OutputMetadata,
@@ -70,6 +71,19 @@ def _pow2_bucket(value: int, lo: int = 16) -> int:
     while b < value:
         b *= 2
     return b
+
+
+class SpecVerifyResult(NamedTuple):
+    """Per-group outcome of one speculative verify dispatch.
+
+    `samples` is the ACCEPTED run in emission order (1..k+1
+    SequenceOutputs): the matched draft prefix plus the first-mismatch
+    target sample, or the bonus sample on full acceptance. `accepted`
+    counts matched drafts (the drafter's EWMA signal, independent of
+    any stop condition the engine applies afterwards)."""
+    samples: list
+    accepted: int
+    proposed: int
 
 
 class StepHandle:
@@ -906,3 +920,182 @@ class ModelRunner:
                 num_topk=plan.num_topk)
         return StepHandle(packed, sampling, plan,
                           num_steps=num_steps), kv_caches
+
+    def _prepare_spec_verify(
+        self,
+        seq_group_metadata_list: List[SequenceGroupMetadata],
+        drafts: Dict[int, List[int]],
+    ) -> Tuple[dict, SamplingMetadata, List[int], List[int]]:
+        """Build the widened verify batch: each sequence with k_i draft
+        tokens contributes k_i+1 contiguous (seq, position) rows to the
+        ragged decode work list. Row j carries the token at position
+        L-1+j (the real last token for j=0, draft j-1 after) and
+        attends with ctx = L+j, so row j sees exactly the tokens the
+        classic path would have at that output position — the KV
+        scatter for ALL rows lands before attention, and per-row
+        context_lens masking keeps later rows invisible to earlier
+        ones. Returns (inputs, sampling, row position offsets for the
+        PRNG salt, rows per group)."""
+        seq_groups, seq_data_map, persistent = [], {}, {}
+        tokens, positions, slot_list, ctx_list, tables_list = \
+            [], [], [], [], []
+        row_offsets: List[int] = []
+        rows_per_group: List[int] = []
+
+        for md in seq_group_metadata_list:
+            (seq_id,) = md.seq_data.keys()
+            data = md.seq_data[seq_id]
+            seq_data_map[seq_id] = data
+            persistent[seq_id] = md.persistent_data.get(seq_id, {})
+            table = md.block_tables[seq_id]
+            draft = drafts.get(seq_id) or []
+            rows_per_group.append(len(draft) + 1)
+            base_pos = data.get_len() - 1
+            row_tokens = [data.get_last_token_id()] + list(draft)
+            for j, tok in enumerate(row_tokens):
+                # One single-seq group PER ROW: the sampler plan then
+                # derives each row's knobs and seed base independently
+                # and finalize emits one output per row.
+                seq_groups.append(([seq_id], md.sampling_params))
+                tokens.append(int(tok))
+                pos = base_pos + j
+                positions.append(pos)
+                # Direct index (no wrap): the scheduler's speculative
+                # page reservation must cover position L-1+k; an
+                # IndexError here means the reservation contract broke.
+                page = table[pos // self.page_size]
+                slot_list.append(page * self.page_size +
+                                 pos % self.page_size)
+                ctx_list.append(pos + 1)
+                tables_list.append(table)
+                row_offsets.append(j)
+
+        batch = len(tokens)
+        padded_batch = _bucket(batch, _DECODE_BATCH_BUCKETS)
+        max_pages = max(len(t) for t in tables_list)
+        max_pages = -(-max_pages // self.pages_bucket) * \
+            self.pages_bucket
+
+        ids = np.zeros((padded_batch, 1), dtype=np.int32)
+        pos_arr = np.zeros((padded_batch, 1), dtype=np.int32)
+        slots = np.full((padded_batch,), self.num_slots, dtype=np.int32)
+        ctx_lens = np.zeros((padded_batch,), dtype=np.int32)
+        num_pages_oob = self.num_slots // self.page_size
+        tables = np.full((padded_batch, max_pages), num_pages_oob,
+                         dtype=np.int32)
+
+        ids[:batch, 0] = tokens
+        pos_arr[:batch, 0] = positions
+        slots[:batch] = slot_list
+        ctx_lens[:batch] = ctx_list
+        for i, t in enumerate(tables_list):
+            tables[i, :len(t)] = t
+
+        # Verify rows legitimately SHARE pages (consecutive positions
+        # of one sequence); the decode invariant that still holds is
+        # slot-exclusivity, which the XLA scatter needs.
+        if __debug__ and flags.get_bool("APHRODITE_DEBUG_KV"):
+            assert len(set(slot_list)) == len(slot_list), (
+                "spec verify rows share a KV slot: "
+                f"{sorted(slot_list)}")
+
+        ppc = choose_pages_per_chunk(max_pages, self.page_size,
+                                     padded_batch)
+        page_counts = [len(t) for t in tables_list] + \
+            [0] * (padded_batch - batch)
+        nw_real = sum(max(1, -(-c // ppc)) for c in page_counts)
+        chunks_cap = -(-max_pages // ppc)
+        mix = 1
+        while padded_batch * mix < nw_real:
+            mix *= 2
+        wi_seq, wi_chunk = build_decode_work_list(
+            page_counts, ppc,
+            pad_to=padded_batch * min(mix, chunks_cap))
+
+        metadata = InputMetadata(
+            slot_mapping=self._dev(slots),
+            block_tables=self._dev(tables),
+            context_lens=self._dev(ctx_lens),
+            kv_scale=self.kv_scale,
+            tp=self._tp,
+            decode_work=(self._dev(wi_seq), self._dev(wi_chunk)),
+            decode_ppc=ppc,
+            spec_verify=True,
+        )
+        sampling = SamplingMetadata(
+            seq_groups=seq_groups,
+            seq_data=seq_data_map,
+            prompt_lens=[],
+            selected_token_indices=jnp.arange(batch, dtype=jnp.int32),
+            categorized_sample_indices={},
+            persistent_metadata=PersistentMetadata(persistent),
+        )
+        inputs = dict(input_ids=self._dev(ids),
+                      positions=self._dev(pos_arr), metadata=metadata,
+                      sel=self._dev(np.arange(padded_batch,
+                                              dtype=np.int32)),
+                      num_rows=batch,
+                      is_prompt=False, use_prefix=False)
+        return inputs, sampling, row_offsets, rows_per_group
+
+    def execute_spec_verify(
+        self,
+        seq_group_metadata_list: List[SequenceGroupMetadata],
+        kv_caches: List[Tuple[jax.Array, jax.Array]],
+        drafts: Dict[int, List[int]],
+        blocks_to_copy: Optional[Dict[int, List[int]]] = None,
+    ) -> Tuple[List[SpecVerifyResult],
+               List[Tuple[jax.Array, jax.Array]]]:
+        """Score k+1 positions per sequence in ONE dispatch and run
+        delta rejection over each drafted suffix host-side.
+
+        Emitted distribution is the classic path's by construction:
+        row j samples from the TARGET with the PRNG salt of output
+        position output_len+j (never a per-step salt), so greedy and
+        seeded streams are bit-equal to `APHRODITE_SPEC=0`. Eligibility
+        (single-seq groups, fused-sampler statics pinned at best_of=1 /
+        no logprobs, no penalties) is enforced by the engine."""
+        kv_caches = self._apply_block_copies(kv_caches, blocks_to_copy)
+        inputs, sampling, row_offsets, rows_per_group = \
+            self._prepare_spec_verify(seq_group_metadata_list, drafts)
+        padded = inputs["input_ids"].shape[0]
+        params = self._params_with_lora(seq_group_metadata_list, padded,
+                                        rows_per_group)
+        plan = self.sampler.plan(sampling, pad_to=padded)
+        assert plan.max_best_of == 1 and plan.num_topk == 0 and \
+            not plan.need_logprobs, "spec verify eligibility broken"
+
+        # The acceptance rule consumes salts per OUTPUT POSITION: row j
+        # of a sequence gets salt1 = output_len + j, exactly the salt
+        # the classic path uses when it reaches that position (plan
+        # salts are host numpy until this point, so the offset is a
+        # plain in-place add).
+        salt1 = np.asarray(plan.salt1, dtype=np.int32).copy()
+        salt1[:len(row_offsets)] += np.asarray(row_offsets,
+                                               dtype=np.int32)
+        with self._mesh_ctx():
+            packed, kv_caches = self._step_sample_fn(
+                params, inputs["input_ids"], inputs["positions"],
+                kv_caches, inputs["metadata"], inputs["sel"],
+                self._dev_tree(plan.tensors),
+                self._dev(np.asarray(plan.bases)),
+                self._dev(salt1),
+                self._dev(np.asarray(plan.salt2)),
+                is_prompt=False, use_prefix=False,
+                max_best_of=plan.max_best_of, num_topk=plan.num_topk)
+        packed_np = np.asarray(packed)                     # ONE sync
+        per_row = self.sampler.finalize(sampling, plan, packed_np, None)
+
+        results: List[SpecVerifyResult] = []
+        row = 0
+        for md, n_rows in zip(seq_group_metadata_list, rows_per_group):
+            (seq_id,) = md.seq_data.keys()
+            draft = drafts.get(seq_id) or []
+            rows = per_row[row:row + n_rows]
+            sampled = [g.samples[0].output_token for g in rows]
+            m = delta_rejection_length(sampled, draft)
+            results.append(SpecVerifyResult(
+                samples=[rows[j].samples[0] for j in range(m + 1)],
+                accepted=m, proposed=len(draft)))
+            row += n_rows
+        return results, kv_caches

@@ -46,6 +46,15 @@ class InputMetadata:
     decode_work: Optional[tuple] = None
 
     is_prompt: bool = struct.field(pytree_node=False, default=False)
+    # Speculative verify batch: rows are (sequence, position) work
+    # items — a sequence may own SEVERAL rows at consecutive
+    # positions, all mapping into the SAME KV pages. Static because
+    # it routes around two one-token-per-page-per-step assumptions:
+    # the fused in-kernel KV write and the pipelined distinct-pages
+    # writer (both assume each page is touched by at most one row).
+    # The verify batch takes the XLA scatter write (distinct SLOTS,
+    # shared pages) + read-only attention instead.
+    spec_verify: bool = struct.field(pytree_node=False, default=False)
     # Tensor-parallel degree of the mesh the step runs on (1 = single
     # device). Static: it routes kernel selection — the Pallas paged
     # attention / KV-writer kernels are single-device programs, so a

@@ -111,8 +111,11 @@ class PagedAttention:
                     kv_scale=metadata.kv_scale,
                     # Decode: one token per sequence, pages are
                     # sequence-exclusive -> the pipelined page writer
-                    # is safe.
-                    distinct_pages=not metadata.is_prompt)
+                    # is safe. Speculative verify rows share pages
+                    # (k+1 consecutive positions per sequence), so
+                    # they must keep the slot-wise scatter.
+                    distinct_pages=(not metadata.is_prompt and
+                                    not metadata.spec_verify))
             if not pallas_write:
                 # XLA-scatter path only: keep the scatter un-fused from
                 # its readers — fusing the in-place page update into the
@@ -147,9 +150,13 @@ class PagedAttention:
         (pos % window, computed host-side in _prepare_decode); the
         fused kernel derives the write position as ctx-1, which the
         window clamp pins — so windowed models MUST keep the
-        slot-mapped writer path."""
+        slot-mapped writer path. Speculative verify batches carry
+        several rows per sequence into the same page; the fused
+        write's one-row-per-page assumption does not hold, so they
+        scatter first and attend read-only."""
         return (k_pages is not None and
                 not metadata.is_prompt and
+                not metadata.spec_verify and
                 self.sliding_window is None and
                 self._pallas_decode_ok(k_pages, metadata))
 

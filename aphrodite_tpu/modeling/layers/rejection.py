@@ -6,17 +6,47 @@ Speculative Sampling", arXiv:2302.01318). TPU-native rewrite: a pure
 jittable function over [batch, k, vocab] probability tensors — no
 module state, no device bookkeeping; acceptance, recovered-distribution
 sampling, and the after-first-rejection masking are all dense vector
-ops. Like the reference, the sampler is present-but-unwired: the
-speculative-decoding scheduler lands in a later round, and the
-statistical test (tests/samplers/test_rejection.py) pins the output
-distribution to the target model's.
+ops. The engine's self-drafting path (processing/drafter.py +
+ModelRunner.execute_spec_verify) uses the DELTA-PROPOSAL
+specialization below: an n-gram drafter is a point-mass proposal
+q = one-hot(draft), for which the general accept/recover machinery
+collapses to `target-sample == draft` (`delta_rejection_length`) —
+provably the same emitted distribution, and bit-equal to classic
+decode for greedy and seeded sampling. The general tensor form stays
+for model-drafted proposals; the statistical test
+(tests/samplers/test_rejection.py) pins the output distribution to
+the target model's.
 """
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+
+
+def delta_rejection_length(sampled: Sequence[int],
+                           drafted: Sequence[int]) -> int:
+    """Accepted-prefix length for a POINT-MASS draft distribution.
+
+    With q = one-hot(d_j), the acceptance test of
+    `rejection_sample` — u * q(d_j) < p(d_j), i.e. accept d_j with
+    probability p(d_j) — and its recovered distribution
+    norm(max(0, p - q)) = p restricted to tokens != d_j are together
+    equivalent to: sample s_j ~ p and accept iff s_j == d_j
+    (P[emit d] = p(d); P[emit x != d] = (1 - p(d)) * p(x)/(1 - p(d))
+    = p(x)). The verify step therefore samples every row from the
+    TARGET with the row's own positional PRNG salt and this helper
+    computes the accepted prefix host-side; emitted tokens are the
+    accepted drafts plus the first-mismatch target sample (or the
+    bonus sample on full acceptance) — bit-equal to classic decode
+    for greedy and seeded rows by construction."""
+    n = 0
+    for s, d in zip(sampled, drafted):
+        if int(s) != int(d):
+            break
+        n += 1
+    return n
 
 
 def _categorical(key: jax.Array, probs: jax.Array) -> jax.Array:

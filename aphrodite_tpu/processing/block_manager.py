@@ -260,6 +260,24 @@ class BlockSpaceManager:
         while len(table) < needed:
             table.append(self.hbm_pool.allocate())
 
+    def trim_reserved(self, seq: Sequence) -> int:
+        """Release look-ahead pages reserved past the sequence's
+        current length (the rollback seam for reserve_slots: burst or
+        speculative reservations whose tokens were never emitted).
+        Only unshared TPU tail blocks are trimmed — a shared or
+        swapped tail means the pages are owned by more than this
+        reservation. Returns the number of pages freed."""
+        table = self.block_tables.get(seq.seq_id)
+        if not table or self.block_sliding_window is not None:
+            return 0
+        needed = (seq.get_len() - 1) // self.block_size + 1
+        freed = 0
+        while len(table) > needed and table[-1].ref_count == 1 and \
+                table[-1].device == Device.TPU:
+            self.hbm_pool.free(table.pop())
+            freed += 1
+        return freed
+
     def fork(self, parent_seq: Sequence, child_seq: Sequence) -> None:
         src_block_table = self.block_tables[parent_seq.seq_id]
         self.block_tables[child_seq.seq_id] = src_block_table.copy()

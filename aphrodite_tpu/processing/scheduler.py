@@ -247,6 +247,21 @@ class Scheduler:
         return int(frac * self.block_manager.num_total_gpu_blocks) + \
             running_slots
 
+    def _reclaim_reservations(self, *queues) -> int:
+        """Trim burst/speculative look-ahead pages reserved past each
+        sequence's current length (block_manager.trim_reserved) across
+        the given group queues. Reservations are re-granted after
+        scheduling (reserve_decode_burst runs post-schedule), so a
+        trimmed row loses at most one round of look-ahead, never
+        correctness. Returns pages freed."""
+        freed = 0
+        for queue in queues:
+            for group in queue:
+                for seq in group.get_seqs(
+                        status=SequenceStatus.RUNNING):
+                    freed += self.block_manager.trim_reserved(seq)
+        return freed
+
     # ------------------------------------------------------------------
 
     def _fit_chunk(self, remaining: int, seq_lens: List[int],
@@ -520,9 +535,22 @@ class Scheduler:
         running: Deque[SequenceGroup] = deque()
         preempted: List[SequenceGroup] = []
         deferred: List[SequenceGroup] = []
+        reclaimed = False
         while self.running:
             seq_group = self.running.popleft()
             while not self.block_manager.can_append_slot(seq_group):
+                if not reclaimed:
+                    # First resort under page pressure: pull back
+                    # burst/speculative look-ahead pages reserved past
+                    # each row's current length before evicting anyone
+                    # — a k-token speculative reservation must never
+                    # force an eviction cascade while its own unused
+                    # pages could cover the shortfall. One sweep per
+                    # round (it reclaims everything reclaimable).
+                    reclaimed = True
+                    if self._reclaim_reservations(
+                            (seq_group,), running, self.running) > 0:
+                        continue
                 if len(preempted) >= preempt_budget:
                     deferred.append(seq_group)
                     break
@@ -833,9 +861,15 @@ class Scheduler:
         # Leave the allocator watermark untouched so speculative burst
         # reservations never starve prompt admission (can_allocate) or
         # peer decode groups (can_append_slot); also keep waiting work
-        # from stalling behind long bursts.
+        # from stalling behind long bursts. The admission low-watermark
+        # reserve (APHRODITE_PAGE_LOW_WATERMARK + one page per running
+        # sequence) is honored too: a k-token speculative reservation
+        # is best-effort and must never eat the pages that keep
+        # can_append_slot from evicting running groups next round —
+        # reservation shrinks, it never forces an eviction cascade.
         free = (self.block_manager.get_num_free_gpu_blocks() -
-                self.block_manager.watermark_blocks)
+                self.block_manager.watermark_blocks -
+                self._admission_page_reserve())
         granted = 0
         for t in range(1, max_extra + 1):
             needed = sum(

@@ -9,6 +9,15 @@ Prints one JSON line in bench.py's round-5 convention:
 {"metric", "value", "samples", "n_runs", ...} — value is the MEDIAN of
 --runs timed runs (GC paused per run), every sample rides along so the
 run-to-run spread stays visible to driver captures.
+
+`--spec-ab` switches to the speculative-decoding A/B: the SAME engine
+runs a prefix-heavy bs=1 greedy stream twice — APHRODITE_SPEC=0
+(classic, one token per dispatch at multi_step=1) then =1 (n-gram
+draft + multi-token verify) — and reports accepted tokens/step and
+effective tok/s per arm plus their ratio, asserting the two streams
+are token-for-token BIT-EQUAL first (a spec win that changes outputs
+is a bug, not a speedup). Capture convention: redirect the JSON line
+to SPEC_rNN.json.
 """
 from __future__ import annotations
 
@@ -42,11 +51,28 @@ def main() -> None:
     parser.add_argument("--runs", type=int, default=3,
                         help="timed runs; value = median (bench.py "
                              "round-5 JSON convention)")
+    parser.add_argument("--spec-ab", action="store_true",
+                        help="speculative-decoding A/B: classic "
+                             "(APHRODITE_SPEC=0) vs n-gram spec (=1) "
+                             "on a prefix-heavy bs=1 greedy stream; "
+                             "reports accepted tokens/step + tok/s "
+                             "per arm and asserts bit-parity")
     args = parser.parse_args()
     if args.model == "synthetic-7b":
         from serving import synthetic_7b_dir
         args.model = synthetic_7b_dir()
         args.load_format = "dummy"
+    elif args.model == "synthetic-tiny":
+        from serving import synthetic_tiny_dir
+        args.model = synthetic_tiny_dir()
+        args.load_format = "dummy"
+        args.dtype = "float32"
+    if args.spec_ab:
+        # One token per classic dispatch makes tokens/step literal:
+        # classic pins ~1.0 and the spec arm's accepted-run widening
+        # is the whole signal. multi_step>1 would fold its own
+        # amortization into both arms and blur the ratio.
+        args.multi_step = 1
 
     from aphrodite_tpu.common.sampling_params import SamplingParams
     from aphrodite_tpu.common.sequence import Sequence, SequenceGroup
@@ -63,7 +89,16 @@ def main() -> None:
         block_size=args.block_size))
     vocab = engine.model_config.get_vocab_size()
     rng = np.random.RandomState(0)
-    prompt = rng.randint(5, vocab - 5, size=args.prompt_len).tolist()
+    if args.spec_ab:
+        # Prefix-heavy stream: a short pattern tiled across the prompt
+        # (the multi-turn shared-prefix shape `serving.py --mix
+        # prefix-heavy` serves) so the n-gram drafter has history to
+        # match — the traffic the A/B criterion is defined on.
+        pat = rng.randint(5, vocab - 5, size=8).tolist()
+        prompt = (pat * (args.prompt_len // len(pat) + 1))
+        prompt = prompt[:args.prompt_len]
+    else:
+        prompt = rng.randint(5, vocab - 5, size=args.prompt_len).tolist()
 
     def run(out_len):
         sp = SamplingParams(temperature=0.0, max_tokens=out_len,
@@ -76,15 +111,117 @@ def main() -> None:
         t0 = time.perf_counter()
         ttft = None
         n = 0
+        steps = 0
+        ids: list = []
         while engine.has_unfinished_requests():
             outs = engine.step()
+            steps += 1
             if ttft is None and outs and outs[0].outputs and \
                     outs[0].outputs[0].token_ids:
                 ttft = time.perf_counter() - t0
             for o in outs:
                 if o.finished:
-                    n = len(o.outputs[0].token_ids)
-        return time.perf_counter() - t0, ttft, n
+                    ids = list(o.outputs[0].token_ids)
+                    n = len(ids)
+        return time.perf_counter() - t0, ttft, n, steps, ids
+
+    if args.spec_ab:
+        import jax
+
+        from aphrodite_tpu.common import flags
+
+        def arm(spec_on: bool) -> dict:
+            """One A/B arm: warmup + --runs timed runs of the same
+            stream with APHRODITE_SPEC pinned (env writes are the
+            sanctioned way for a harness to set per-call-read flags).
+            """
+            os.environ["APHRODITE_SPEC"] = "1" if spec_on else "0"
+            counters = {"drafted": 0, "accepted": 0}
+            orig_observe = engine.drafter.observe
+
+            def spy(seq_id, proposed, accepted):
+                counters["drafted"] += proposed
+                counters["accepted"] += accepted
+                return orig_observe(seq_id, proposed, accepted)
+
+            engine.drafter.observe = spy
+            try:
+                for _ in range(args.warmup):
+                    run(args.output_len)
+                counters["drafted"] = counters["accepted"] = 0
+                out = {"tok_per_step": [], "tok_s": [], "ids": None}
+                for r in range(max(1, args.runs)):
+                    gc.collect()
+                    gc.disable()
+                    try:
+                        wall, ttft, n, steps, ids = run(args.output_len)
+                    finally:
+                        gc.enable()
+                    # Step 1 is the prefill (emits token 1); the
+                    # remaining steps-1 dispatches emit n-1 tokens, so
+                    # tokens/step is the per-dispatch decode yield —
+                    # classic pins ~1.0 at multi_step=1, the spec arm
+                    # rises with every accepted draft run.
+                    tpstep = (n - 1) / max(1, steps - 1)
+                    tps = (n - 1) / (wall - ttft) if n > 1 else 0.0
+                    out["tok_per_step"].append(round(tpstep, 3))
+                    out["tok_s"].append(round(tps, 1))
+                    if out["ids"] is None:
+                        out["ids"] = ids
+                    print(f"[spec-ab] {'spec' if spec_on else 'classic'}"
+                          f" run {r + 1}/{args.runs}: "
+                          f"{tpstep:.3f} tok/step, {tps:.1f} tok/s "
+                          f"({steps} steps, {n} tokens)",
+                          file=sys.stderr, flush=True)
+                out.update(counters)
+                return out
+            finally:
+                engine.drafter.observe = orig_observe
+
+        classic = arm(False)
+        spec = arm(True)
+        # The distribution pin before any perf claim: greedy spec
+        # output must be bit-equal to classic on the identical stream.
+        if classic["ids"] != spec["ids"]:
+            raise AssertionError(
+                "spec A/B parity broke: classic and spec token "
+                f"streams differ (classic[:8]={classic['ids'][:8]}, "
+                f"spec[:8]={spec['ids'][:8]})")
+        ratios = [round(s / c, 3) for s, c in
+                  zip(spec["tok_per_step"], classic["tok_per_step"])]
+        value = statistics.median(ratios)
+        print(json.dumps({
+            "metric": "spec_accepted_tok_per_step_x",
+            "value": round(value, 3),
+            "unit": "x vs classic",
+            "samples": ratios,
+            "n_runs": len(ratios),
+            "detail": {
+                "backend": jax.default_backend(),
+                "workload": "prefix-heavy bs=1 greedy (pattern prompt)",
+                "prompt_len": args.prompt_len,
+                "output_len": args.output_len,
+                "spec_k": flags.get_int("APHRODITE_SPEC_K"),
+                "greedy_bit_equal": True,
+                "classic": {
+                    "tok_per_step": statistics.median(
+                        classic["tok_per_step"]),
+                    "tok_per_step_samples": classic["tok_per_step"],
+                    "tok_s": statistics.median(classic["tok_s"]),
+                    "tok_s_samples": classic["tok_s"],
+                },
+                "spec": {
+                    "tok_per_step": statistics.median(
+                        spec["tok_per_step"]),
+                    "tok_per_step_samples": spec["tok_per_step"],
+                    "tok_s": statistics.median(spec["tok_s"]),
+                    "tok_s_samples": spec["tok_s"],
+                    "drafted_total": spec["drafted"],
+                    "accepted_total": spec["accepted"],
+                },
+            },
+        }))
+        return
 
     for _ in range(args.warmup):
         # Warmup must cover the FULL decode range: every
@@ -102,7 +239,7 @@ def main() -> None:
         gc.collect()
         gc.disable()
         try:
-            wall, ttft, n = run(args.output_len)
+            wall, ttft, n, _, _ = run(args.output_len)
         finally:
             gc.enable()
         tps = (n - 1) / (wall - ttft) if n > 1 else 0.0

@@ -186,7 +186,7 @@ def main() -> None:
     ap.add_argument("--iters", type=int, default=100)
     ap.add_argument("--only", type=str, default="",
                     help="comma list: qmm,a8,ab,dense,attn,kv,head,"
-                         "prefill,pglue,layer,burst,pstep,glue,"
+                         "prefill,pglue,layer,burst,spec,pstep,glue,"
                          "roofline")
     ap.add_argument("--no-roofline-gate", action="store_true",
                     help="skip the pre-run aphrocheck ROOF/FOLD gate")
@@ -938,6 +938,133 @@ def main() -> None:
             row(f"BURST {nm} b={B}", s * 1e3, 1, "")
         del state
 
+    # --- speculative verify A/B: the widened k+1-row verify dispatch
+    # vs the classic 1-row decode (same model, same ragged work-list
+    # grid; the verify arm rides spec_verify=True, i.e. the slot-wise
+    # KV scatter instead of the fused in-kernel write, exactly as
+    # ModelRunner.execute_spec_verify dispatches it). The headline is
+    # the BREAK-EVEN acceptance: cost_verify/cost_classic - 1 drafted
+    # tokens must land per step before speculation pays on-device
+    # (host-side draft + rejection are noise next to a dispatch). ---
+    if want("spec"):
+        from types import SimpleNamespace as _NSP
+        from aphrodite_tpu.common import flags
+        from aphrodite_tpu.modeling.models.llama import LlamaForCausalLM
+        from aphrodite_tpu.modeling.layers.quantization.gptq import (
+            GPTQConfig)
+        from aphrodite_tpu.modeling.hf_loader import (
+            initialize_dummy_params)
+        from aphrodite_tpu.modeling.input_metadata import InputMetadata
+        from aphrodite_tpu.ops.pallas.paged_attention import (
+            build_decode_work_list, choose_pages_per_chunk)
+
+        SPEC_K = flags.get_int("APHRODITE_SPEC_K")
+        cfg_s = _NSP(
+            architectures=["LlamaForCausalLM"], vocab_size=VOCAB,
+            hidden_size=HIDDEN, intermediate_size=INTER,
+            num_hidden_layers=LAYERS, num_attention_heads=HEADS,
+            num_key_value_heads=KV_HEADS, rms_norm_eps=1e-5,
+            rope_theta=10000.0, max_position_embeddings=4096,
+            tie_word_embeddings=False, hidden_act="silu")
+        smodel = LlamaForCausalLM(
+            cfg_s, dtype=jnp.bfloat16,
+            linear_method=GPTQConfig(4, GROUP).get_linear_method())
+        sprm = initialize_dummy_params(smodel, seed=0)
+        # Pages must cover position ctx-1+k (the scheduler's spec
+        # reservation contract); table width rides the 8-page bucket.
+        pps_data = -(-(ctx + SPEC_K) // PAGE)
+        width = -(-pps_data // 8) * 8
+        npg_s = B * pps_data + 1
+        skv = [
+            (jnp.zeros((npg_s, PAGE, KV_HEADS * HEAD_DIM),
+                       jnp.bfloat16),
+             jnp.zeros((npg_s, PAGE, KV_HEADS * HEAD_DIM),
+                       jnp.bfloat16))
+            for _ in range(LAYERS)
+        ]
+
+        def verify_geom(rows_per_seq):
+            """(ids, pos, metadata) for B sequences x rows_per_seq
+            consecutive verify rows, built as _prepare_spec_verify
+            does: row j carries position ctx-1+j, attends with
+            ctx_lens = pos+1, and all rows of a sequence share its
+            pages."""
+            j = np.tile(np.arange(rows_per_seq), B)
+            sidx = np.repeat(np.arange(B), rows_per_seq)
+            nrows = B * rows_per_seq
+            pos = (ctx - 1 + j).astype(np.int32)
+            page = sidx * pps_data + pos // PAGE
+            slots = (page * PAGE + pos % PAGE).astype(np.int32)
+            ctxl = (pos + 1).astype(np.int32)
+            tbl = np.zeros((nrows, width), np.int32)
+            tbl[:, :pps_data] = (sidx[:, None] * pps_data +
+                                 np.arange(pps_data)[None, :])
+            counts = (-(-ctxl // PAGE)).tolist()
+            ppc = choose_pages_per_chunk(width, PAGE, nrows)
+            work = build_decode_work_list(counts, ppc)
+            meta = InputMetadata(
+                slot_mapping=jnp.asarray(slots),
+                block_tables=jnp.asarray(tbl),
+                context_lens=jnp.asarray(ctxl),
+                is_prompt=False,
+                decode_work=tuple(jnp.asarray(w) for w in work),
+                decode_ppc=ppc,
+                spec_verify=rows_per_seq > 1)
+            return (jnp.ones((nrows, 1), jnp.int32),
+                    jnp.asarray(pos[:, None]), meta)
+
+        spec_ms = {}
+        for nm, rps in (("classic 1-row", 1),
+                        (f"verify k={SPEC_K}", SPEC_K + 1)):
+            sids, spos, smeta = verify_geom(rps)
+
+            def sstep(c, i, spos=spos, smeta=smeta):
+                ids, kv, prm = c
+                hidden, kv = smodel(prm, ids, spos, kv, smeta)
+                flat = hidden.reshape(-1, hidden.shape[-1])
+                logits = smodel.compute_logits(prm, flat)
+                ids = jnp.maximum(
+                    ids, (logits[:, :1] * 0).astype(jnp.int32))
+                return (ids, kv, prm)
+
+            # Three chained device_bench calls -> three independent
+            # slope samples (bench.py round-5 discipline) on the same
+            # donated KV pool.
+            state = (sids, skv, sprm)
+            samples = []
+            for _ in range(3):
+                s, rtt, state = device_bench(sstep, state, slow=True,
+                                             donate=True)
+                rtts.append(rtt)
+                samples.append(round(s * 1e3, 3))
+            _, skv, sprm = state
+            spec_ms[rps] = samples
+            med = sorted(samples)[1]
+            row(f"SPEC {nm} b={B}", med, 1,
+                f"{B * rps} rows" + (", spec_verify" if rps > 1
+                                     else ""))
+        del skv, state
+        classic_s, verify_s = (sorted(spec_ms[1])[1],
+                               sorted(spec_ms[SPEC_K + 1])[1])
+        break_even = verify_s / classic_s - 1.0
+        print(f"\n=== spec verify A/B b={B} ctx={ctx}: classic "
+              f"{classic_s:.3f} ms/step, verify(k={SPEC_K}) "
+              f"{verify_s:.3f} ms/step -> break-even "
+              f"{break_even:.2f} accepted tok/step ===")
+        print(json.dumps({
+            "metric": "spec_verify_cost_x",
+            "value": round(verify_s / classic_s, 3),
+            "unit": "x classic dispatch",
+            "samples": [round(v / c, 3) for v, c in
+                        zip(spec_ms[SPEC_K + 1], spec_ms[1])],
+            "n_runs": 3,
+            "detail": {"batch": B, "ctx": ctx, "spec_k": SPEC_K,
+                       "classic_ms_samples": spec_ms[1],
+                       "verify_ms_samples": spec_ms[SPEC_K + 1],
+                       "break_even_accepted_tok_per_step":
+                       round(break_even, 2)},
+        }))
+
     # --- the REAL whole prompt step (one scheduling round) ---
     if want("pstep"):
         from types import SimpleNamespace as _NS2
@@ -1096,8 +1223,8 @@ def main() -> None:
     # FULL-layer cross-check (which already contains the components)
     # are reference rows, not addends.
     excluded = ("bf16 dense", "kv_write prefill-window", "FULL decoder",
-                "PREFILL", "BURST", "PROMPT", "W4A8", "ATTN A/B",
-                "QMM A/B")
+                "PREFILL", "BURST", "PROMPT", "SPEC", "W4A8",
+                "ATTN A/B", "QMM A/B")
     for name, ms_call, n, ms_step, note in rows:
         print(f"{name:54s} {ms_call * 1e3:9.1f} {n:4d} {ms_step:8.3f}  "
               f"{note}")

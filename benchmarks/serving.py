@@ -77,6 +77,85 @@ async def poisson_arrivals(n: int, rate: float, rng: np.random.RandomState):
         await asyncio.sleep(rng.exponential(1.0 / rate))
 
 
+def build_mix(args, vocab: int, rng: np.random.RandomState):
+    """Per-request workload shapes for the --mix presets.
+
+    Returns (prompts, out_lens, mix_detail):
+
+    - uniform:      every request is prompt_len/output_len (the
+      historical 128/64 shape — zero per-arrival variance, so rate
+      sweeps isolate scheduler behavior from workload noise).
+    - sharegpt:     ragged conversational shape — lognormal prompt AND
+      output lengths with the configured lengths as medians (p90/p50
+      ~2x, the long-tail shape ShareGPT-trace benchmarks sample),
+      independent token streams.
+    - prefix-heavy: multi-turn sessions — every --session-turns'th
+      request shares a ~3/4-prompt_len session prefix with a ragged
+      fresh suffix. Repeated history is what the prefix cache pins and
+      the n-gram drafter mines, so this is the traffic the spec-decode
+      A/B criterion is defined on.
+
+    Lengths are clamped so prompt+output+16 fits --max-model-len; the
+    summary stats ride in the JSON so a capture is self-describing.
+    """
+    n = args.num_requests
+    p_nom, o_nom = args.prompt_len, args.output_len
+    cap = max(32, args.max_model_len - 16)
+    mix = getattr(args, "mix", "uniform") or "uniform"
+    extra = {}
+    if mix == "uniform":
+        prompts = [rng.randint(5, vocab - 5, size=p_nom).tolist()
+                   for _ in range(n)]
+        out_lens = [o_nom] * n
+    elif mix == "sharegpt":
+        # Lognormal with the nominal length as median, written as
+        # median * exp(N(0, sigma)) (equivalent, and it avoids np.log
+        # — the race pass's name-resolved call graph would alias a
+        # `log` call here onto StatLogger.log and cross-pollute its
+        # execution domains).
+        plens = np.clip(np.round(
+            p_nom * np.exp(rng.normal(0.0, 0.6, size=n))),
+            4, cap - 8).astype(int)
+        out_lens = np.clip(np.round(
+            o_nom * np.exp(rng.normal(0.0, 0.6, size=n))), 1,
+            cap - plens).astype(int).tolist()
+        prompts = [rng.randint(5, vocab - 5, size=int(pl)).tolist()
+                   for pl in plens]
+    elif mix == "prefix-heavy":
+        turns = max(1, int(getattr(args, "session_turns", 4) or 4))
+        n_sessions = max(1, n // turns)
+        prefix_len = min(max(8, (3 * p_nom) // 4), cap - o_nom - 8)
+        prefixes = {
+            s: rng.randint(5, vocab - 5, size=prefix_len).tolist()
+            for s in range(n_sessions)
+        }
+        prompts = []
+        for i in range(n):
+            sfx_cap = max(2, min(p_nom - prefix_len,
+                                 cap - o_nom - prefix_len))
+            sfx = int(rng.randint(1, sfx_cap))
+            prompts.append(prefixes[i % n_sessions] +
+                           rng.randint(5, vocab - 5, size=sfx).tolist())
+        out_lens = [o_nom] * n
+        extra = {"sessions": n_sessions, "turns": turns,
+                 "prefix_len": prefix_len}
+    else:
+        raise ValueError(f"unknown --mix preset: {mix!r}")
+    plens_a = np.asarray([len(p) for p in prompts])
+    olens_a = np.asarray(out_lens)
+    mix_detail = {
+        "preset": mix,
+        "prompt_len_p50": int(np.percentile(plens_a, 50)),
+        "prompt_len_p90": int(np.percentile(plens_a, 90)),
+        "prompt_len_max": int(plens_a.max()),
+        "output_len_p50": int(np.percentile(olens_a, 50)),
+        "output_len_p90": int(np.percentile(olens_a, 90)),
+        "output_len_max": int(olens_a.max()),
+        **extra,
+    }
+    return prompts, out_lens, mix_detail
+
+
 async def run(args) -> dict:
     from aphrodite_tpu.common import faultinject
     from aphrodite_tpu.common.sampling_params import SamplingParams
@@ -128,10 +207,7 @@ async def run(args) -> dict:
         multi_step=args.multi_step))
     vocab = engine.engine.model_config.get_vocab_size()
     rng = np.random.RandomState(0)
-    prompts = [
-        rng.randint(5, vocab - 5, size=args.prompt_len).tolist()
-        for _ in range(args.num_requests)
-    ]
+    prompts, out_lens, mix_detail = build_mix(args, vocab, rng)
     # Deterministic abort plan: request index -> abort delay fraction.
     abort_rng = np.random.RandomState(
         int(getattr(args, "chaos_seed", 0) or 0) + 99)
@@ -153,18 +229,19 @@ async def run(args) -> dict:
     disc_rng = np.random.RandomState(
         int(getattr(args, "chaos_seed", 0) or 0) + 17)
     disconnect_after = {
-        i: int(disc_rng.randint(1, max(2, args.output_len)))
+        i: int(disc_rng.randint(1, max(2, out_lens[i])))
         for i in range(args.num_requests)
         if overload and disc_rng.uniform() < disconnect_rate
     }
 
     ttfts, tpots, e2es = [], [], []
+    survived_out_tokens: list = []
     outcomes = {"survived": 0, "aborted": 0, "failed": 0,
                 "shed": 0, "expired": 0, "disconnected": 0}
     rejection_ms: list = []
 
     async def one(i: int, *, measured: bool = True) -> None:
-        sp = SamplingParams(temperature=0.0, max_tokens=args.output_len,
+        sp = SamplingParams(temperature=0.0, max_tokens=out_lens[i],
                             ignore_eos=True,
                             ttft_slo_s=deadline_of.get(i))
         rid = f"req-{i}" if measured else f"warm-req-{i}"
@@ -227,10 +304,11 @@ async def run(args) -> dict:
             final.outputs else 0
         if not measured:
             return
-        if n_out < args.output_len:
+        if n_out < out_lens[i]:
             outcomes["aborted"] += 1
             return                  # partial: excluded from latency
         outcomes["survived"] += 1
+        survived_out_tokens.append(n_out)
         ttfts.append((first or t1) - t0)
         if n_out > 1:
             tpots.append((t1 - (first or t1)) / (n_out - 1))
@@ -308,6 +386,7 @@ async def run(args) -> dict:
         ttfts.clear()
         tpots.clear()
         e2es.clear()
+        survived_out_tokens.clear()
         rejection_ms.clear()
         for key in outcomes:
             outcomes[key] = 0
@@ -350,8 +429,12 @@ async def run(args) -> dict:
         # virtual-mesh capture is never mistaken for hardware.
         "mesh": list(mesh_shape) if mesh_shape else None,
         "backend": _jax.default_backend(),
+        "mix": mix_detail,
+        # Ragged mixes finish different token counts per request, so
+        # throughput sums what actually completed (uniform reduces to
+        # the old survived * output_len).
         "throughput_out_tok_s": round(
-            outcomes["survived"] * args.output_len / wall, 1),
+            sum(survived_out_tokens) / wall, 1),
         "ttft_p50": round(pct(ttfts, 50), 4),
         "ttft_p90": round(pct(ttfts, 90), 4),
         "ttft_p99": round(pct(ttfts, 99), 4),
@@ -374,7 +457,7 @@ async def run(args) -> dict:
             # Goodput: output tokens of fully-served admitted
             # requests over the measured wall time.
             "goodput_out_tok_s": round(
-                outcomes["survived"] * args.output_len / wall, 1),
+                sum(survived_out_tokens) / wall, 1),
             "rejection_ms_p50": round(pct(rejection_ms, 50), 2),
             "rejection_ms_max": round(max(rejection_ms), 2)
             if rejection_ms else 0.0,
@@ -970,6 +1053,16 @@ def main() -> None:
     parser.add_argument("--num-requests", type=int, default=128)
     parser.add_argument("--prompt-len", type=int, default=128)
     parser.add_argument("--output-len", type=int, default=64)
+    parser.add_argument("--mix", default="uniform",
+                        choices=("uniform", "sharegpt", "prefix-heavy"),
+                        help="request-shape preset: 'uniform' (every "
+                             "request prompt-len/output-len), "
+                             "'sharegpt' (ragged lognormal prompt+"
+                             "output lengths around the configured "
+                             "medians), 'prefix-heavy' (multi-turn "
+                             "sessions sharing a ~3/4-prompt prefix — "
+                             "the spec-decode A/B traffic); shape "
+                             "stats recorded in the JSON detail")
     parser.add_argument("--warmup", type=int, default=1,
                         help="run the workload once first to absorb "
                              "shape-bucket compiles (0 to disable)")

@@ -1,0 +1,22 @@
+"""Seeded violations proving the drafter hot-module scope: one
+SYNC001 and one SYNC002 in functions whose names do NOT match the
+`execute_`/`dispatch_`/`finalize_` hot prefixes, plus one FLAG001 raw
+env read. Copied to `aphrodite_tpu/processing/drafter.py` inside a
+throwaway tree, the SYNC pass must fire through `HOT_MODULES` (every
+drafter function is step-path); at any other package path the same
+functions stay quiet. The FLAG finding fires at BOTH paths — the
+drafter sits inside the module-wide FLAG scope like the rest of the
+package."""
+import os
+
+import numpy as np
+
+
+def propose_like(scores, rows):
+    best = scores.argmax().item()            # SYNC001 at drafter path
+    pulled = [np.asarray(r) for r in rows]   # SYNC002 at drafter path
+    return best, pulled
+
+
+def backoff_threshold() -> str:
+    return os.environ.get("APHRODITE_FIXTURE_SPEC", "0.3")  # FLAG001

@@ -480,6 +480,47 @@ def test_fleet_scope_extension_fires(tmp_path):
             [f.render() for f in pass_fn(ctx2)]
 
 
+def test_drafter_hot_module_scope_fires(tmp_path):
+    """The SYNC family covers EVERY function of
+    `aphrodite_tpu/processing/drafter.py` (the drafter runs host-side
+    between engine rounds — each of its functions is step-path): the
+    seeded fixture copied to the drafter path fires SYNC001+SYNC002
+    through the HOT_MODULES scope even though no function matches the
+    hot-name prefixes, while the same file at another package path
+    stays SYNC-quiet. The FLAG family fires at both paths — module
+    placement never exempted the drafter from the package-wide
+    scopes."""
+    import shutil
+    src = os.path.join(REPO_ROOT, _fixture("fixture_drafter_scope.py"))
+    drafter_rel = "aphrodite_tpu/processing/drafter.py"
+    other_rel = "aphrodite_tpu/processing/seeded.py"
+    for rel in (drafter_rel, other_rel):
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(src, str(dst))
+    ctx, parse_findings = build_context(str(tmp_path), [drafter_rel])
+    assert not parse_findings
+    assert sorted(f.rule for f in sync_pass.run(ctx)) == \
+        ["SYNC001", "SYNC002"]
+    assert [f.rule for f in flag_pass.run(ctx)] == ["FLAG001"]
+    ctx2, parse_findings2 = build_context(str(tmp_path), [other_rel])
+    assert not parse_findings2
+    assert not sync_pass.run(ctx2), \
+        [f.render() for f in sync_pass.run(ctx2)]
+    assert [f.rule for f in flag_pass.run(ctx2)] == ["FLAG001"]
+
+
+def test_drafter_real_module_clean_under_hot_scope():
+    """The real drafter satisfies the SYNC/RECOMP/FLAG passes that
+    now gate it in full (pinned here so a scope regression cannot
+    silently exempt it)."""
+    rels = ["aphrodite_tpu/processing/drafter.py"]
+    for pass_fn in (sync_pass.run, recomp_pass.run, flag_pass.run):
+        findings = [f for f in _pass_findings(pass_fn, rels)
+                    if f.path.endswith("drafter.py")]
+        assert not findings, [f.render() for f in findings]
+
+
 def test_fleet_real_tree_is_clean_under_new_scope():
     """The router/replica/launcher modules themselves satisfy the
     passes that now gate them (the gate proves this too, but this

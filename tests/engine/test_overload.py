@@ -441,6 +441,66 @@ def test_low_watermark_admission_never_forces_preemption(monkeypatch):
     assert sched.waiting[0].request_id == "r2"
 
 
+def test_spec_reservation_respects_admission_reserve(monkeypatch):
+    """A speculative k-token reservation is best-effort: with the
+    admission low watermark set it must NOT eat the reserved pages
+    that keep can_append_slot from evicting running groups — it
+    grants 0 instead, and nothing is preempted."""
+    from aphrodite_tpu.common.sequence import SequenceStatus
+    sched = _make_scheduler(num_gpu_blocks=4)
+    g = _make_group("r1")                 # 7 tokens -> 2 of 4 blocks
+    sched.add_seq_group(g)
+    _, out = sched.schedule()
+    assert [c.group.request_id for c in out.prompt_chunks] == ["r1"]
+    free_before = sched.block_manager.get_num_free_gpu_blocks()
+
+    # Without the watermark the 2 free pages are fair game.
+    granted = sched.reserve_decode_burst([], 8, groups=[g])
+    assert granted > 0
+    assert sched.block_manager.get_num_free_gpu_blocks() < free_before
+    assert sched._reclaim_reservations([g]) > 0     # reset for part 2
+    assert sched.block_manager.get_num_free_gpu_blocks() == free_before
+
+    # With it, the reserve (0.5 * 4 + 1 running) exceeds the free
+    # pool: the reservation shrinks to nothing rather than dip in.
+    monkeypatch.setenv("APHRODITE_PAGE_LOW_WATERMARK", "0.5")
+    assert sched.reserve_decode_burst([], 8, groups=[g]) == 0
+    assert sched.block_manager.get_num_free_gpu_blocks() == free_before
+    assert g.get_seqs()[0].status == SequenceStatus.RUNNING
+
+
+def test_stale_reservations_reclaimed_before_preemption(monkeypatch):
+    """Pages reserved for speculative look-ahead are trimmed back
+    before the scheduler resorts to evicting a running group: a round
+    under page pressure reclaims the reserved tail and decodes
+    everyone, with ZERO preemptions."""
+    from aphrodite_tpu.common.sequence import SequenceStatus
+    monkeypatch.setenv("APHRODITE_PREEMPT_BUDGET", "1")
+    sched = _make_scheduler(num_gpu_blocks=8)
+    ga, gb = _make_group("ra"), _make_group("rb")
+    sched.add_seq_group(ga)
+    sched.add_seq_group(gb)
+    _, out = sched.schedule()
+    assert len(out.prompt_chunks) == 2    # 2 x 2 blocks, 4 free
+
+    # A speculative reservation for ra eats the entire free pool.
+    granted = sched.reserve_decode_burst([], 16, groups=[ga])
+    assert granted > 0
+    assert sched.block_manager.get_num_free_gpu_blocks() == 0
+
+    # rb crosses a page boundary: without reclaim this round would
+    # have to preempt (pool empty); with it the stale reservation is
+    # trimmed and both rows decode.
+    _fill_to_boundary(ga, 2)
+    _fill_to_boundary(gb, 2)
+    _, out2 = sched.schedule()
+    assert ga.get_seqs()[0].status == SequenceStatus.RUNNING
+    assert gb.get_seqs()[0].status == SequenceStatus.RUNNING
+    assert ga in out2.decode_groups and gb in out2.decode_groups
+    assert not sched.waiting, "a running group was evicted despite " \
+        "reclaimable reserved pages"
+
+
 # ------------------------------------------------------------------
 # HTTP 429 semantics
 # ------------------------------------------------------------------

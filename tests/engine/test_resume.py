@@ -170,6 +170,72 @@ def test_continuation_rejects_multi_sequence(engine):
                            emitted_token_ids=[1, 2])
 
 
+def test_spec_stream_resumes_bit_equal(engine, monkeypatch):
+    """A seeded speculative stream killed mid-generation resumes
+    bit-equal to the unkilled control: the verify rows salt by output
+    position, and the emitted prefix re-enters as outputs, so the
+    splice is invisible — for every split point."""
+    monkeypatch.setenv("APHRODITE_SPEC", "1")
+    pattern = [11, 23, 37, 41] * 5
+    sp = SamplingParams(temperature=1.0, seed=616, max_tokens=12,
+                        ignore_eos=True)
+    engine.add_request("spec-full", None, sp,
+                       prompt_token_ids=list(pattern))
+    full = _drain(engine)["spec-full"]
+    ids = list(full.outputs[0].token_ids)
+    assert len(ids) == 12
+
+    for k in (1, 5, 11):
+        engine.add_request(f"spec-cont-{k}", None, sp,
+                           prompt_token_ids=list(pattern),
+                           emitted_token_ids=ids[:k])
+        out = _drain(engine)[f"spec-cont-{k}"]
+        assert list(out.outputs[0].token_ids) == ids, f"split {k}"
+        assert out.outputs[0].text == full.outputs[0].text
+        assert out.resumed_tokens == k
+
+
+def test_spec_resume_redrafts_from_joint_history(engine, monkeypatch):
+    """The resumed continuation drafts against the JOINT prompt+output
+    history: killed inside the greedy cycle, the resumed stream's
+    drafter immediately sees the periodic tail and lands accepted
+    multi-token rounds again."""
+    monkeypatch.setenv("APHRODITE_SPEC", "1")
+    pattern = [11, 23, 37, 41] * 5
+    sp = SamplingParams(temperature=0.0, max_tokens=60, ignore_eos=True)
+    engine.add_request("redraft-full", None, sp,
+                       prompt_token_ids=list(pattern))
+    full = _drain(engine)["redraft-full"]
+    ids = list(full.outputs[0].token_ids)
+
+    histories, accepted = [], []
+    orig_propose = engine.drafter.propose
+    orig_observe = engine.drafter.observe
+
+    def spy_propose(seq_id, token_ids, k):
+        histories.append(list(token_ids))
+        return orig_propose(seq_id, token_ids, k)
+
+    def spy_observe(seq_id, proposed, acc):
+        accepted.append(acc)
+        return orig_observe(seq_id, proposed, acc)
+
+    monkeypatch.setattr(engine.drafter, "propose", spy_propose)
+    monkeypatch.setattr(engine.drafter, "observe", spy_observe)
+    kill = 40                       # well inside the period-9 cycle
+    engine.add_request("redraft-cont", None, sp,
+                       prompt_token_ids=list(pattern),
+                       emitted_token_ids=ids[:kill])
+    out = _drain(engine)["redraft-cont"]
+    assert list(out.outputs[0].token_ids) == ids
+    # Every draft was proposed from the joint history — the replayed
+    # prefix is part of what the drafter matched against.
+    assert histories
+    assert all(h[:len(pattern) + kill] ==
+               pattern + ids[:kill] for h in histories)
+    assert sum(accepted) > 0, "resumed stream never re-drafted a hit"
+
+
 def test_continuation_detok_resumes_mid_word(engine):
     """resumed_text equals the incremental-detok text of the emitted
     prefix (what the original stream delivered), even when the split

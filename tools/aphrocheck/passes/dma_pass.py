@@ -192,6 +192,9 @@ class _Kernel:
         return []
 
     def sem_moduli(self) -> Dict[str, List[Tuple[str, tuple, ast.AST]]]:
+        cached = getattr(self, "_sem_moduli", None)
+        if cached is not None:
+            return cached
         out: Dict[str, List[Tuple[str, tuple, ast.AST]]] = {}
         for ctor in self.ctors:
             base = _constructor_base(ctor)
@@ -204,6 +207,7 @@ class _Kernel:
                                     self.module.branch_path(ctor))
             if mods:
                 out.setdefault(base, []).extend(mods)
+        self._sem_moduli = out
         return out
 
 
@@ -279,10 +283,11 @@ def _check_moduli(module: Module, kernel: _Kernel,
 
 
 def _check_sem_lengths(module: Module, findings: List[Finding],
-                       call_graph=None) -> None:
-    kernels = {k.fn.name if hasattr(k.fn, 'name') else '': k
-               for k in (_Kernel(module, fn)
-                         for fn in _top_level_kernel_fns(module))}
+                       call_graph=None, kernels=None) -> None:
+    if kernels is None:
+        kernels = {k.fn.name if hasattr(k.fn, 'name') else '': k
+                   for k in (_Kernel(module, fn)
+                             for fn in _top_level_kernel_fns(module))}
     for site in find_sites(module):
         sem_dims: List[int] = []
         for variant in site.variants:
@@ -326,15 +331,19 @@ def _check_sem_lengths(module: Module, findings: List[Finding],
 def run(ctx) -> List[Finding]:
     findings: List[Finding] = []
     for module in ctx.modules:
+        kernels = {}
         for fn in _top_level_kernel_fns(module):
             kernel = _Kernel(module, fn)
+            if hasattr(fn, "name"):
+                kernels[fn.name] = kernel
             _check_start_wait(module, kernel, findings)
             _check_moduli(module, kernel, findings)
         # text prefilter: DMA semaphores only exist at pallas_call
         # sites
         if "pallas_call" in module.text:
             _check_sem_lengths(module, findings,
-                               getattr(ctx, "call_graph", None))
+                               getattr(ctx, "call_graph", None),
+                               kernels=kernels)
     return findings
 
 

@@ -1,7 +1,9 @@
 """SYNC pass: host-sync and retrace hazards in the serving hot path.
 
 Hot path = functions named `execute_*` / `dispatch_*` / `finalize_*`
-(the model-runner/executor step surface). The engine's throughput
+(the model-runner/executor step surface), plus EVERY function of the
+modules in `HOT_MODULES` (the n-gram drafter runs host-side between
+engine rounds, so all of it is step-path). The engine's throughput
 contract is ONE host sync per round; these rules catch the patterns
 that silently add more:
 
@@ -34,14 +36,21 @@ from tools.aphrocheck.core import (Finding, Module, dotted_name,
 
 HOT_NAME = re.compile(r"^(execute_|dispatch_|finalize_)")
 
+#: Modules that are hot in their ENTIRETY, regardless of function
+#: name: the n-gram drafter runs on the host between every engine
+#: round, so each of its functions sits on the step path.
+HOT_MODULES = frozenset({"aphrodite_tpu/processing/drafter.py"})
+
 _SYNC_CALLS = {"np.asarray", "np.array", "numpy.asarray",
                "numpy.array"}
 
 
 def _hot_functions(module: Module) -> List[ast.FunctionDef]:
-    return [n for n in module.nodes
-            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
-            and HOT_NAME.match(n.name)]
+    fns = [n for n in module.nodes
+           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    if module.rel.replace("\\", "/") in HOT_MODULES:
+        return fns
+    return [n for n in fns if HOT_NAME.match(n.name)]
 
 
 def _in_loop(module: Module, node: ast.AST, stop: ast.AST) -> bool:
@@ -189,8 +198,10 @@ def run(ctx) -> List[Finding]:
 
 #: (rule, one-line contract, example) — rendered by `--rules-md`.
 RULES = (
-    ("SYNC001", "`.item()` in a hot-path (`execute_*`/`dispatch_*`/"
-     "`finalize_*`) function: a per-element host sync",
+    ("SYNC001", "`.item()` in a hot-path function (`execute_*`/"
+     "`dispatch_*`/`finalize_*`, or any function of "
+     "`processing/drafter.py` — the drafter runs every round): a "
+     "per-element host sync",
      "`logits.argmax().item()` in `execute_model`"),
     ("SYNC002", "`np.asarray`/`device_get` inside a loop in a "
      "hot-path function: one host sync per iteration",
