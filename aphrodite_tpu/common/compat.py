@@ -44,3 +44,18 @@ def get_context_mesh():
     if mesh is None or mesh.empty:
         return None
     return mesh
+
+
+def context_tp() -> int:
+    """Tensor-parallel degree of the context mesh, 1 when tracing
+    outside any mesh (single-chip jit) or on a mesh without a "tp"
+    axis. Pallas launch gates consult this (aphrocheck MESH003):
+    Pallas kernels are single-device programs, so any tp>1 trace must
+    take the GSPMD-partitionable jnp path instead."""
+    mesh = get_context_mesh()
+    if mesh is None:
+        return 1
+    try:
+        return int(mesh.shape.get("tp", 1))
+    except (AttributeError, TypeError):
+        return 1

@@ -109,6 +109,7 @@ class PagedAttention:
                     flat_k, flat_v, k_pages, v_pages,
                     metadata.slot_mapping,
                     kv_scale=metadata.kv_scale,
+                    tp=metadata.tp,
                     # Decode: one token per sequence, pages are
                     # sequence-exclusive -> the pipelined page writer
                     # is safe. Speculative verify rows share pages

@@ -114,7 +114,10 @@ class AWQLinearMethod(LinearMethod):
         qw = params["qweight"]
         in_features, n_packed = qw.shape
         lead = x.shape[:-1]
-        if jax.default_backend() == "tpu":
+        from aphrodite_tpu.common.compat import context_tp
+        # Pallas kernels are single-device programs: tp>1 traces take
+        # the GSPMD-partitionable dequant-then-dot path (MESH003).
+        if jax.default_backend() == "tpu" and context_tp() == 1:
             from aphrodite_tpu.common import flags
             from aphrodite_tpu.ops.pallas.quant_matmul import (
                 awq_matmul, awq_matmul_a8, awq_supported)

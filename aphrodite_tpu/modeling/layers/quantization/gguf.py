@@ -291,9 +291,12 @@ class GGUFLinearMethod(LinearMethod):
     def apply(self, params: Dict[str, jax.Array],
               x: jax.Array) -> jax.Array:
         lead = x.shape[:-1]
+        # Pallas kernels are single-device programs: tp>1 traces take
+        # the GSPMD-partitionable dequant-then-dot path (MESH003).
+        from aphrodite_tpu.common.compat import context_tp
         if "qs8" in params:
             K, N = params["qs8"].shape
-            if jax.default_backend() == "tpu":
+            if jax.default_backend() == "tpu" and context_tp() == 1:
                 from aphrodite_tpu.ops.pallas.quant_matmul import (
                     gguf_w8a8_matmul, gguf_w8a8_supported)
                 if gguf_w8a8_supported(K, N):
@@ -307,7 +310,7 @@ class GGUFLinearMethod(LinearMethod):
         elif "qweight" in params:
             K = params["qweight"].shape[0] * 8
             N = params["qweight"].shape[1]
-            if jax.default_backend() == "tpu":
+            if jax.default_backend() == "tpu" and context_tp() == 1:
                 from aphrodite_tpu.ops.pallas.quant_matmul import (
                     gguf_q4k_matmul, gguf_q4k_supported)
                 if gguf_q4k_supported(K, N):
@@ -320,7 +323,7 @@ class GGUFLinearMethod(LinearMethod):
                     return y
         elif "qs" in params and "d16" in params:
             K, N = params["qs"].shape
-            if jax.default_backend() == "tpu":
+            if jax.default_backend() == "tpu" and context_tp() == 1:
                 from aphrodite_tpu.ops.pallas.quant_matmul import (
                     gguf_i8g_matmul, gguf_i8g_supported)
                 if gguf_i8g_supported(K, N):
@@ -332,7 +335,7 @@ class GGUFLinearMethod(LinearMethod):
                     return y
         elif "qs" in params:
             K, N = params["qs"].shape
-            if jax.default_backend() == "tpu":
+            if jax.default_backend() == "tpu" and context_tp() == 1:
                 from aphrodite_tpu.ops.pallas.quant_matmul import (
                     gguf_q8_matmul, gguf_q8_supported)
                 if gguf_q8_supported(K, N):

@@ -170,8 +170,12 @@ class GPTQLinearMethod(LinearMethod):
         from aphrodite_tpu.common import flags
         if flags.get_bool("APHRODITE_DISABLE_PALLAS_QUANT"):
             return False
+        from aphrodite_tpu.common.compat import context_tp
         from aphrodite_tpu.ops.pallas.quant_matmul import gptq_supported
+        # Pallas kernels are single-device programs: tp>1 traces take
+        # the GSPMD-partitionable dequant-then-dot path (MESH003).
         return (jax.default_backend() == "tpu" and
+                context_tp() == 1 and
                 gptq_supported(in_features, out_features,
                                self.config.weight_bits,
                                self.config.group_size,

@@ -62,7 +62,10 @@ class Int8LinearMethod(LinearMethod):
               x: jax.Array) -> jax.Array:
         w = params["weight"]
         in_features, out_features = w.shape
-        if jax.default_backend() == "tpu":
+        from aphrodite_tpu.common.compat import context_tp
+        # Pallas kernels are single-device programs: tp>1 traces take
+        # the GSPMD-partitionable upcast-GEMM path (MESH003).
+        if jax.default_backend() == "tpu" and context_tp() == 1:
             from aphrodite_tpu.ops.pallas.quant_matmul import (
                 int8_matmul, int8_supported)
             if int8_supported(in_features, out_features):

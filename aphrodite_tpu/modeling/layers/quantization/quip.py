@@ -342,7 +342,11 @@ class QuipLinearMethod(LinearMethod):
                 squeezellm_matmul, squeezellm_supported)
             qw = params["qweight"]
             lut = params["lookup_table"] * ws
+            # Pallas kernels are single-device programs: tp>1 traces
+            # take the GSPMD-partitionable LUT-gather path (MESH003).
+            from aphrodite_tpu.common.compat import context_tp
             if jax.default_backend() == "tpu" and \
+                    context_tp() == 1 and \
                     squeezellm_supported(q_in, q_out):
                 # x stays f32 (the kernel dots in x's dtype): the int8
                 # path this replaces also fed f32 activations, and all
@@ -362,7 +366,10 @@ class QuipLinearMethod(LinearMethod):
             # Quarter-integer codes at rest (see create_weights).
             from aphrodite_tpu.ops.pallas.quant_matmul import (
                 int8_matmul, int8_supported)
+            # Same single-device constraint as the LUT path above.
+            from aphrodite_tpu.common.compat import context_tp
             if jax.default_backend() == "tpu" and \
+                    context_tp() == 1 and \
                     int8_supported(q_in, q_out):
                 out = int8_matmul(
                     xr, w, jnp.full((q_out,), 0.25, jnp.float32) * ws)

@@ -107,9 +107,13 @@ class SqueezeLLMLinearMethod(LinearMethod):
         from aphrodite_tpu.common import flags
         if flags.get_bool("APHRODITE_DISABLE_PALLAS_QUANT"):
             return False
+        from aphrodite_tpu.common.compat import context_tp
         from aphrodite_tpu.ops.pallas.quant_matmul import (
             squeezellm_supported)
+        # Pallas kernels are single-device programs: tp>1 traces take
+        # the GSPMD-partitionable gather path (MESH003).
         return (jax.default_backend() == "tpu" and
+                context_tp() == 1 and
                 squeezellm_supported(in_features, out_features))
 
     def load_weight(self, params, name: str,

@@ -58,6 +58,7 @@ def write_to_kv_cache(
     slot_mapping: jax.Array,  # [num_tokens] int32; pad with num_slots (OOB)
     kv_scale: float = 1.0,    # int8 quantization scale (trace-time const)
     distinct_pages: bool = False,  # decode batches: 1 token/page
+    tp: int = 1,              # mesh tp degree (trace-time const)
 ) -> Tuple[jax.Array, jax.Array]:
     """Scatter freshly computed K/V for each token into its cache slot.
 
@@ -74,8 +75,10 @@ def write_to_kv_cache(
     # HBM update. The XLA scatter below is semantically identical but XLA
     # wraps it in full-cache layout-conversion copies when the scattered
     # values arrive late in the program (the transformer chain), costing
-    # tens of ms/step on multi-GB caches.
-    if jax.default_backend() == "tpu":
+    # tens of ms/step on multi-GB caches. Single-device meshes only
+    # (MESH003): under tp-sharded pages the per-chip custom call would
+    # force GSPMD to replicate the cache around it.
+    if tp == 1 and jax.default_backend() == "tpu":
         from aphrodite_tpu.ops.pallas.kv_write import (
             can_use_pallas_writer, write_kv_pages)
         if can_use_pallas_writer(k_pages.dtype, page_size, hd):

@@ -1,0 +1,57 @@
+"""Clean-construct precision fixture for the MESH family: the real
+tree's idioms must produce ZERO findings.
+
+- the column/row `shard_along` seam idiom (feature-pin then the
+  row-parallel layer's declared `None` repin through its class
+  attribute) — the declared all-reduce seam, not an implicit one;
+- the `InputMetadata.tp`-gated launcher, the gate-variable form
+  (`pallas_write`), and the one-hop predicate form (`_use_pallas`);
+- classified commit sites with explicit shardings.
+"""
+import jax
+from jax.experimental import pallas as pl
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from aphrodite_tpu.modeling.layers.linear import shard_along
+
+
+def _write_kernel(src_ref, dst_ref):
+    dst_ref[...] = src_ref[...]
+
+
+def write_pages(src, dst):
+    return pl.pallas_call(
+        _write_kernel,
+        out_shape=jax.ShapeDtypeStruct(dst.shape, dst.dtype),
+    )(src)
+
+
+class FixtureAttention:
+
+    out_activation = None
+
+    def _use_pallas(self):
+        from aphrodite_tpu.common.compat import context_tp
+        return jax.default_backend() == "tpu" and context_tp() == 1
+
+    def __call__(self, params, x, pages, metadata):
+        up = shard_along(x @ params["up"], "tp")
+        down = shard_along(up @ params["down"], self.out_activation)
+        if metadata.tp == 1 and jax.default_backend() == "tpu":
+            pages = write_pages(down, pages)             # direct gate
+        pallas_write = metadata.tp == 1
+        if pallas_write:
+            pages = write_pages(down, pages)             # gate variable
+        if self._use_pallas():
+            pages = write_pages(down, pages)             # predicate gate
+        return down, pages
+
+
+class FixtureRunner:
+
+    def _prepare_decode(self, ids):
+        return jax.device_put(ids, self._input_sharding)
+
+    def execute_model(self, ids, mesh):
+        return jax.device_put(ids, NamedSharding(mesh, P(None)))

@@ -1,0 +1,23 @@
+"""Seeded MESH002 violation: a value pinned feature-sharded
+(`shard_along(x, "tp")`) then re-pinned replicated in the same
+function — an implicit all-reduce outside the declared row-parallel /
+embed seams — fires EXACTLY once.
+
+The second repin's source was never feature-pinned and the third pins
+through the class-attribute idiom (`self.out_activation`, how the
+linear layers declare their seams); both must stay quiet.
+"""
+from aphrodite_tpu.modeling.layers.linear import shard_along
+
+
+class FixtureCombine:
+
+    out_activation = None
+
+    def forward(self, params, x):
+        y = shard_along(x @ params["up"], "tp")
+        y = shard_along(y, None)                         # MESH002
+        z = x @ params["gate"]
+        z = shard_along(z, None)                         # quiet: never "tp"
+        w = shard_along(x @ params["down"], self.out_activation)  # quiet
+        return y, z, w

@@ -1,0 +1,18 @@
+"""Seeded MESH004 violation: an executor-scope commit site whose
+function classifies into NO placement domain (prefill/decode/
+maintenance/shared/shared_kv) — fires EXACTLY once.
+
+The commit carries an explicit sharding, so MESH001 stays quiet: the
+finding is purely that the disagg split cannot place what it cannot
+classify. The second function commits from a decode-named scope and
+stays quiet.
+"""
+
+
+class FixtureRunner:
+
+    def stage_batch(self, ids):
+        return self._dev(ids)                            # MESH004
+
+    def dispatch_burst(self, ids):
+        return self._dev(ids)                            # quiet: decode

@@ -1,0 +1,27 @@
+"""Seeded MESH003 violation: a `pallas_call` launcher dispatched from
+an execute path without an `InputMetadata.tp` / `context_tp()` gate or
+shard_map wrap — fires EXACTLY once.
+
+The launcher definition itself (its internal pallas_call) is the
+launch, not a dispatch decision, and stays quiet; a backend-only gate
+does not count as a tp gate.
+"""
+import jax
+from jax.experimental import pallas as pl
+
+
+def _scatter_kernel(src_ref, dst_ref):
+    dst_ref[...] = src_ref[...]
+
+
+def scatter_rows(src, dst):
+    return pl.pallas_call(
+        _scatter_kernel,
+        out_shape=jax.ShapeDtypeStruct(dst.shape, dst.dtype),
+    )(src)
+
+
+def execute_verify(src, dst):
+    if jax.default_backend() == "tpu":                # backend-only gate
+        return scatter_rows(src, dst)                 # MESH003
+    return dst.at[...].set(src)

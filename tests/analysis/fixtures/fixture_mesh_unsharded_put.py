@@ -1,0 +1,24 @@
+"""Seeded MESH001 violation: a committed step-program operand with no
+explicit sharding — `jax.device_put(x)` bare in a (fixture-)executor
+scope function — fires EXACTLY once.
+
+The second commit passes a NamedSharding construction and the third a
+`*sharding*`-named attribute (the `self._input_sharding` idiom); both
+must stay quiet. The function names classify as prefill/decode so
+MESH004 stays quiet too.
+"""
+import jax
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+
+class FixtureRunner:
+
+    def _prepare_prompt(self, ids):
+        return jax.device_put(ids)                       # MESH001
+
+    def _prepare_decode(self, ids):
+        sharded = jax.device_put(
+            ids, NamedSharding(self.mesh, P(None)))      # quiet
+        staged = jax.device_put(ids, self._input_sharding)  # quiet
+        return sharded, staged

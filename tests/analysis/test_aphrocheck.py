@@ -2,9 +2,9 @@
 
 Three layers:
 
-1. THE GATE: every pass (all 17 families, the ROOF/FOLD perf rules,
-   the ASYNC/RACE concurrency rules, and the LEAK/OWN page-ownership
-   rules included) over the real tree
+1. THE GATE: every pass (all 18 families, the ROOF/FOLD perf rules,
+   the ASYNC/RACE concurrency rules, the LEAK/OWN page-ownership
+   rules, and the MESH placement rules included) over the real tree
    (`aphrodite_tpu/`, `bench.py`, `benchmarks/`) must produce zero
    findings even with NO allowlist,
    the checked-in allowlist must hold at most 5 entries (currently
@@ -37,8 +37,8 @@ from tools.aphrocheck.core import (EVENT_LOOP, FLAGS_MODULE, REPO_ROOT,
 from tools.aphrocheck.passes import (async_pass, bound_pass,
                                      clock_pass, dma_pass, exc_pass,
                                      flag_pass, fold_pass, grid_pass,
-                                     leak_pass, own_pass, race_pass,
-                                     recomp_pass, ref_pass,
+                                     leak_pass, mesh_pass, own_pass,
+                                     race_pass, recomp_pass, ref_pass,
                                      roofline_pass, shard_pass,
                                      sync_pass, vmem_pass)
 from tools.aphrocheck.registry import parse_registry
@@ -79,7 +79,7 @@ def test_repo_is_clean():
 
 
 def test_repo_clean_without_allowlist():
-    """The stronger form of the gate: all 17 pass families produce
+    """The stronger form of the gate: all 18 pass families produce
     ZERO findings with no allowlist at all — every real finding the
     passes surfaced was fixed in-tree (the ROOF/FOLD motivating
     findings closed in round 7; their perf-known pragmas are gone),
@@ -131,6 +131,7 @@ def test_checker_never_imports_jax():
          "import tools.aphrocheck.passes.fold_pass; "
          "import tools.aphrocheck.passes.leak_pass; "
          "import tools.aphrocheck.passes.own_pass; "
+         "import tools.aphrocheck.passes.mesh_pass; "
          "assert 'jax' not in sys.modules, 'checker imports jax'; "
          "assert 'numpy' not in sys.modules, 'checker imports numpy'"],
         cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
@@ -202,6 +203,10 @@ def test_scan_covers_benches():
     (leak_pass.run, "fixture_leak_rollback.py", "LEAK004"),
     (own_pass.run, "fixture_own_refcount.py", "OWN001"),
     (own_pass.run, "fixture_own_escape.py", "OWN002"),
+    (mesh_pass.run, "fixture_mesh_unsharded_put.py", "MESH001"),
+    (mesh_pass.run, "fixture_mesh_collective.py", "MESH002"),
+    (mesh_pass.run, "fixture_mesh_ungated_launcher.py", "MESH003"),
+    (mesh_pass.run, "fixture_mesh_domain.py", "MESH004"),
 ])
 def test_rule_fires_exactly_once(pass_fn, fixture, rule):
     findings = _pass_findings(pass_fn, [_fixture(fixture)])
@@ -551,6 +556,49 @@ def test_live_async_findings_fixed_in_tree():
         assert "get_event_loop()" not in f.read()
 
 
+def test_shard_hot_module_scope_fires(tmp_path):
+    """SHARD004 covers the hot MODULES outside the executor —
+    `aphrodite_tpu/lora/layers.py` and `ops/ring_attention.py`, whose
+    every function sits on the step path (per-token LoRA apply,
+    per-layer ring rotation): the seeded transfer fixture copied to
+    the LoRA path fires through the hot-module scope — INCLUDING its
+    `prepare_*` helper, which the executor's hot-NAME scope exempts —
+    while the same file at another in-package path stays quiet."""
+    import shutil
+    src = os.path.join(REPO_ROOT, _fixture("fixture_shard_transfer.py"))
+    lora_rel = "aphrodite_tpu/lora/layers.py"
+    other_rel = "aphrodite_tpu/modeling/seeded.py"
+    for rel in (lora_rel, other_rel):
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(src, str(dst))
+    ctx, parse_findings = build_context(str(tmp_path), [lora_rel])
+    assert not parse_findings
+    assert [f.rule for f in shard_pass.run(ctx)] == \
+        ["SHARD004", "SHARD004"], \
+        [f.render() for f in shard_pass.run(ctx)]
+    ctx2, parse_findings2 = build_context(str(tmp_path), [other_rel])
+    assert not parse_findings2
+    assert not shard_pass.run(ctx2), \
+        [f.render() for f in shard_pass.run(ctx2)]
+
+
+def test_shard_hot_modules_clean_on_real_tree():
+    """The real LoRA layer stack and the ring-attention op satisfy
+    the SHARD pass under the extended scope (pinned here so a scope
+    regression cannot silently exempt them): every PartitionSpec
+    resolves against the declared mesh axes — including the
+    param-default `axis="sp"` idiom and named-constant specs — and
+    neither module hosts a hot-path host transfer."""
+    findings = _pass_findings(
+        shard_pass.run,
+        ["aphrodite_tpu/lora/layers.py",
+         "aphrodite_tpu/ops/ring_attention.py",
+         "aphrodite_tpu/modeling/layers/linear.py",
+         "aphrodite_tpu/common/config.py"])
+    assert not findings, [f.render() for f in findings]
+
+
 def test_shard004_scope_exempts_non_executor():
     """SHARD004 is executor-scope: the engine's step loop and the
     cache engine's cold swap path (np.asarray of whole KV planes in
@@ -705,7 +753,9 @@ def test_cli_rules_md_and_readme_drift():
                  "LEAK001", "LEAK002", "LEAK003", "LEAK004",
                  "OWN001", "OWN002",
                  "ROOF001", "ROOF002", "ROOF003", "ROOF004", "FOLD001",
-                 "FOLD002"):
+                 "FOLD002",
+                 "MESH001", "MESH002", "MESH003", "MESH004",
+                 "MESH005"):
         assert f"| {rule} |" in table, f"{rule} missing from rules-md"
     with open(os.path.join(REPO_ROOT, "README.md"),
               encoding="utf-8") as f:
@@ -726,6 +776,7 @@ def test_ci_workflow_runs_the_gates():
         workflow = f.read()
     assert "python -m tools.aphrocheck" in workflow
     assert "python -m pytest tests/" in workflow
+    assert "diff /tmp/meshplan.json MESHPLAN.json" in workflow
     assert "JAX_PLATFORMS=cpu" in workflow
     assert "-m 'not slow'" in workflow
 

@@ -148,6 +148,105 @@ def test_tp2_kv_lane_sharded_parity(tiny8_dir, tp1_llm):
     assert _greedy(llm, prompts) == _greedy(tp1_llm, prompts)
 
 
+def test_tp8_spec_decode_greedy_parity(tp8_llm, tp1_llm, monkeypatch):
+    """Speculative decoding on the sharded engine: a recurring-pattern
+    prompt drives the n-gram drafter into multi-token verify rounds
+    (`execute_spec_verify` on the tp=8 mesh), and greedy tokens stay
+    BIT-EQUAL to both the tp=8 classic run (APHRODITE_SPEC=0) and the
+    tp=1 spec run. The drafter spy proves verify rounds actually
+    accepted tokens — parity via silent classic fallback would be
+    vacuous."""
+    vocab = tp8_llm.engine.model_config.get_vocab_size()
+    pattern = [v % (vocab - 10) + 5 for v in (11, 23, 37, 41)]
+    prompt = pattern * 5
+    monkeypatch.setenv("APHRODITE_SPEC", "0")
+    classic8 = _greedy(tp8_llm, [prompt], max_tokens=24)[0]
+    monkeypatch.setenv("APHRODITE_SPEC", "1")
+    observed = []
+    drafter = tp8_llm.engine.drafter
+    orig = drafter.observe
+
+    def spy(seq_id, proposed, accepted):
+        observed.append((proposed, accepted))
+        return orig(seq_id, proposed, accepted)
+
+    monkeypatch.setattr(drafter, "observe", spy)
+    spec8 = _greedy(tp8_llm, [prompt], max_tokens=24)[0]
+    spec1 = _greedy(tp1_llm, [prompt], max_tokens=24)[0]
+    assert spec8 == classic8
+    assert spec8 == spec1
+    assert observed, "spec verify never ran on the sharded engine"
+    assert sum(a for _, a in observed) >= 1, \
+        f"no verify round accepted: rounds={observed}"
+
+
+def test_tp8_compiled_step_allreduce_count_matches_meshplan(
+        tmp_path_factory):
+    """The static placement ledger's collective model IS the compiled
+    program's: lower the bare step program at tp=8 (kv_heads=8
+    divides tp, so no KV-replication collectives muddy the count) and
+    count all-reduce ops in the HLO — it must equal MESHPLAN.json's
+    `per_layer * n_layers + fixed` (one all-reduce per row-parallel
+    matmul, o_proj + down_proj, plus the vocab-sharded embed combine)
+    with ZERO all-gathers: the logits all-gather is a CONSUMER-side
+    seam GSPMD defers into whatever reads the logits (here, the fused
+    sampler — which is exactly why this lowers `_step_fn`, the bare
+    model+logits program the ledger prices)."""
+    import json
+    import os
+    import re
+    from aphrodite_tpu.endpoints.llm import LLM
+
+    n_layers = 2
+    path = tmp_path_factory.mktemp("tiny-kv8-llama")
+    (path / "config.json").write_text(json.dumps({
+        "architectures": ["LlamaForCausalLM"], "model_type": "llama",
+        "vocab_size": 128, "hidden_size": 64, "intermediate_size": 128,
+        "num_hidden_layers": n_layers, "num_attention_heads": 8,
+        "num_key_value_heads": 8, "max_position_embeddings": 256,
+        "rms_norm_eps": 1e-6, "rope_theta": 10000.0,
+        "tie_word_embeddings": False, "torch_dtype": "float32",
+        "bos_token_id": 0, "eos_token_id": 1,
+    }))
+    llm = LLM(model=str(path), tensor_parallel_size=8, **_ENGINE_KW)
+    runner = llm.engine.executor.model_runner
+
+    captured = {}
+    orig = runner._step_sample_fn
+
+    def spy(*args, **kwargs):
+        captured["args"], captured["kwargs"] = args, kwargs
+        return orig(*args, **kwargs)
+
+    runner._step_sample_fn = spy
+    vocab = llm.engine.model_config.get_vocab_size()
+    _greedy(llm, [_prompts(vocab)[0]], max_tokens=1)
+    runner._step_sample_fn = orig
+    assert captured, "step never dispatched"
+
+    args, kwargs = captured["args"], captured["kwargs"]
+    with runner._mesh_ctx():
+        hlo = runner._step_fn.lower(
+            *args[:6], is_prompt=kwargs["is_prompt"],
+            use_prefix=kwargs["use_prefix"]).compile().as_text()
+    n_ar = len(re.findall(r" all-reduce(?:-start)?\(", hlo))
+    n_ag = len(re.findall(r" all-gather(?:-start)?\(", hlo))
+
+    plan_path = os.path.join(os.path.dirname(__file__), os.pardir,
+                             os.pardir, "MESHPLAN.json")
+    with open(plan_path, encoding="utf-8") as f:
+        plan = json.load(f)
+    rec = plan["programs"][
+        "aphrodite_tpu/executor/model_runner.py::ModelRunner._step"]
+    want = rec["all_reduce"]["per_layer"] * n_layers + \
+        rec["all_reduce"]["fixed"]
+    assert n_ar == want == 5, \
+        f"compiled {n_ar} all-reduces, ledger prices {want}"
+    assert n_ag == 0, \
+        f"compiled {n_ag} all-gathers; the logits all-gather must " \
+        "stay a consumer-side seam"
+
+
 def test_tp8_random_sampling_serves(tp8_llm):
     """Seeded random sampling (still the fused sampler program) runs
     on the sharded mesh and honors its token budget — a smoke for the

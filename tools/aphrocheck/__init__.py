@@ -59,6 +59,15 @@ Rule families (see each pass module's docstring for the contract):
                  the owner modules, and raw PhysicalTokenBlock
                  objects escaping owner scope (only block_number
                  ints may cross); `# owner-ok: <reason>` escape
+  MESH001-005    the static placement ledger (aphromesh): executor
+                 `device_put` commits without an explicit sharding,
+                 implicit replicate-repins outside the declared
+                 row-parallel/embed seams, pallas_call launcher
+                 dispatches without an InputMetadata.tp /
+                 context_tp() gate or shard_map wrap, commit sites
+                 that classify into no placement domain, and drift
+                 vs the checked-in MESHPLAN.json collective
+                 baseline; `--meshplan` emits the ledger
 
 Name resolution is interprocedural: a same-package call graph
 (core.CallGraph) lets helper parameters resolve through their call
@@ -85,7 +94,7 @@ DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(
 
 _RULE_ORDER = ("PARSE", "FLAG", "VMEM", "DMA", "GRID", "SYNC", "REF",
                "SHARD", "RECOMP", "EXC", "BP", "ASYNC", "RACE",
-               "LEAK", "OWN", "ROOF", "FOLD")
+               "LEAK", "OWN", "ROOF", "FOLD", "MESH")
 
 
 @dataclasses.dataclass

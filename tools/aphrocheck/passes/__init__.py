@@ -56,13 +56,21 @@ Rule ID families:
                          tables) outside the owner modules, and raw
                          PhysicalTokenBlock objects escaping owner
                          scope (only block_number ints may cross)
+- MESH001..MESH005   — the static placement ledger (aphromesh):
+                         executor commits without an explicit
+                         sharding, implicit replicate-repins outside
+                         the declared row-parallel/embed seams,
+                         ungated pallas_call launcher dispatches,
+                         unclassifiable placement-domain commit
+                         sites, and drift vs the checked-in
+                         MESHPLAN.json collective baseline
 """
 
 from tools.aphrocheck.passes import (async_pass, bound_pass,
                                      clock_pass, dma_pass, exc_pass,
                                      flag_pass, fold_pass, grid_pass,
-                                     leak_pass, own_pass, race_pass,
-                                     recomp_pass, ref_pass,
+                                     leak_pass, mesh_pass, own_pass,
+                                     race_pass, recomp_pass, ref_pass,
                                      roofline_pass, shard_pass,
                                      sync_pass, vmem_pass)
 
@@ -84,4 +92,5 @@ ALL_PASSES = (
     ("OWN", own_pass.run),
     ("ROOF", roofline_pass.run),
     ("FOLD", fold_pass.run),
+    ("MESH", mesh_pass.run),
 )

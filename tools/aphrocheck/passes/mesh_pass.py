@@ -1,0 +1,853 @@
+"""MESH pass: static placement ledger and collective-cost analysis.
+
+The multichip step's placement contract lives in scattered annotations:
+`shard_along` pins in the linear layers, `kv_partition_spec` on the KV
+planes, `InputMetadata.tp` gates in front of every single-device Pallas
+launcher, and explicit `NamedSharding`s on every committed operand.
+One compiled-at-tp=8 test proves the composition; nothing proves the
+pieces. This pass derives the collective structure of the step program
+statically from those annotations — the static twin of the r05 ICI
+model — and ledgers it in MESHPLAN.json (regenerate with
+`python -m tools.aphrocheck --meshplan --json > MESHPLAN.json`), so the
+disagg prefill/decode split (ROADMAP item 2) starts from a
+machine-defined placement map instead of a code read.
+
+- MESH001: a committed step-program operand (`jax.device_put` in
+  executor scope) with no explicit sharding argument — a NamedSharding
+  construction, a name that carries one (`sharding`,
+  `self._input_sharding`), or a local assigned from one. Placement by
+  GSPMD guessing is exactly the hole `_dev`/`_dev_tree` exist to close.
+- MESH002: an implicit collective outside the declared seams — a value
+  pinned feature-sharded (`shard_along(x, "tp")`) later re-pinned
+  replicated (`shard_along(x, None)`) in the same function. The ONLY
+  sanctioned replicate-repins are the row-parallel output
+  (`out_activation = None`) and the vocab-parallel embed combine;
+  an ad-hoc repin inserts an all-reduce the plan does not price.
+- MESH003: tp-gate coverage — every call of a `pallas_call` launcher
+  outside ops/pallas/ must sit behind an `InputMetadata.tp` /
+  `context_tp()` gate (directly, through a gate variable, or through a
+  one-hop predicate like `_pallas_decode_ok`/`_use_pallas`) or inside
+  a shard_map-wrapped function. Pallas kernels are single-device
+  programs: an ungated launcher on a tp>1 mesh either crashes at
+  trace time or silently computes on one shard's slice.
+- MESH004: placement-domain map — every committed array
+  (`_dev`/`_dev_tree`/`device_put` in executor scope) must classify
+  as prefill / decode / maintenance / shared / shared_kv from its
+  committing function, machine-defining which arrays a disagg
+  (prefill-group, decode-group) split hands off (the
+  `kv_partition_spec` set) vs replicates. An unclassifiable commit
+  site fires.
+- MESH005: drift vs the checked-in MESHPLAN.json — ledger out of sync,
+  or a jitted program's static all-reduce count grew (a new collective
+  on the step path that the ICI model has not priced).
+
+Static collective model (verified against compiled tp=8 HLO on the
+virtual 8-device mesh, tests/engine/test_tp_parity.py): per layer, one
+all-reduce per row-parallel matmul (o_proj + down_proj); per step, one
+all-reduce for the vocab-sharded embed combine. The vocab-sharded
+logits' all-gather is a CONSUMER-side seam — GSPMD defers it into
+whatever reads the logits (the fused sampler's reductions), so the
+bare step program compiles to per_layer*n_layers + fixed all-reduces
+and ZERO all-gathers.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.aphrocheck.core import (Finding, Module, assignments_of,
+                                   call_tail, dotted_name, iter_calls,
+                                   keyword_arg, str_const, tail_name)
+
+BASELINE_FILE = "MESHPLAN.json"
+
+_EXECUTOR_PREFIXES = ("aphrodite_tpu/executor/",)
+_MODELS_PREFIX = "aphrodite_tpu/modeling/models/"
+_PALLAS_PREFIX = "aphrodite_tpu/ops/pallas/"
+
+#: Everything the CLI normally scans; explicitly-passed files outside
+#: these roots (the seeded fixtures) are treated as in-scope.
+_SCAN_PREFIXES = ("aphrodite_tpu/", "benchmarks/", "bench.py")
+
+#: The reference model chain the per-program counts are priced with
+#: (the geometry below is its 7B serving point).
+_REFERENCE_MODEL = "LlamaForCausalLM"
+
+#: The recorded 7B serving geometry (mirrors the r05 ICI model:
+#: bs=256 bf16 decode on v5e-8, ~180 GB/s usable ICI per chip).
+_GEOMETRY = {
+    "n_layers": 32,
+    "hidden": 4096,
+    "batch": 256,
+    "vocab": 32000,
+    "dtype_bytes": 2,
+    "tp": 8,
+    "ici_gbps": 180.0,
+}
+
+#: Commit-site domain classification, checked in order. shared_kv is
+#: special-cased first (body references kv_partition_spec — the
+#: disagg handoff set).
+_DOMAIN_RULES = (
+    ("prefill", ("prompt", "prefill")),
+    ("decode", ("decode", "burst", "spec")),
+    ("maintenance", ("copy", "swap", "block")),
+    ("shared", ("model", "lora", "param")),
+)
+
+_COMMIT_TAILS = ("_dev", "_dev_tree", "device_put")
+
+
+def _fixture_scope(rel: str) -> bool:
+    rel = rel.replace("\\", "/")
+    return not any(rel == p.rstrip("/") or rel.startswith(p)
+                   for p in _SCAN_PREFIXES)
+
+
+def _executor_scope(rel: str) -> bool:
+    rel = rel.replace("\\", "/")
+    return any(rel.startswith(p) for p in _EXECUTOR_PREFIXES) or \
+        _fixture_scope(rel)
+
+
+def _package_scope(rel: str) -> bool:
+    """MESH003 call-site scope: the package minus the kernel modules
+    themselves (a launcher calling its own kernel is the launch, not
+    a dispatch decision) and minus the bench harnesses (single-chip
+    by construction)."""
+    rel = rel.replace("\\", "/")
+    if rel.startswith(_PALLAS_PREFIX):
+        return False
+    return rel.startswith("aphrodite_tpu/") or _fixture_scope(rel)
+
+
+def _qualname(module: Module, fn: ast.AST) -> str:
+    parts = [fn.name]
+    cur = module.parents.get(fn)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            parts.append(cur.name)
+        cur = module.parents.get(cur)
+    return ".".join(reversed(parts))
+
+
+# ------------------------------------------------------------------
+# MESH001 — committed operands without an explicit sharding
+# ------------------------------------------------------------------
+
+def _sharding_expr(module: Module, scope, node: ast.AST,
+                   depth: int = 0) -> bool:
+    """Whether an expression names an explicit sharding: a
+    *Sharding(...) construction, an identifier that carries one by
+    name, or a local assigned from either."""
+    if node is None or depth > 3:
+        return False
+    if isinstance(node, ast.Call):
+        t = tail_name(node.func) or ""
+        return t.endswith("Sharding")
+    name = dotted_name(node)
+    if name is None and isinstance(node, ast.Attribute):
+        name = node.attr
+    if name and "sharding" in name.lower():
+        return True
+    if isinstance(node, ast.Name) and scope is not None:
+        for src in assignments_of(scope, node.id, module):
+            if _sharding_expr(module, scope, src, depth + 1):
+                return True
+    return False
+
+
+def _mesh001(module: Module, findings: List[Finding]) -> None:
+    if not _executor_scope(module.rel):
+        return
+    if "device_put" not in module.text:
+        return
+    for call in module.calls:
+        if tail_name(call.func) != "device_put":
+            continue
+        dst = call.args[1] if len(call.args) >= 2 else (
+            keyword_arg(call, "device") or keyword_arg(call, "sharding"))
+        scope = module.enclosing_function(call)
+        if dst is None or not _sharding_expr(module, scope, dst):
+            findings.append(module.finding(
+                "MESH001", call,
+                "device_put of a step-program operand without an "
+                "explicit NamedSharding — placement by GSPMD guessing; "
+                "commit through _dev/_dev_tree or pass the declared "
+                "sharding"))
+
+
+# ------------------------------------------------------------------
+# MESH002 — implicit collective outside the declared seams
+# ------------------------------------------------------------------
+
+def _mesh002(module: Module, findings: List[Finding]) -> None:
+    if "shard_along" not in module.text:
+        return
+    for call in module.calls:
+        if tail_name(call.func) != "shard_along" or len(call.args) < 2:
+            continue
+        axis = call.args[1]
+        if not (isinstance(axis, ast.Constant) and axis.value is None):
+            continue
+        src_name = call.args[0]
+        if not isinstance(src_name, ast.Name):
+            continue
+        scope = module.enclosing_function(call)
+        if scope is None:
+            continue
+        for src in assignments_of(scope, src_name.id, module):
+            if isinstance(src, ast.Call) and \
+                    tail_name(src.func) == "shard_along" and \
+                    len(src.args) >= 2 and \
+                    str_const(src.args[1]) == "tp":
+                findings.append(module.finding(
+                    "MESH002", call,
+                    f"`{src_name.id}` is pinned feature-sharded "
+                    "(shard_along(..., \"tp\")) and then re-pinned "
+                    "replicated in the same function — an implicit "
+                    "all-reduce outside the declared row-parallel/"
+                    "embed seams that the ICI cost model does not "
+                    "price"))
+                break
+
+
+# ------------------------------------------------------------------
+# MESH003 — tp-gate coverage of pallas_call launchers
+# ------------------------------------------------------------------
+
+def _launcher_registry(modules: List[Module]) -> Set[str]:
+    """Function names that transitively (local call edges) reach a
+    pallas_call — the kernel launchers. Predicates (`*_supported`,
+    `can_use_pallas_writer`) and cross-module wrappers do not reach a
+    pallas_call locally and stay out."""
+    launchers: Set[str] = set()
+    for module in modules:
+        if "pallas_call" not in module.text:
+            continue
+        defs = module.def_index(None)
+        callee_memo: Dict[int, Set[str]] = {}
+
+        def callees(fn: ast.AST) -> Set[str]:
+            got = callee_memo.get(id(fn))
+            if got is None:
+                got = set()
+                for c in iter_calls(fn):
+                    t = call_tail(c)
+                    if t:
+                        got.add(t)
+                callee_memo[id(fn)] = got
+            return got
+
+        reach_memo: Dict[int, bool] = {}
+
+        def reaches(fn: ast.AST, stack: Tuple[int, ...]) -> bool:
+            got = reach_memo.get(id(fn))
+            if got is not None:
+                return got
+            if id(fn) in stack or len(stack) > 8:
+                return False
+            cs = callees(fn)
+            hit = "pallas_call" in cs
+            if not hit:
+                for name in cs:
+                    for sub in defs.get(name, ()):
+                        if reaches(sub, stack + (id(fn),)):
+                            hit = True
+                            break
+                    if hit:
+                        break
+            reach_memo[id(fn)] = hit
+            return hit
+
+        for name, fns in defs.items():
+            if any(reaches(fn, ()) for fn in fns):
+                launchers.add(name)
+    return launchers
+
+
+_TP_ATTRS = ("tp", "_tp")
+
+
+def _expr_has_tp_marker(module: Module, scope, expr: ast.AST,
+                        depth: int = 0) -> bool:
+    """Whether a gate expression consults the tp degree: an
+    `InputMetadata.tp` read, a bare `tp` name, a `context_tp()` probe,
+    a gate variable assigned from one, or a one-hop call to a local
+    predicate whose body contains one."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and node.attr in _TP_ATTRS:
+            return True
+        if isinstance(node, ast.Name) and node.id == "tp":
+            return True
+        if isinstance(node, ast.Call):
+            t = call_tail(node)
+            if t in ("context_tp", "shard_map", "get_shard_map"):
+                return True
+            if t and depth < 1:
+                for fn in module.def_index(None).get(t, ()):
+                    if _expr_has_tp_marker(module, fn, fn,
+                                           depth=2):
+                        return True
+    if depth < 2 and scope is not None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Name):
+                for src in assignments_of(scope, node.id, module):
+                    if src is not expr and _expr_has_tp_marker(
+                            module, scope, src, depth + 1):
+                        return True
+    return False
+
+
+def _tp_gated(module: Module, call: ast.Call) -> bool:
+    scope = module.enclosing_function(call)
+    if scope is not None:
+        for c in iter_calls(scope):
+            if call_tail(c) in ("shard_map", "get_shard_map"):
+                return True
+    cur: ast.AST = call
+    parent = module.parents.get(cur)
+    while parent is not None:
+        if isinstance(parent, (ast.If, ast.IfExp)) and \
+                _expr_has_tp_marker(module, scope, parent.test):
+            return True
+        cur, parent = parent, module.parents.get(parent)
+    return False
+
+
+def _launcher_targets(module: Module, call: ast.Call,
+                      launchers: Set[str]) -> List[str]:
+    t = call_tail(call)
+    if t in launchers:
+        return [t]
+    if isinstance(call.func, ast.Name):
+        scope = module.enclosing_function(call)
+        if scope is not None:
+            hits: List[str] = []
+            for src in assignments_of(scope, call.func.id, module):
+                for n in ast.walk(src):
+                    if isinstance(n, ast.Name) and n.id in launchers:
+                        hits.append(n.id)
+            return sorted(set(hits))
+    return []
+
+
+def _mesh003(module: Module, launchers: Set[str],
+             findings: List[Finding]) -> None:
+    if not _package_scope(module.rel):
+        return
+    for call in module.calls:
+        targets = _launcher_targets(module, call, launchers)
+        if not targets or _tp_gated(module, call):
+            continue
+        findings.append(module.finding(
+            "MESH003", call,
+            f"pallas_call launcher {'/'.join(targets)} dispatched "
+            "without an InputMetadata.tp / context_tp() gate or "
+            "shard_map wrap — Pallas kernels are single-device "
+            "programs; tp>1 must take the GSPMD-partitionable jnp "
+            "path"))
+
+
+# ------------------------------------------------------------------
+# MESH004 — the placement-domain map
+# ------------------------------------------------------------------
+
+def _commit_domain(module: Module, fn: ast.AST) -> Optional[str]:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and \
+                node.id == "kv_partition_spec":
+            return "shared_kv"
+    name = fn.name.lower()
+    for domain, keys in _DOMAIN_RULES:
+        if any(k in name for k in keys):
+            return domain
+    return None
+
+
+def _commit_functions(module: Module) -> Dict[int, ast.AST]:
+    """id -> top-level function containing a _dev/_dev_tree/device_put
+    commit (the commit primitives themselves excluded)."""
+    out: Dict[int, ast.AST] = {}
+    for call in module.calls:
+        if tail_name(call.func) not in _COMMIT_TAILS:
+            continue
+        fn = module.top_level_function(call)
+        if fn is None or fn.name in ("_dev", "_dev_tree"):
+            continue
+        out[id(fn)] = fn
+    return out
+
+
+def _mesh004(module: Module, findings: List[Finding]) -> None:
+    if not _executor_scope(module.rel):
+        return
+    if not any(t in module.text for t in ("_dev", "device_put")):
+        return
+    for fn in _commit_functions(module).values():
+        if _commit_domain(module, fn) is None:
+            findings.append(module.finding(
+                "MESH004", fn,
+                f"commit site {fn.name} does not classify into a "
+                "placement domain (prefill/decode/maintenance/"
+                "shared/shared_kv) — the disagg split cannot place "
+                "arrays it cannot classify; name the function for "
+                "its phase or route the commit through a classified "
+                "helper"))
+
+
+# ------------------------------------------------------------------
+# the static collective model (MESH002's ledger surface)
+# ------------------------------------------------------------------
+
+def _class_table(modules: List[Module]
+                 ) -> Dict[str, Tuple[Module, ast.ClassDef]]:
+    table: Dict[str, Tuple[Module, ast.ClassDef]] = {}
+    for module in modules:
+        for node in module.nodes:
+            if isinstance(node, ast.ClassDef):
+                table.setdefault(node.name, (module, node))
+    return table
+
+
+def _mro(table, name: str, _path=frozenset()) -> List[str]:
+    """Approximate C3 linearization: left-to-right DFS, deduplicated
+    keeping the LAST occurrence — so a shared base sinks below every
+    subclass that refines it (exact for this package's single-diamond
+    hierarchies, e.g. MergedColumnParallelLinear(_ShardedLoadMixin,
+    ColumnParallelLinear) resolves out_axis from ColumnParallel, not
+    the mixin's LinearBase)."""
+    if name not in table or name in _path or len(_path) > 8:
+        return []
+    order = [name]
+    _, cls = table[name]
+    for base in cls.bases:
+        bn = tail_name(base)
+        if bn:
+            order.extend(_mro(table, bn, _path | {name}))
+    out: List[str] = []
+    for n in reversed(order):
+        if n not in out:
+            out.append(n)
+    out.reverse()
+    return out
+
+
+def _class_attr(table, name: str, attr: str) -> Optional[ast.AST]:
+    for cname in _mro(table, name):
+        _, cls = table[cname]
+        for stmt in cls.body:
+            if isinstance(stmt, ast.Assign):
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id == attr:
+                        return stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and \
+                    isinstance(stmt.target, ast.Name) and \
+                    stmt.target.id == attr and stmt.value is not None:
+                return stmt.value
+    return None
+
+
+def _attr_json(node: Optional[ast.AST]):
+    if node is None:
+        return "absent"
+    if isinstance(node, ast.Constant):
+        if node.value is False:
+            return "unpinned"
+        return node.value
+    return "dynamic"
+
+
+def _cost_classes(table) -> Dict[str, Tuple[str, str]]:
+    """class name -> (collective kind, why) for classes whose use
+    inserts a collective: row-parallel layers (output re-pinned
+    replicated => all-reduce), replicate-pinned combines (the vocab
+    embed => all-reduce), vocab-sharded logits heads (compute_logits
+    pinning "tp" => consumer-side all-gather)."""
+    costs: Dict[str, Tuple[str, str]] = {}
+    for name in table:
+        out_act = _class_attr(table, name, "out_activation")
+        if isinstance(out_act, ast.Constant) and out_act.value is None:
+            costs[name] = ("all_reduce",
+                           "row-parallel output re-pinned replicated")
+            continue
+        _, cls = table[name]
+        own_ar = own_ag = False
+        for stmt in cls.body:
+            if not isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            for c in iter_calls(stmt):
+                if tail_name(c.func) != "shard_along" or \
+                        len(c.args) < 2:
+                    continue
+                axis = c.args[1]
+                if isinstance(axis, ast.Constant) and axis.value is None:
+                    own_ar = True
+                elif str_const(axis) == "tp" and \
+                        stmt.name == "compute_logits":
+                    own_ag = True
+        if own_ar:
+            costs[name] = ("all_reduce", "replicate-pinned combine")
+        elif own_ag:
+            costs[name] = ("all_gather",
+                           "vocab-sharded logits (consumer-side seam)")
+    return costs
+
+
+def _in_loop(module: Module, fn: ast.AST, node: ast.AST) -> bool:
+    cur = module.parents.get(node)
+    while cur is not None and cur is not fn:
+        if isinstance(cur, (ast.For, ast.While, ast.ListComp,
+                            ast.SetComp, ast.DictComp,
+                            ast.GeneratorExp)):
+            return True
+        cur = module.parents.get(cur)
+    return False
+
+
+def _collect_sites(table, costs, cls_name: str, repeated: bool,
+                   sites: Dict[Tuple[str, int], Tuple[str, bool]],
+                   stack: frozenset) -> None:
+    if cls_name in stack or len(stack) > 8:
+        return
+    stack = stack | {cls_name}
+    for mro_name in _mro(table, cls_name):
+        module, cls = table[mro_name]
+        for stmt in cls.body:
+            if not isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            for call in iter_calls(stmt):
+                t = tail_name(call.func)
+                if t is None or t == cls_name or t not in table:
+                    continue
+                rep = repeated or _in_loop(module, stmt, call)
+                if t in costs:
+                    key = (module.rel, call.lineno)
+                    prev = sites.get(key)
+                    sites[key] = (costs[t][0],
+                                  rep or (prev[1] if prev else False))
+                _collect_sites(table, costs, t, rep, sites, stack)
+
+
+def _model_counts(ctx, table, costs) -> Dict[str, dict]:
+    models: Dict[str, dict] = {}
+    for name in sorted(table):
+        module, _cls = table[name]
+        if not name.endswith("ForCausalLM"):
+            continue
+        rel = module.rel.replace("\\", "/")
+        if not (rel.startswith(_MODELS_PREFIX) or _fixture_scope(rel)):
+            continue
+        sites: Dict[Tuple[str, int], Tuple[str, bool]] = {}
+        _collect_sites(table, costs, name, False, sites, frozenset())
+        per_layer = {"all_reduce": 0, "all_gather": 0}
+        fixed = {"all_reduce": 0, "all_gather": 0}
+        for kind, repeated in sites.values():
+            (per_layer if repeated else fixed)[kind] += 1
+        models[name] = {
+            "all_reduce": {"per_layer": per_layer["all_reduce"],
+                           "fixed": fixed["all_reduce"]},
+            "all_gather": {"per_layer": per_layer["all_gather"],
+                           "fixed": fixed["all_gather"]},
+        }
+    return models
+
+
+# ------------------------------------------------------------------
+# jitted step programs and their collective counts
+# ------------------------------------------------------------------
+
+def _method_closure(module: Module, fn: ast.AST,
+                    depth: int = 3) -> List[ast.AST]:
+    defs = module.def_index(None)
+    out: Dict[int, ast.AST] = {id(fn): fn}
+    frontier = [fn]
+    for _ in range(depth):
+        nxt: List[ast.AST] = []
+        for f in frontier:
+            for c in iter_calls(f):
+                t = call_tail(c)
+                for sub in defs.get(t, ()) if t else ():
+                    if id(sub) not in out:
+                        out[id(sub)] = sub
+                        nxt.append(sub)
+        frontier = nxt
+    return list(out.values())
+
+
+def _programs(ctx, ref_counts: Optional[dict]) -> Dict[str, dict]:
+    out: Dict[str, dict] = {}
+    for module in ctx.modules:
+        if not _executor_scope(module.rel):
+            continue
+        if "jit" not in module.text:
+            continue
+        for call in module.calls:
+            if tail_name(call.func) != "jit" or not call.args:
+                continue
+            target = tail_name(call.args[0])
+            if target is None:
+                continue
+            defs = module.def_index(None).get(target, [])
+            if not defs:
+                continue
+            fn = defs[0]
+            closure = _method_closure(module, fn)
+            tails = {call_tail(c)
+                     for f in closure for c in iter_calls(f)}
+            forward = "model" in tails
+            logits = "compute_logits" in tails
+            rec = {
+                "model_forward": forward,
+                "logits_head": logits,
+                "multi_step_scan": "scan" in tails,
+            }
+            ar = {"per_layer": 0, "fixed": 0}
+            ag_fixed = 0
+            if ref_counts is not None:
+                if forward:
+                    ar = dict(ref_counts["all_reduce"])
+                if logits:
+                    ag_fixed = ref_counts["all_gather"]["fixed"]
+            rec["all_reduce"] = ar
+            rec["all_gather_consumer_seam"] = ag_fixed
+            out[f"{module.rel}::{_qualname(module, fn)}"] = rec
+    return {k: out[k] for k in sorted(out)}
+
+
+def _domain_map(ctx) -> Tuple[Dict[str, str], List[str]]:
+    domains: Dict[str, str] = {}
+    handoff: List[str] = []
+    for module in ctx.modules:
+        if not _executor_scope(module.rel):
+            continue
+        if not any(t in module.text for t in ("_dev", "device_put")):
+            continue
+        for fn in _commit_functions(module).values():
+            domain = _commit_domain(module, fn)
+            if domain is None:
+                continue
+            qual = f"{module.rel}::{_qualname(module, fn)}"
+            domains[qual] = domain
+            if domain == "shared_kv":
+                handoff.append(qual)
+    return ({k: domains[k] for k in sorted(domains)}, sorted(handoff))
+
+
+def _geometry(ref_counts: dict) -> dict:
+    g = _GEOMETRY
+    per_layer = ref_counts["all_reduce"]["per_layer"]
+    fixed = ref_counts["all_reduce"]["fixed"]
+    n_ar = per_layer * g["n_layers"] + fixed
+    ar_payload = g["batch"] * g["hidden"] * g["dtype_bytes"]
+    ar_bytes = n_ar * ar_payload
+    # Ring collectives: all-reduce moves 2(N-1)/N of the payload per
+    # chip, all-gather (N-1)/N (same model as the r05 dry run).
+    tp = g["tp"]
+    ici_ar = ar_bytes * 2 * (tp - 1) / tp
+    ag_payload = g["batch"] * g["vocab"] * g["dtype_bytes"]
+    ici_ag = ag_payload * (tp - 1) / tp
+    ici_gbps = g["ici_gbps"] * 1e9
+    return {
+        **g,
+        "all_reduce_count_per_step": n_ar,
+        "all_reduce_mb_per_step": round(ar_bytes / 1e6, 2),
+        "all_reduce_ici_mb_per_chip": round(ici_ar / 1e6, 2),
+        "all_reduce_ici_ms": round(ici_ar / ici_gbps * 1e3, 3),
+        "logits_all_gather_mb": round(ag_payload / 1e6, 2),
+        "logits_all_gather_ici_ms": round(
+            ici_ag / ici_gbps * 1e3, 3),
+    }
+
+
+def report_payload(ctx) -> dict:
+    """The MESHPLAN.json schema. Line numbers are excluded on
+    purpose: pure code motion must not drift the baseline, only
+    placement-structure changes."""
+    from tools.aphrocheck.passes.shard_pass import _declared_axes
+
+    table = _class_table(ctx.modules)
+    costs = _cost_classes(table)
+    axes, _found = _declared_axes(ctx.modules)
+    plan: Dict[str, dict] = {}
+    for name in sorted(table):
+        attrs = {a: _attr_json(_class_attr(table, name, a))
+                 for a in ("out_axis", "in_axis", "out_activation")}
+        if all(v == "absent" for v in attrs.values()) and \
+                name not in costs:
+            continue
+        rec = {k: v for k, v in attrs.items() if v != "absent"}
+        if name in costs:
+            rec["collective"] = costs[name][0]
+            rec["why"] = costs[name][1]
+        plan[name] = rec
+    models = _model_counts(ctx, table, costs)
+    ref = models.get(_REFERENCE_MODEL)
+    domains, handoff = _domain_map(ctx)
+    payload = {
+        "mesh_axes": sorted(axes),
+        "reference_model": _REFERENCE_MODEL if ref else None,
+        "sharding_plan": plan,
+        "models": models,
+        "programs": _programs(ctx, ref),
+        "domains": domains,
+        "kv_handoff": {
+            "partition_spec": "kv_partition_spec",
+            "commit_sites": handoff,
+            "replicated_fallback":
+                "num_kv_heads % tp != 0 replicates the pages",
+        },
+    }
+    if ref is not None:
+        payload["geometry_7b"] = _geometry(ref)
+    return payload
+
+
+def render_report(ctx) -> str:
+    payload = report_payload(ctx)
+    lines = ["MESH placement ledger — static collective model of the "
+             "multichip step path", ""]
+    lines.append(f"mesh axes: {', '.join(payload['mesh_axes']) or '?'}")
+    lines.append("")
+    lines.append("models (collectives per forward):")
+    for name, rec in payload["models"].items():
+        ar, ag = rec["all_reduce"], rec["all_gather"]
+        lines.append(
+            f"  {name}: all-reduce {ar['per_layer']}/layer + "
+            f"{ar['fixed']} fixed; all-gather {ag['per_layer']}/layer "
+            f"+ {ag['fixed']} fixed (consumer seam)")
+    lines.append("")
+    lines.append("jitted programs:")
+    for qual, rec in payload["programs"].items():
+        ar = rec["all_reduce"]
+        tags = [t for t, on in (
+            ("forward", rec["model_forward"]),
+            ("logits", rec["logits_head"]),
+            ("scan", rec["multi_step_scan"])) if on]
+        lines.append(
+            f"  {qual}: {'+'.join(tags) or 'no-model'}; all-reduce "
+            f"{ar['per_layer']}/layer + {ar['fixed']} fixed, "
+            f"all-gather seam {rec['all_gather_consumer_seam']}")
+    lines.append("")
+    lines.append("placement domains:")
+    for qual, domain in payload["domains"].items():
+        lines.append(f"  {qual}: {domain}")
+    geo = payload.get("geometry_7b")
+    if geo:
+        lines.append("")
+        lines.append(
+            f"7B geometry (bs={geo['batch']}, tp={geo['tp']}, "
+            f"{geo['ici_gbps']:.0f} GB/s ICI): "
+            f"{geo['all_reduce_count_per_step']} all-reduces/step, "
+            f"{geo['all_reduce_mb_per_step']} MB payload -> "
+            f"{geo['all_reduce_ici_mb_per_chip']} MB/chip over ICI, "
+            f"{geo['all_reduce_ici_ms']} ms; logits all-gather seam "
+            f"{geo['logits_all_gather_mb']} MB, "
+            f"{geo['logits_all_gather_ici_ms']} ms")
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------------
+# MESH005 — drift vs the checked-in baseline
+# ------------------------------------------------------------------
+
+def _load_baseline(root: str) -> Optional[dict]:
+    path = os.path.join(root, BASELINE_FILE)
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _program_ar_total(rec: dict, n_layers_token: int = 1) -> int:
+    ar = rec.get("all_reduce", {})
+    return int(ar.get("per_layer", 0)) * n_layers_token + \
+        int(ar.get("fixed", 0))
+
+
+def _mesh005(ctx, payload: dict,
+             findings: List[Finding]) -> None:
+    if not getattr(ctx, "full_scan", True):
+        return
+    if not payload.get("programs"):
+        # Subset scans with no jitted program in view have no plan to
+        # compare; the full sweep and the tier-1 ledger test carry
+        # the gate.
+        return
+    baseline = _load_baseline(getattr(ctx, "root", "."))
+    if baseline is None or baseline == payload:
+        return
+    by_rel = {m.rel: m for m in ctx.modules}
+    anchor_rel = next(iter(sorted(payload["programs"]))).split("::")[0]
+    module = by_rel.get(anchor_rel, ctx.modules[0])
+    anchor = module.tree.body[0] if getattr(module.tree, "body", None) \
+        else module.tree
+    base_prog = baseline.get("programs", {})
+    grew = []
+    for qual, rec in payload["programs"].items():
+        old = base_prog.get(qual)
+        if old is not None and \
+                _program_ar_total(rec) > _program_ar_total(old):
+            grew.append(qual)
+    if grew:
+        findings.append(module.finding(
+            "MESH005", anchor,
+            f"static all-reduce count grew for {', '.join(grew)} vs "
+            f"the checked-in {BASELINE_FILE} — a new collective on "
+            "the step path the ICI model has not priced; if "
+            "intentional, regenerate with `python -m tools.aphrocheck "
+            "--meshplan --json > MESHPLAN.json`"))
+    else:
+        findings.append(module.finding(
+            "MESH005", anchor,
+            f"{BASELINE_FILE} is out of sync with the tree — "
+            "regenerate with `python -m tools.aphrocheck --meshplan "
+            "--json > MESHPLAN.json`"))
+
+
+# ------------------------------------------------------------------
+
+def run(ctx) -> List[Finding]:
+    findings: List[Finding] = []
+    launchers = _launcher_registry(ctx.modules)
+    for module in ctx.modules:
+        _mesh001(module, findings)
+        _mesh002(module, findings)
+        _mesh003(module, launchers, findings)
+        _mesh004(module, findings)
+    payload = report_payload(ctx)
+    _mesh005(ctx, payload, findings)
+    return findings
+
+
+#: (rule, one-line contract, example) — rendered by `--rules-md`.
+RULES = (
+    ("MESH001", "executor-scope `device_put` without an explicit "
+     "sharding (NamedSharding construction, a `*sharding*` name, or "
+     "a local assigned from one) — placement by GSPMD guessing",
+     "`jax.device_put(ids)` instead of `self._dev(ids)`"),
+    ("MESH002", "a feature-sharded value (`shard_along(x, \"tp\")`) "
+     "re-pinned replicated in the same function — an implicit "
+     "all-reduce outside the declared row-parallel/embed seams",
+     '`y = shard_along(y, "tp")` ... `shard_along(y, None)`'),
+    ("MESH003", "a `pallas_call` launcher dispatched outside "
+     "ops/pallas/ without an `InputMetadata.tp`/`context_tp()` gate "
+     "or shard_map wrap — Pallas kernels are single-device programs",
+     "`write_kv_pages(...)` behind a backend-only check"),
+    ("MESH004", "an executor commit site (`_dev`/`_dev_tree`/"
+     "`device_put`) that classifies into no placement domain "
+     "(prefill/decode/maintenance/shared/shared_kv) — the disagg "
+     "split cannot place arrays it cannot classify",
+     "`self._dev(x)` in a function named `stage_inputs`"),
+    ("MESH005", "MESHPLAN.json out of sync with the tree, or a "
+     "jitted program's static all-reduce count grew — regenerate "
+     "with `python -m tools.aphrocheck --meshplan --json > "
+     "MESHPLAN.json`",
+     "a new `shard_along(..., None)` seam reachable from `_step`"),
+)

@@ -7,10 +7,13 @@ error that names neither the spec nor the layer that owns it.
 
 - SHARD001: a literal string axis in a `PartitionSpec(...)` / `P(...)`
   call that no `Mesh(...)`/`make_mesh(...)` axis-name declaration in
-  the scanned tree provides. Variable axes (`in_axis`, a function's
-  `axis_name` parameter) are not literals and stay silent; the pass
-  is also silent when the scan contains no mesh declaration at all
-  (subset scans of non-mesh files).
+  the scanned tree provides. Declarations resolve through named
+  constants (the production `Mesh(devices, ParallelConfig.MESH_AXES)`
+  spelling), and an `axis_name: str = "sp"`-style parameter DEFAULT
+  counts as that literal at its P() uses; truly variable axes
+  (`in_axis`, a defaultless parameter) stay silent, as does the pass
+  when the scan contains no mesh declaration at all (subset scans of
+  non-mesh files).
 - SHARD002: `jax.device_put(x, NamedSharding(mesh, P(...)))` where the
   spec has MORE axes than x's statically-known rank (resolved through
   assignments to literal-shape constructors — jnp.zeros/ones/full —
@@ -25,7 +28,10 @@ error that names neither the spec nor the layer that owns it.
 - SHARD004: a host transfer (`.item()`, `np.asarray`/`np.array`,
   `jax.device_get`) of a MESH-SHARDED array inside an executor-scope
   (`aphrodite_tpu/executor/`) hot-path (`execute_*`/`dispatch_*`/
-  `finalize_*`) function. Pulling a tp-sharded KV plane or parameter
+  `finalize_*`) function — plus EVERY function of the hot modules
+  that build PartitionSpecs outside the executor (lora/layers.py's
+  per-token apply, ops/ring_attention.py's per-layer ring), where
+  any host pull sits on the step path regardless of its name. Pulling a tp-sharded KV plane or parameter
   is a cross-device all-gather plus a multi-GB device->host copy per
   call — the exact class of silent step-time cliff the multichip
   sharding plan exists to avoid. "Mesh-sharded" is the repo's naming
@@ -71,6 +77,45 @@ _SHARDED_NAMES = frozenset((
 _TRANSFER_CALLS = {"np.asarray", "np.array", "numpy.asarray",
                    "numpy.array"}
 
+#: SHARD004 hot MODULES: PartitionSpec builders outside the executor
+#: whose every function sits on the step path (per-token LoRA apply,
+#: per-layer ring rotation) — hot regardless of function name.
+_HOT_MODULES = frozenset((
+    "aphrodite_tpu/lora/layers.py",
+    "aphrodite_tpu/ops/ring_attention.py",
+))
+
+
+def _literal_axis_names(node: ast.AST) -> Optional[List[str]]:
+    if isinstance(node, (ast.Tuple, ast.List)) and node.elts:
+        names = [str_const(e) for e in node.elts]
+        if all(n is not None for n in names):
+            return names
+    return None
+
+
+def _resolve_axis_constant(modules: List[Module],
+                           name: str) -> Optional[List[str]]:
+    """A named axis-tuple constant (`MESH_AXES = ("dp", ...)`) — the
+    production `Mesh(devices, ParallelConfig.MESH_AXES)` spelling —
+    resolved by tail name across the scanned tree."""
+    for module in modules:
+        for node in module.nodes:
+            value = None
+            if isinstance(node, ast.Assign):
+                if any(isinstance(t, ast.Name) and t.id == name
+                       for t in node.targets):
+                    value = node.value
+            elif isinstance(node, ast.AnnAssign):
+                if isinstance(node.target, ast.Name) and \
+                        node.target.id == name:
+                    value = node.value
+            names = _literal_axis_names(value) if value is not None \
+                else None
+            if names is not None:
+                return names
+    return None
+
 
 def _declared_axes(modules: List[Module]) -> Tuple[Set[str], bool]:
     """(axis names, any declaration found) across the scanned tree."""
@@ -86,11 +131,14 @@ def _declared_axes(modules: List[Module]) -> Tuple[Set[str], bool]:
                     cand = kw.value
             if cand is None and len(call.args) >= 2:
                 cand = call.args[1]
-            if isinstance(cand, (ast.Tuple, ast.List)):
-                names = [str_const(e) for e in cand.elts]
-                if all(n is not None for n in names):
-                    axes.update(names)
-                    found = True
+            names = _literal_axis_names(cand)
+            if names is None and cand is not None:
+                const = tail_name(cand)
+                if const:
+                    names = _resolve_axis_constant(modules, const)
+            if names is not None:
+                axes.update(names)
+                found = True
     return axes, found
 
 
@@ -118,18 +166,43 @@ def _spec_calls(module: Module) -> List[ast.Call]:
     return out
 
 
-def _spec_axis_literals(call: ast.Call) -> List[Tuple[str, ast.AST]]:
+def _param_default(module: Module, call: ast.Call,
+                   name: str) -> Optional[str]:
+    """String DEFAULT of parameter `name` in the function enclosing
+    `call` (`axis_name: str = "sp"`) — the axis that P() use binds
+    unless a caller overrides it."""
+    scope = module.enclosing_function(call)
+    if scope is None:
+        return None
+    pos = scope.args.args
+    for param, default in zip(pos[len(pos) - len(scope.args.defaults):],
+                              scope.args.defaults):
+        if param.arg == name:
+            return str_const(default)
+    for param, default in zip(scope.args.kwonlyargs,
+                              scope.args.kw_defaults):
+        if param.arg == name and default is not None:
+            return str_const(default)
+    return None
+
+
+def _spec_axis_literals(module: Module,
+                        call: ast.Call) -> List[Tuple[str, ast.AST]]:
     out = []
+
+    def visit(e: ast.AST) -> None:
+        s = str_const(e)
+        if s is None and isinstance(e, ast.Name):
+            s = _param_default(module, call, e.id)
+        if s is not None:
+            out.append((s, e))
+
     for arg in call.args:
         if isinstance(arg, (ast.Tuple, ast.List)):
             for e in arg.elts:
-                s = str_const(e)
-                if s is not None:
-                    out.append((s, e))
+                visit(e)
         else:
-            s = str_const(arg)
-            if s is not None:
-                out.append((s, arg))
+            visit(arg)
     return out
 
 
@@ -248,11 +321,13 @@ def _sharded_operand(node: ast.AST) -> bool:
 
 def _check_host_transfers(module: Module,
                           findings: List[Finding]) -> None:
-    if not _executor_scope(module.rel):
+    rel = module.rel.replace("\\", "/")
+    hot_module = rel in _HOT_MODULES
+    if not (_executor_scope(module.rel) or hot_module):
         return
     hot = [n for n in module.nodes
            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
-           and _HOT_NAME.match(n.name)]
+           and (hot_module or _HOT_NAME.match(n.name))]
     for fn in hot:
         for call in iter_calls(fn):
             if isinstance(call.func, ast.Attribute) and \
@@ -284,7 +359,7 @@ def run(ctx) -> List[Finding]:
     for module in ctx.modules:
         if have_mesh:
             for call in _spec_calls(module):
-                for axis, node in _spec_axis_literals(call):
+                for axis, node in _spec_axis_literals(module, call):
                     if axis not in axes:
                         findings.append(module.finding(
                             "SHARD001", node,
@@ -300,8 +375,10 @@ def run(ctx) -> List[Finding]:
 
 #: (rule, one-line contract, example) — rendered by `--rules-md`.
 RULES = (
-    ("SHARD001", "literal PartitionSpec axis that no declared mesh "
-     "provides",
+    ("SHARD001", "literal PartitionSpec axis (incl. `axis_name=\"sp\"`"
+     "-style parameter defaults) that no declared mesh provides — "
+     "declarations resolve through named constants like "
+     "`ParallelConfig.MESH_AXES`",
      '`P("model")` against `Mesh(..., ("dp", "pp", "sp", "tp"))`'),
     ("SHARD002", "NamedSharding spec with more axes than the "
      "operand\'s statically-known rank",
@@ -311,6 +388,7 @@ RULES = (
      "`from jax.experimental.shard_map import shard_map`"),
     ("SHARD004", "host transfer (`.item()`/`np.asarray`/`device_get`) "
      "of a mesh-sharded array (KV planes, params) in an "
-     "executor-scope hot-path function",
+     "executor-scope hot-path function or anywhere in the hot "
+     "spec-building modules (lora/layers.py, ops/ring_attention.py)",
      "`np.asarray(kv_caches[0])` in `execute_model`"),
 )
