@@ -14,7 +14,7 @@ LoRAConfig). TPU-first differences:
 from __future__ import annotations
 
 import os
-from typing import Optional, Union
+from typing import Optional, Tuple, Union
 
 from aphrodite_tpu.common.logger import init_logger
 from aphrodite_tpu.transformers_utils.config import get_config
@@ -134,6 +134,18 @@ class ModelConfig:
             raise ValueError(
                 f"Total number of hidden layers ({total_num_hidden_layers}) "
                 f"must be divisible by pipeline parallel size ({pp}).")
+        if parallel_config.disagg_split is not None:
+            # Each disagg group is its own tp submesh: every tp-sharded
+            # weight dim must divide BOTH group sizes (jax rejects an
+            # uneven NamedSharding at device_put time, so fail here
+            # with the real constraint instead of mid-load).
+            for group, n in zip(("prefill", "decode"),
+                                parallel_config.disagg_split):
+                if total_num_attention_heads % n != 0:
+                    raise ValueError(
+                        f"Total number of attention heads "
+                        f"({total_num_attention_heads}) must be divisible "
+                        f"by the disagg {group} group size ({n}).")
 
     def get_sliding_window(self) -> Optional[int]:
         return getattr(self.hf_config, "sliding_window", None)
@@ -270,12 +282,21 @@ class ParallelConfig:
         disable_custom_all_reduce: bool = False,
         sequence_parallel_size: int = 1,
         sp_prefill_threshold: int = 1024,
+        disagg_split: Optional[Tuple[int, int]] = None,
     ) -> None:
         self.pipeline_parallel_size = pipeline_parallel_size
         self.tensor_parallel_size = tensor_parallel_size
         self.data_parallel_size = data_parallel_size
         self.max_parallel_loading_workers = max_parallel_loading_workers
         self.disable_custom_all_reduce = disable_custom_all_reduce
+        # Disaggregated prefill/decode (TPLA, arxiv 2508.15881): split
+        # the tp chips into a (prefill, decode) group pair — e.g.
+        # (2, 6) of 8 — each its own submesh. Prefill-phase programs
+        # compile against the prefill submesh, decode/burst/spec-verify
+        # against the decode submesh, and finished prefills hand their
+        # KV pages off over ICI (CacheEngine.kv_handoff). None =
+        # colocated (the classic single mesh).
+        self.disagg_split = tuple(disagg_split) if disagg_split else None
         # Sequence/context parallelism: prompts whose (padded) length is
         # >= sp_prefill_threshold run prefill attention as a ring over
         # the sp mesh axis (ops/ring_attention.py) — K/V shards rotate
@@ -299,6 +320,32 @@ class ParallelConfig:
         return (self.data_parallel_size, self.pipeline_parallel_size,
                 self.sequence_parallel_size, self.tensor_parallel_size)
 
+    @property
+    def disagg(self) -> bool:
+        """Whether the engine serves disaggregated (split submeshes)."""
+        return self.disagg_split is not None
+
+    def group_mesh_shape(self, group: str) -> tuple:
+        """(dp, pp, sp, tp) of one disagg submesh ("prefill" or
+        "decode") — same axis names as the colocated mesh so every
+        PartitionSpec in the tree resolves unchanged on either group."""
+        assert self.disagg_split is not None
+        n = self.disagg_split[0 if group == "prefill" else 1]
+        return (1, 1, 1, n)
+
+    @staticmethod
+    def parse_disagg_split(spec: Optional[str]
+                           ) -> Optional[Tuple[int, int]]:
+        """Parse a "2,6"-style split spec (CLI / APHRODITE_DISAGG);
+        empty or None disables the split."""
+        if not spec:
+            return None
+        parts = [p.strip() for p in str(spec).split(",")]
+        if len(parts) != 2:
+            raise ValueError(
+                f"disagg split must be 'n_prefill,n_decode', got {spec!r}")
+        return (int(parts[0]), int(parts[1]))
+
     def _verify_args(self) -> None:
         for name, value in (
             ("pipeline_parallel_size", self.pipeline_parallel_size),
@@ -314,6 +361,24 @@ class ParallelConfig:
                 "uses the pp mesh axis, so pp>1 would allocate chips that do "
                 "no work. Shard with tensor_parallel_size and/or "
                 "sequence_parallel_size instead.")
+        if self.disagg_split is not None:
+            n_p, n_d = self.disagg_split
+            if n_p < 1 or n_d < 1:
+                raise ValueError(
+                    f"disagg_split groups must be >= 1 chip each, got "
+                    f"{self.disagg_split}.")
+            if n_p + n_d != self.tensor_parallel_size:
+                raise ValueError(
+                    f"disagg_split {self.disagg_split} must sum to "
+                    f"tensor_parallel_size ({self.tensor_parallel_size}): "
+                    "the split partitions the tp chips into a prefill "
+                    "group and a decode group.")
+            if (self.data_parallel_size, self.pipeline_parallel_size,
+                    self.sequence_parallel_size) != (1, 1, 1):
+                raise NotImplementedError(
+                    "disagg_split composes with tensor parallelism only "
+                    "(dp = pp = sp = 1): each group is a pure-tp "
+                    "submesh.")
 
 
 class SchedulerConfig:

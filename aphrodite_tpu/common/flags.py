@@ -475,6 +475,19 @@ _register(Flag(
     minimum=1))
 
 _register(Flag(
+    "APHRODITE_DISAGG", "str", "",
+    "Disaggregated prefill/decode split as 'n_prefill,n_decode' chips "
+    "(e.g. '2,6' of tp=8): prefill-phase programs run on the prefill "
+    "submesh, decode/burst/spec-verify on the decode submesh, and "
+    "finished prefills hand their KV pages off over ICI. Unset = "
+    "colocated. The --disagg-split engine arg takes precedence."))
+
+_register(Flag(
+    "APHRODITE_DISAGG_TIMING", "bool", False,
+    "Print per-flush KV handoff lines (pages, bytes, transfer+sync "
+    "time) from the disagg executor hot path (profiling aid)."))
+
+_register(Flag(
     "APHRODITE_SPEC", "bool", True,
     "Self-drafting speculative decoding (n-gram/prompt-lookup "
     "drafter + multi-token verify on the decode path); 0 pins the "

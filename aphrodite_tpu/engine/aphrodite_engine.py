@@ -141,7 +141,8 @@ class AphroditeEngine:
                                     parallel_config, scheduler_config,
                                     device_config, lora_config)
         self.scheduler = Scheduler(scheduler_config, cache_config,
-                                   lora_config)
+                                   lora_config,
+                                   disagg=parallel_config.disagg)
         # Self-drafting speculative decoding: host-side prompt-lookup
         # drafter feeding the widened verify dispatch (_spec_round).
         # Advisory per-seq acceptance state only — it survives
@@ -551,7 +552,8 @@ class AphroditeEngine:
                                     self.scheduler_config,
                                     self.device_config, self.lora_config)
         self.scheduler = Scheduler(self.scheduler_config,
-                                   self.cache_config, self.lora_config)
+                                   self.cache_config, self.lora_config,
+                                   disagg=self.parallel_config.disagg)
         for group in restorable:
             if group.prefix is not None:
                 group.prefix = self.scheduler.prefix_pool.intern(
@@ -605,6 +607,7 @@ class AphroditeEngine:
                     scheduler_outputs.blocks_to_swap_out,
                     scheduler_outputs.blocks_to_copy,
                     num_steps=burst, extra_cap=extra_cap)
+            self._flush_kv_handoff(prompt_mds)
             return self._process_round(prompt_output, decode_outputs,
                                        scheduler_outputs)
 
@@ -632,8 +635,29 @@ class AphroditeEngine:
             scheduler_outputs.blocks_to_swap_out,
             scheduler_outputs.blocks_to_copy)
         if prompt_mds:
+            self._flush_kv_handoff(prompt_mds)
             return self._process_round(output, [], scheduler_outputs)
         return self._process_round(None, [output], scheduler_outputs)
+
+    def _flush_kv_handoff(self, prompt_mds) -> None:
+        """Disagg only: push the pages of every group whose FINAL
+        prompt chunk ran this round from the prefill pool to the decode
+        pool, batched into one executor.kv_handoff flush. Timing is the
+        invariant: the group enters decode no earlier than the NEXT
+        round, and its pages are still owned here (a free can only
+        follow _process_round), so the decode pool always sees the full
+        prefix before the first decode step reads it. Non-final chunks
+        stay prefill-local — their KV is only ever read by later chunks
+        on the same submesh."""
+        if not self.executor.disagg:
+            return
+        pages = set()
+        for md in prompt_mds:
+            if md.is_prompt and md.is_final_chunk:
+                for table in md.block_tables.values():
+                    pages.update(table)
+        if pages:
+            self.executor.kv_handoff(sorted(pages))
 
     @staticmethod
     def _prompt_fast_path_ok(prompt_mds) -> bool:
@@ -672,6 +696,7 @@ class AphroditeEngine:
         self._check_epoch()
         rounds = [scheduler_outputs]
         handles = [handle]
+        all_prompt_mds = list(prompt_mds)
         while len(handles) < 4:
             nxt = self.scheduler.schedule_prompt_only()
             if nxt is None:
@@ -690,6 +715,7 @@ class AphroditeEngine:
             # an ineligible round must still EXECUTE — synced — not be
             # dropped: its KV writes and sampled tokens are owed.
             self._inflight_rounds.append(outputs2)
+            all_prompt_mds.extend(mds2)
             h2 = None
             if self._prompt_fast_path_ok(mds2):
                 h2 = self.executor.dispatch_prompt_round(
@@ -708,6 +734,12 @@ class AphroditeEngine:
                 handles.append(out2)        # already finalized
                 break
             handles.append(h2)
+        # Disagg: hand off every final-chunk group of the batch-built
+        # rounds BEFORE the finalize sync — the handoff gather chains
+        # on the in-flight prompt programs' donated pool handles (JAX
+        # data dependency), so the ICI transfer rides inside the one
+        # sync we were paying anyway.
+        self._flush_kv_handoff(all_prompt_mds)
         pending = [h for h in handles if hasattr(h, "packed")]
         finalized = iter(self.executor.finalize_prompt_rounds(pending))
         request_outputs = []

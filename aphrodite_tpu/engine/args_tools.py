@@ -40,6 +40,9 @@ class EngineArgs:
     data_parallel_size: int = 1
     sequence_parallel_size: int = 1
     sp_prefill_threshold: int = 1024
+    # Disaggregated prefill/decode split "n_prefill,n_decode" (e.g.
+    # "2,6" of tp=8); None falls back to APHRODITE_DISAGG, "" colocates.
+    disagg_split: Optional[str] = None
     max_parallel_loading_workers: Optional[int] = None
     block_size: int = 16
     swap_space: float = 4          # GiB
@@ -107,6 +110,11 @@ class EngineArgs:
                             default=1024,
                             help="route prefill through ring attention "
                                  "at/above this padded prompt length")
+        parser.add_argument("--disagg-split", type=str, default=None,
+                            help="disaggregated prefill/decode chip "
+                                 "split 'n_prefill,n_decode' (e.g. "
+                                 "'2,6' of tp=8); unset falls back to "
+                                 "APHRODITE_DISAGG, '' colocates")
         parser.add_argument("--max-parallel-loading-workers", type=int,
                             default=None)
         parser.add_argument("--block-size", type=int, default=16,
@@ -164,13 +172,20 @@ class EngineArgs:
         cache_config = CacheConfig(
             self.block_size, self.gpu_memory_utilization, self.swap_space,
             self.kv_cache_dtype, model_config.get_sliding_window())
+        # --disagg-split wins; None defers to the APHRODITE_DISAGG
+        # flag (registry-validated read), "" explicitly colocates.
+        disagg_spec = self.disagg_split
+        if disagg_spec is None:
+            from aphrodite_tpu.common import flags
+            disagg_spec = flags.get_str("APHRODITE_DISAGG")
         parallel_config = ParallelConfig(
             self.pipeline_parallel_size, self.tensor_parallel_size,
             self.data_parallel_size, self.worker_use_ray,
             self.max_parallel_loading_workers,
             self.disable_custom_all_reduce,
             sequence_parallel_size=self.sequence_parallel_size,
-            sp_prefill_threshold=self.sp_prefill_threshold)
+            sp_prefill_threshold=self.sp_prefill_threshold,
+            disagg_split=ParallelConfig.parse_disagg_split(disagg_spec))
         scheduler_config = SchedulerConfig(
             self.max_num_batched_tokens, self.max_num_seqs,
             model_config.max_model_len, self.max_paddings,

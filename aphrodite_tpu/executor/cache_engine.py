@@ -72,10 +72,22 @@ class CacheEngine:
         model_config: ModelConfig,
         parallel_config: ParallelConfig,
         mesh: Optional[Mesh] = None,
+        prefill_mesh: Optional[Mesh] = None,
     ) -> None:
         self.cache_config = cache_config
         self.model_config = model_config
+        # Disaggregated serving: `mesh` is the DECODE group's submesh
+        # (the pool the scheduler's block tables, swaps, and CoW copies
+        # live on) and `prefill_mesh` the prefill group's. The two
+        # pools mirror ONE logical page-id space — the block manager
+        # stays the single allocator, so the ownership ledger and the
+        # free seams are unchanged by construction — and kv_handoff()
+        # reshards exactly the pages a finished prefill wrote from the
+        # prefill pool into the decode pool (a batched cross-submesh
+        # device_put over ICI). Colocated engines pass prefill_mesh =
+        # None and get the classic single pool.
         self.mesh = mesh
+        self.prefill_mesh = prefill_mesh
 
         self.page_size = cache_config.block_size
         self.num_device_pages = cache_config.num_gpu_blocks
@@ -108,6 +120,21 @@ class CacheEngine:
                 "APHRODITE_KV_SCALE", default=DEFAULT_KV_SCALE)
 
         self.kv_caches: List[KVCache] = self._allocate_device()
+        # Prefill-group pool: same page count as the decode pool so the
+        # two mirror one logical page space — a handed-off page keeps
+        # its id, only its physical residency changes. None when
+        # colocated.
+        self.prefill_kv_caches: Optional[List[KVCache]] = None
+        if self.prefill_mesh is not None:
+            self.prefill_kv_caches = self._allocate_prefill_pool()
+        # Handoff accounting (read by benchmarks / DISAGG capture):
+        # totals survive for the engine lifetime, last_* cover the most
+        # recent flush.
+        self.handoff_pages_total = 0
+        self.handoff_bytes_total = 0
+        self.handoff_flushes = 0
+        self.last_handoff_pages = 0
+        self.last_handoff_bytes = 0
         # Host swap pool: per layer [2, pages, page, heads_i*dim] numpy
         # — token-major like the device pages, indexed by page on axis 1
         # (list because DeciLM-style models vary heads per layer).
@@ -142,6 +169,79 @@ class CacheEngine:
 
         return [(alloc(heads), alloc(heads))
                 for heads in self.kv_heads_per_layer]
+
+    def _allocate_prefill_pool(self) -> List[KVCache]:
+        """Prefill-group mirror of the device pool.
+
+        Same shapes and page ids as `kv_caches`, placed on the prefill
+        submesh with the same `kv_partition_spec` head partition — the
+        one-truth spec keeps both pools' lane layout identical, which
+        is what lets kv_handoff resolve the cross-submesh device_put as
+        a pure ICI reshard (no host bounce, no gather reshuffle)."""
+        assert self.prefill_mesh is not None
+
+        def alloc(num_heads: int):
+            shape = (self.num_device_pages, self.page_size,
+                     num_heads * self.head_size)
+            z = jnp.zeros(shape, dtype=self.dtype)
+            return jax.device_put(z, NamedSharding(
+                self.prefill_mesh,
+                kv_partition_spec(num_heads, self.prefill_mesh)))
+
+        return [(alloc(heads), alloc(heads))
+                for heads in self.kv_heads_per_layer]
+
+    # -- disaggregated handoff --
+
+    def handoff_page_bytes(self) -> int:
+        """Bytes moved over ICI per handed-off page (K+V, all layers)
+        — the static price MESHPLAN's handoff domain uses, kept here so
+        the ledger and the live path share one formula."""
+        elt = np.dtype(self.dtype).itemsize
+        per_token = sum(self.kv_heads_per_layer) * self.head_size * elt
+        return 2 * self.page_size * per_token
+
+    def kv_handoff(self, pages: List[int]) -> int:
+        """Reshard `pages` from the prefill pool into the decode pool.
+
+        Page-granular and batched: one gather per layer-side on the
+        prefill submesh, one cross-submesh `device_put` onto the decode
+        pool's `kv_partition_spec` sharding (the ICI transfer), one
+        scatter into the decode pool at the SAME page ids. The copy is
+        idempotent — shared prefix pages may be handed off again by a
+        later fork and land bit-identically — and never touches pages
+        outside `pages`, so the block manager's ownership ledger and
+        free seams stay exact on both pools by construction.
+
+        Returns bytes transferred (0 when colocated or no pages)."""
+        if self.prefill_kv_caches is None or not pages:
+            return 0
+        idx = jnp.asarray(sorted(set(pages)), dtype=jnp.int32)
+        n = int(idx.shape[0])
+        new_caches: List[KVCache] = []
+        for layer, (pk, pv) in enumerate(self.prefill_kv_caches):
+            dk, dv = self.kv_caches[layer]
+            heads = self.kv_heads_per_layer[layer]
+            spec = kv_partition_spec(heads, self.mesh) \
+                if self.mesh is not None else None
+            planes = []
+            for src, dst in ((pk, dk), (pv, dv)):
+                slab = jnp.take(src, idx, axis=0)
+                if spec is not None:
+                    # Explicit target sharding: this device_put IS the
+                    # ICI hop between the submeshes.
+                    slab = jax.device_put(
+                        slab, NamedSharding(self.mesh, spec))
+                planes.append(dst.at[idx].set(slab))
+            new_caches.append((planes[0], planes[1]))
+        self.kv_caches = new_caches
+        moved = n * self.handoff_page_bytes()
+        self.handoff_pages_total += n
+        self.handoff_bytes_total += moved
+        self.handoff_flushes += 1
+        self.last_handoff_pages = n
+        self.last_handoff_bytes = moved
+        return moved
 
     def kv_shardings(self) -> Optional[List[NamedSharding]]:
         """Per-layer NamedSharding of the KV planes (None off-mesh) —

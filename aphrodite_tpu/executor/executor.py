@@ -38,19 +38,37 @@ _FALLBACK_CACHE_BYTES = 256 << 20
 
 
 def build_mesh(parallel_config: ParallelConfig,
-               device_config: DeviceConfig):
+               device_config: DeviceConfig,
+               group: Optional[str] = None):
     """Construct the (dp, pp, sp, tp) mesh, or None for one device.
 
     sp (sequence parallel) sits next to tp on the fast axis ordering so
-    ring-attention ppermute hops ride ICI neighbours."""
-    if parallel_config.world_size == 1:
-        return None
+    ring-attention ppermute hops ride ICI neighbours.
+
+    `group` ("prefill" | "decode") builds one of the disaggregated
+    submeshes instead: prefill over devices[0:n_p], decode over
+    devices[n_p:world] — contiguous device ranges so each group's
+    all-reduces stay on neighbour ICI links and the inter-group handoff
+    crosses exactly the one seam between them. Both submeshes carry all
+    four axis names so every jitted program's PartitionSpecs resolve
+    unchanged against either group."""
     from jax.sharding import Mesh
     devices = jax.devices()
     if len(devices) < parallel_config.world_size:
         raise ValueError(
             f"world_size {parallel_config.world_size} exceeds available "
             f"devices ({len(devices)}).")
+    if group is not None:
+        assert parallel_config.disagg_split is not None
+        n_p, _ = parallel_config.disagg_split
+        world = parallel_config.world_size
+        sel = devices[:n_p] if group == "prefill" else devices[n_p:world]
+        return Mesh(
+            np.asarray(sel).reshape(
+                parallel_config.group_mesh_shape(group)),
+            ParallelConfig.MESH_AXES)
+    if parallel_config.world_size == 1:
+        return None
     mesh_devices = np.asarray(
         devices[:parallel_config.world_size]).reshape(
             parallel_config.mesh_shape)
@@ -75,21 +93,49 @@ class TPUExecutor:
         self.scheduler_config = scheduler_config
         self.lora_config = lora_config
 
-        self.mesh = build_mesh(parallel_config, device_config)
-        if self.mesh is not None:
+        # Disaggregated serving (TPLA, arxiv 2508.15881): the DECODE
+        # group's submesh becomes the primary `self.mesh` (block tables,
+        # swaps, sampling state — everything long-lived lives there) and
+        # the prefill group gets its own submesh, a resharded copy of
+        # the params, and its own runner. Colocated engines keep the
+        # classic single full mesh and `prefill_runner is model_runner`.
+        self.prefill_mesh = None
+        if parallel_config.disagg:
+            if lora_config is not None:
+                raise NotImplementedError(
+                    "disagg_split + LoRA is not supported: adapter "
+                    "slots would need mirroring across both groups")
+            self.mesh = build_mesh(parallel_config, device_config,
+                                   group="decode")
+            self.prefill_mesh = build_mesh(parallel_config, device_config,
+                                           group="prefill")
             logger.info(
-                "SPMD mesh %s over %d %s devices: weights "
-                "column/row-sharded on tp, KV pages lane(=head)-"
-                "sharded, batch inputs replicated",
-                dict(self.mesh.shape), self.mesh.size,
+                "Disaggregated mesh: prefill group %s, decode group %s "
+                "(%d+%d of %d %s devices); KV handoff over the group "
+                "seam", dict(self.prefill_mesh.shape),
+                dict(self.mesh.shape), self.prefill_mesh.size,
+                self.mesh.size, parallel_config.world_size,
                 jax.devices()[0].platform)
+        else:
+            self.mesh = build_mesh(parallel_config, device_config)
+            if self.mesh is not None:
+                logger.info(
+                    "SPMD mesh %s over %d %s devices: weights "
+                    "column/row-sharded on tp, KV pages lane(=head)-"
+                    "sharded, batch inputs replicated",
+                    dict(self.mesh.shape), self.mesh.size,
+                    jax.devices()[0].platform)
         logger.info("Loading model %s ...", model_config.model)
         self.model, self.params = get_model(model_config, self.mesh,
                                             lora_config)
+        self.prefill_params = None
+        if self.prefill_mesh is not None:
+            self.prefill_params = self._stage_prefill_params()
 
         self._profile_and_size_cache()
         self.cache_engine = CacheEngine(cache_config, model_config,
-                                        parallel_config, self.mesh)
+                                        parallel_config, self.mesh,
+                                        prefill_mesh=self.prefill_mesh)
         sp = None
         if self.mesh is not None and \
                 parallel_config.sequence_parallel_size > 1:
@@ -102,6 +148,17 @@ class TPUExecutor:
             kv_scale=self.cache_engine.kv_scale,
             sp=sp,
             kv_cache_dtype=self.cache_engine.dtype)
+        self.prefill_runner = self.model_runner
+        if self.prefill_mesh is not None:
+            self.prefill_runner = ModelRunner(
+                self.model, self.prefill_params, model_config,
+                scheduler_config,
+                page_size=cache_config.block_size,
+                num_slots=self.cache_engine.num_slots,
+                mesh=self.prefill_mesh,
+                kv_scale=self.cache_engine.kv_scale,
+                sp=None,
+                kv_cache_dtype=self.cache_engine.dtype)
 
         self.lora_manager = None
         if lora_config is not None:
@@ -116,11 +173,74 @@ class TPUExecutor:
     @property
     def mesh_shape(self) -> Optional[Tuple[int, int, int, int]]:
         """(dp, pp, sp, tp) of the live mesh, None single-device —
-        recorded by the bench harnesses next to every number."""
+        recorded by the bench harnesses next to every number. Under
+        disagg this is the DECODE group's shape; the split itself is in
+        parallel_config.disagg_split."""
         if self.mesh is None:
             return None
         return tuple(int(self.mesh.shape[a])
                      for a in ("dp", "pp", "sp", "tp"))
+
+    @property
+    def disagg(self) -> bool:
+        return self.prefill_mesh is not None
+
+    def _stage_prefill_params(self):
+        """Prefill-group weights: leaf-wise reshard of the decode-mesh
+        params onto the prefill submesh — same PartitionSpecs,
+        different device group. The model is mesh-agnostic and
+        resolves sharding at trace time, so both runners share one
+        model object and this copy is the only extra weight
+        residency the split costs."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        def _to_prefill(leaf):
+            spec = getattr(leaf.sharding, "spec", P())
+            return jax.device_put(
+                leaf, NamedSharding(self.prefill_mesh, spec))
+
+        return jax.tree_util.tree_map(_to_prefill, self.params)
+
+    # Prompt-phase pool indirection: under disagg, prefill programs
+    # read/write the prefill group's pool; colocated they're the one
+    # shared pool. Decode/burst/spec paths always use kv_caches.
+    def _prompt_pool(self):
+        if self.disagg:
+            return self.cache_engine.prefill_kv_caches
+        return self.cache_engine.kv_caches
+
+    def _set_prompt_pool(self, kv) -> None:
+        if self.disagg:
+            self.cache_engine.prefill_kv_caches = kv
+        else:
+            self.cache_engine.kv_caches = kv
+
+    def kv_handoff(self, pages: List[int]) -> int:
+        """Flush one round's finished-prefill pages across the group
+        seam (no-op when colocated). Called by the engine with exactly
+        the block tables of groups whose FINAL prompt chunk ran this
+        round — the groups enter decode next round, so end-of-round is
+        always in time and the pages are still owned (no free/realloc
+        race)."""
+        if not self.disagg or not pages:
+            return 0
+        timing = flags.get_bool("APHRODITE_DISAGG_TIMING")
+        t0 = 0.0
+        if timing:
+            import time
+            t0 = time.perf_counter()
+        moved = self.cache_engine.kv_handoff(pages)
+        if timing:
+            jax.block_until_ready(
+                [plane for kv in self.cache_engine.kv_caches
+                 for plane in kv])
+            dt = (time.perf_counter() - t0) * 1e3
+            print(f"[kv-handoff pages="
+                  f"{self.cache_engine.last_handoff_pages} "
+                  f"bytes={moved}] transfer+sync {dt:.2f} ms",
+                  flush=True)
+        return moved
 
     # -- sizing --
 
@@ -247,6 +367,15 @@ class TPUExecutor:
     ) -> SamplerOutput:
         self._pre_step(seq_group_metadata_list, blocks_to_swap_in,
                        blocks_to_swap_out)
+        if self.disagg and seq_group_metadata_list and \
+                all(md.is_prompt for md in seq_group_metadata_list):
+            # Pure prompt round -> prefill group. (Mixed rounds go
+            # through execute_combined; decode rounds fall through.)
+            output, new_caches = self.prefill_runner.execute_model(
+                seq_group_metadata_list, self._prompt_pool(),
+                blocks_to_copy)
+            self._set_prompt_pool(new_caches)
+            return output
         output, new_caches = self.model_runner.execute_model(
             seq_group_metadata_list, self.cache_engine.kv_caches,
             blocks_to_copy)
@@ -300,18 +429,18 @@ class TPUExecutor:
         batch-building rounds chain on the donated KV handles, so the
         device runs them back-to-back while the host schedules ahead."""
         self._pre_step(prompt_metadata, {}, {})
-        kv = self.model_runner._apply_block_copies(
-            self.cache_engine.kv_caches, blocks_to_copy)
-        handle, kv = self.model_runner.dispatch_prompt(
+        kv = self.prefill_runner._apply_block_copies(
+            self._prompt_pool(), blocks_to_copy)
+        handle, kv = self.prefill_runner.dispatch_prompt(
             prompt_metadata, kv)
-        self.cache_engine.kv_caches = kv
+        self._set_prompt_pool(kv)
         return handle
 
     def finalize_prompt_rounds(self, handles):
         """One transfer for every pending round's packed results."""
         pulled = jax.device_get([h.packed for h in handles])
         return [
-            self.model_runner.finalize_step(h, np.asarray(p))
+            self.prefill_runner.finalize_step(h, np.asarray(p))
             for h, p in zip(handles, pulled)
         ]
 
@@ -335,6 +464,10 @@ class TPUExecutor:
         decode) fall back to two synced steps within the round."""
         self._pre_step(prompt_metadata + decode_metadata,
                        blocks_to_swap_in, blocks_to_swap_out)
+        if self.disagg:
+            return self._execute_combined_disagg(
+                prompt_metadata, decode_metadata, blocks_to_copy,
+                num_steps, extra_cap)
         kv = self.model_runner._apply_block_copies(
             self.cache_engine.kv_caches, blocks_to_copy)
 
@@ -373,4 +506,65 @@ class TPUExecutor:
             out, kv = self.model_runner.execute_model(decode_metadata, kv)
             decode_outs = [out]
         self.cache_engine.kv_caches = kv
+        return prompt_out, decode_outs
+
+    def _execute_combined_disagg(
+        self,
+        prompt_metadata: List[SequenceGroupMetadata],
+        decode_metadata: List[SequenceGroupMetadata],
+        blocks_to_copy: Dict[int, List[int]],
+        num_steps: int,
+        extra_cap=None,
+    ) -> Tuple[SamplerOutput, List[SamplerOutput]]:
+        """Combined round on the split mesh: the prefill program runs on
+        the prefill submesh and the decode burst on the decode submesh
+        with NO data dependency between them (separate pools), so the
+        two groups genuinely overlap and one host sync collects both.
+        This is the interference fix the split buys — a long prefill
+        costs the decode arm nothing but the later page handoff.
+        Round-level CoW copies are applied to BOTH pools (same page
+        ids, idempotent) so the mirrors stay coherent regardless of
+        which phase forked."""
+        pkv = self.prefill_runner._apply_block_copies(
+            self.cache_engine.prefill_kv_caches, blocks_to_copy)
+        dkv = self.model_runner._apply_block_copies(
+            self.cache_engine.kv_caches, blocks_to_copy)
+
+        handle = None
+        if num_steps > 1:
+            handle, pkv = self.prefill_runner.dispatch_prompt(
+                prompt_metadata, pkv)
+        if handle is not None:
+            import time
+            timing = flags.get_bool("APHRODITE_BURST_TIMING")
+            t0 = time.perf_counter() if timing else 0.0
+            bhandle, dkv = self.model_runner.dispatch_burst(
+                decode_metadata, dkv, num_steps, extra_cap)
+            self.cache_engine.prefill_kv_caches = pkv
+            self.cache_engine.kv_caches = dkv
+            p_np, b_np = jax.device_get((handle.packed, bhandle.packed))
+            t1 = time.perf_counter() if timing else 0.0
+            prompt_out = self.prefill_runner.finalize_step(
+                handle, np.asarray(p_np))
+            decode_outs = self.model_runner.finalize_burst(
+                bhandle, np.asarray(b_np))
+            if timing:
+                print(f"[disagg-combined prompts={len(prompt_metadata)} "
+                      f"burst={num_steps}x{len(decode_metadata)}] "
+                      f"overlapped device+sync {(t1 - t0) * 1e3:.0f} ms",
+                      flush=True)
+            return prompt_out, decode_outs
+
+        # Sequential fallback — still pool-separated, two syncs.
+        prompt_out, pkv = self.prefill_runner.execute_model(
+            prompt_metadata, pkv)
+        self.cache_engine.prefill_kv_caches = pkv
+        if num_steps > 1:
+            decode_outs, dkv = self.model_runner.execute_decode_burst(
+                decode_metadata, dkv, num_steps, extra_cap=extra_cap)
+        else:
+            out, dkv = self.model_runner.execute_model(
+                decode_metadata, dkv)
+            decode_outs = [out]
+        self.cache_engine.kv_caches = dkv
         return prompt_out, decode_outs

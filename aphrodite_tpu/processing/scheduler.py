@@ -119,10 +119,19 @@ class Scheduler:
         scheduler_config: SchedulerConfig,
         cache_config: CacheConfig,
         lora_config: Optional[LoRAConfig] = None,
+        disagg: bool = False,
     ) -> None:
         self.scheduler_config = scheduler_config
         self.cache_config = cache_config
         self.lora_config = lora_config
+        # Disaggregated prefill/decode: prompt chunks run on a chip
+        # group the decode batch never touches, so the chunk throttle —
+        # which exists only to keep a co-located prefill from stalling
+        # the decode stream — is lifted and mixed rounds run prefill at
+        # the FULL budget. Phase routing itself needs no new scheduler
+        # state: the round is already emitted as prompt chunks + decode
+        # groups, and the executor maps each half to its submesh.
+        self.disagg = disagg
 
         self.prompt_limit = min(scheduler_config.max_model_len,
                                 scheduler_config.max_num_batched_tokens)
@@ -619,8 +628,8 @@ class Scheduler:
         ignored: List[SequenceGroup] = []
         seq_lens: List[int] = []
         full = self.scheduler_config.max_num_batched_tokens
-        budget = (self.scheduler_config.max_chunk_tokens if decode_groups
-                  else full)
+        budget = (self.scheduler_config.max_chunk_tokens
+                  if decode_groups and not self.disagg else full)
         if decode_groups and 0 < budget < full and \
                 not self.prefilling and \
                 not self._waiting_backlog_at_least(full + 1):

@@ -242,6 +242,28 @@ def main() -> None:
         print(f"repriced with the ledger count: device floor "
               f"{floor_ms:.2f} ms/step -> {proj:,.0f} tok/s, x0.79 "
               f"engine efficiency {proj * 0.79:,.0f} tok/s")
+        # --- disagg arm: the handoff domain priced by the same ledger.
+        # A finished prefill's pages cross the prefill->decode group
+        # seam as ONE batched point-to-point device_put (no ring), so
+        # the price is bytes/ici_gbps — printed next to the r05 model
+        # so the per-step all-reduce cost and the per-prompt handoff
+        # cost share a frame of reference.
+        n_p, n_d = geo["disagg_split"]
+        print(f"disagg ({n_p},{n_d}) handoff domain: "
+              f"{geo['handoff_page_mb']} MB/page "
+              f"({geo['handoff_page_ici_us']} us over ICI); "
+              f"{geo['handoff_prompt_tokens']}-token prefill = "
+              f"{geo['handoff_prompt_pages']} pages, "
+              f"{geo['handoff_prompt_mb']:,.0f} MB -> "
+              f"{geo['handoff_prompt_ici_ms']} ms/prompt "
+              f"point-to-point @ {geo['ici_gbps']:.0f} GB/s")
+        amort = (geo["handoff_prompt_ici_ms"]
+                 / (geo["handoff_prompt_tokens"] / geo["batch"]))
+        print(f"disagg handoff vs r05 step budget: amortized "
+              f"{amort:.3f} ms per decode-step-equivalent at "
+              f"bs={geo['batch']} vs {geo['all_reduce_ici_ms']} ms "
+              f"all-reduce/step — handoff rides the seam, not the "
+              f"decode critical path")
         if only == {"mesh"}:
             return
 

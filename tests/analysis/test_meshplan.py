@@ -166,9 +166,11 @@ def test_ledger_domain_map_and_kv_handoff():
     assert domains[f"{runner}._params_with_lora"] == "shared"
     handoff = baseline["kv_handoff"]
     assert handoff["partition_spec"] == "kv_partition_spec"
+    cache = "aphrodite_tpu/executor/cache_engine.py::CacheEngine"
     assert handoff["commit_sites"] == [
-        "aphrodite_tpu/executor/cache_engine.py::"
-        "CacheEngine._allocate_device"]
+        f"{cache}._allocate_device",
+        f"{cache}._allocate_prefill_pool",
+        f"{cache}.kv_handoff"]
     assert handoff["commit_sites"] == \
         [q for q, d in domains.items() if d == "shared_kv"]
 

@@ -300,6 +300,66 @@ def test_seeded_partial_request_restores_bit_equal(tiny_model_dir):
         assert len(cur) > len(prev)
 
 
+def test_disagg_reincarnation_rebuilds_split_bit_equal(tiny_model_dir):
+    """Reincarnation THROUGH the disaggregated path: a (2,2)-split
+    engine killed mid-generation rebuilds BOTH submeshes, both KV
+    pools, and the disagg-aware scheduler; the restored request's KV
+    re-prefills on the NEW prefill group, hands off across the new
+    seam, and the joint output is bit-equal to the fault-free split
+    run — with the shared ownership ledger back at free0."""
+    sp = SamplingParams(temperature=1.0, seed=31337, max_tokens=12,
+                        ignore_eos=True)
+    kw = dict(tensor_parallel_size=4, disagg_split="2,2")
+
+    def run(kill_at_output_len):
+        engine = _sync_engine(tiny_model_dir, **kw)
+        assert engine.executor.disagg
+        free0 = engine.scheduler.block_manager.get_num_free_gpu_blocks()
+        engine.add_request("seeded", None, sp,
+                           prompt_token_ids=_prompt(0))
+        killed = False
+        final = None
+        while engine.has_unfinished_requests():
+            if not killed and kill_at_output_len is not None:
+                groups = list(engine.scheduler.running)
+                if groups and groups[0].get_seqs()[0].get_output_len() \
+                        >= kill_at_output_len:
+                    flushes_before = \
+                        engine.executor.cache_engine.handoff_flushes
+                    assert flushes_before > 0, \
+                        "no handoff before the kill"
+                    outcome = engine.reincarnate()
+                    assert outcome.restored == 1
+                    assert outcome.lost == []
+                    # The rebuilt executor is a fresh split: both
+                    # submeshes present, pools zeroed, counters reset,
+                    # scheduler still chunk-throttle-free.
+                    assert engine.executor.disagg
+                    assert engine.executor.prefill_mesh.size == 2
+                    assert engine.executor.mesh.size == 2
+                    assert engine.executor.cache_engine \
+                        .handoff_flushes == 0
+                    assert engine.scheduler.disagg
+                    killed = True
+                    continue
+            for out in engine.step():
+                if out.finished:
+                    final = out
+        assert killed == (kill_at_output_len is not None)
+        if kill_at_output_len is not None:
+            # The restored request re-prefilled on the NEW prefill
+            # group and handed off across the new seam.
+            assert engine.executor.cache_engine.handoff_flushes > 0
+        assert engine.scheduler.block_manager \
+            .get_num_free_gpu_blocks() == free0
+        return final
+
+    clean = run(None)
+    faulty = run(4)
+    assert list(faulty.outputs[0].token_ids) == \
+        list(clean.outputs[0].token_ids)
+
+
 def test_async_restore_no_duplicate_chunks(tiny_model_dir,
                                            monkeypatch):
     """The stream-level half of the same invariant: a FATAL fault

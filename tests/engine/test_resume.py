@@ -236,6 +236,45 @@ def test_spec_resume_redrafts_from_joint_history(engine, monkeypatch):
     assert sum(accepted) > 0, "resumed stream never re-drafted a hit"
 
 
+@pytest.fixture(scope="module")
+def disagg_engine(tiny_model_dir):
+    """(2,2)-of-tp=4 split on the virtual mesh: the tiny model's 4 q
+    heads divide both groups, so resume rides the real handoff path."""
+    return _sync_engine(tiny_model_dir, tensor_parallel_size=4,
+                        disagg_split="2,2")
+
+
+def test_disagg_continuation_bit_equal_and_free0(disagg_engine):
+    """Mid-stream resume THROUGH the disagg seam: a continuation whose
+    original KV was handed off to the decode pool re-prefills its
+    joint history on the prefill group, hands off again, and the joint
+    output is bit-equal to the unbroken seeded run — with the shared
+    ownership ledger back at free0 (both pools mirror it by
+    construction)."""
+    eng = disagg_engine
+    ce = eng.executor.cache_engine
+    bm = eng.scheduler.block_manager
+    free0 = bm.get_num_free_gpu_blocks()
+    sp = SamplingParams(temperature=1.0, seed=4242, max_tokens=10,
+                        ignore_eos=True)
+    full = _full_run(eng, sp, "disagg-full")
+    ids = list(full.outputs[0].token_ids)
+    assert len(ids) == 10
+    assert ce.handoff_flushes > 0, "unbroken run never handed off"
+
+    for k in (1, 4, 9):
+        flushes0 = ce.handoff_flushes
+        eng.add_request(f"disagg-cont-{k}", None, sp,
+                        prompt_token_ids=list(PROMPT),
+                        emitted_token_ids=ids[:k])
+        out = _drain(eng)[f"disagg-cont-{k}"]
+        assert list(out.outputs[0].token_ids) == ids, f"split {k}"
+        assert out.resumed_tokens == k
+        assert ce.handoff_flushes > flushes0, \
+            f"continuation at split {k} never re-handed off its KV"
+    assert bm.get_num_free_gpu_blocks() == free0, "pool leak on resume"
+
+
 def test_continuation_detok_resumes_mid_word(engine):
     """resumed_text equals the incremental-detok text of the emitted
     prefix (what the original stream delivered), even when the split

@@ -85,6 +85,12 @@ _GEOMETRY = {
     "dtype_bytes": 2,
     "tp": 8,
     "ici_gbps": 180.0,
+    # Disagg handoff domain (prefill/decode split over ICI): KV page
+    # geometry and the reference split + prompt the per-step handoff
+    # price is quoted at. 7B is MHA, so kv_heads * head_dim == hidden.
+    "page_size": 16,
+    "disagg_split": [2, 6],
+    "handoff_prompt_tokens": 2048,
 }
 
 #: Commit-site domain classification, checked in order. shared_kv is
@@ -653,6 +659,15 @@ def _geometry(ref_counts: dict) -> dict:
     ag_payload = g["batch"] * g["vocab"] * g["dtype_bytes"]
     ici_ag = ag_payload * (tp - 1) / tp
     ici_gbps = g["ici_gbps"] * 1e9
+    # Handoff domain: one finished prefill's pages cross the single
+    # seam between the groups as a batched reshard — K+V planes of
+    # each page across all layers (the same formula
+    # CacheEngine.handoff_page_bytes computes live), moving point to
+    # point over ICI, not ring-reduced.
+    page_bytes = (2 * g["page_size"] * g["hidden"] *
+                  g["dtype_bytes"] * g["n_layers"])
+    prompt_pages = -(-g["handoff_prompt_tokens"] // g["page_size"])
+    prompt_bytes = prompt_pages * page_bytes
     return {
         **g,
         "all_reduce_count_per_step": n_ar,
@@ -662,6 +677,13 @@ def _geometry(ref_counts: dict) -> dict:
         "logits_all_gather_mb": round(ag_payload / 1e6, 2),
         "logits_all_gather_ici_ms": round(
             ici_ag / ici_gbps * 1e3, 3),
+        "handoff_page_mb": round(page_bytes / 1e6, 3),
+        "handoff_page_ici_us": round(
+            page_bytes / ici_gbps * 1e6, 2),
+        "handoff_prompt_pages": prompt_pages,
+        "handoff_prompt_mb": round(prompt_bytes / 1e6, 2),
+        "handoff_prompt_ici_ms": round(
+            prompt_bytes / ici_gbps * 1e3, 3),
     }
 
 
@@ -749,6 +771,14 @@ def render_report(ctx) -> str:
             f"{geo['all_reduce_ici_ms']} ms; logits all-gather seam "
             f"{geo['logits_all_gather_mb']} MB, "
             f"{geo['logits_all_gather_ici_ms']} ms")
+        lines.append(
+            f"handoff domain (disagg split {geo['disagg_split']}, "
+            f"page {geo['page_size']}): {geo['handoff_page_mb']} "
+            f"MB/page ({geo['handoff_page_ici_us']} us ICI); "
+            f"{geo['handoff_prompt_tokens']}-token prefill = "
+            f"{geo['handoff_prompt_pages']} pages, "
+            f"{geo['handoff_prompt_mb']} MB, "
+            f"{geo['handoff_prompt_ici_ms']} ms across the seam")
     return "\n".join(lines)
 
 
