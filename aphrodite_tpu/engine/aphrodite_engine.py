@@ -284,6 +284,8 @@ class AphroditeEngine:
         if lora_request is not None and not self.lora_config:
             raise ValueError("LoRA is not enabled (set enable_lora).")
         if arrival_time is None:
+            # replay-ok: arrival stamp orders FCFS admission, never tokens
+            # (token values derive from seed + output position alone)
             arrival_time = time.monotonic()
         if prompt_token_ids is None:
             assert prompt is not None

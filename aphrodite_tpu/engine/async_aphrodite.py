@@ -630,6 +630,8 @@ class AsyncAphrodite:
             prompt=prompt,
             sampling_params=sampling_params,
             prompt_token_ids=prompt_token_ids,
+            # replay-ok: arrival stamp orders FCFS admission, never tokens
+            # (token values derive from seed + output position alone)
             arrival_time=arrival_time or time.monotonic(),
             prefix_pos=prefix_pos,
             emitted_token_ids=emitted_token_ids)

@@ -365,7 +365,11 @@ class BlockSpaceManager:
     # ------------------------------------------------------------------
 
     def _free_block_table(self, block_table: BlockTable) -> None:
-        for block in set(block_table):
+        # Order-preserving dedup: prefix-shared tables repeat blocks,
+        # but the frees must land in table order (set order hashes by
+        # id, so a reincarnated process would rebuild its free lists
+        # in a different order and break the bit-equal replay).
+        for block in dict.fromkeys(block_table):
             if block.device == Device.TPU:
                 self.hbm_pool.free(block)
             else:

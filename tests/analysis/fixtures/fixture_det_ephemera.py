@@ -1,0 +1,29 @@
+"""Seeded DET005 violation: a continuation-seam function (it takes
+`emitted_token_ids` — the journal-splice replay seam) reading tracker
+ephemera — `self.decode_ewma` — fires EXACTLY once.
+
+The clean constructs must stay quiet: the same seam reading journaled
+state only (emitted tokens, seed), a NON-seam function reading the
+EWMA freely, and a seam wall-clock read registered with a reasoned
+`# replay-ok:` pragma.
+"""
+import time
+
+
+class FixtureEngine:
+
+    def add_request(self, request_id, emitted_token_ids=None):
+        budget = self.decode_ewma * 2                       # DET005
+        return self._admit(request_id, emitted_token_ids, budget)
+
+    def resume(self, request_id, emitted_token_ids=None, seed=None):
+        return self._admit(request_id, list(emitted_token_ids),  # quiet
+                           seed)
+
+    def record_stats(self):
+        self.stats.append(self.decode_ewma)                 # quiet
+
+    def splice(self, request_id, emitted_token_ids=None):
+        # replay-ok: arrival stamp orders FCFS admission, never tokens
+        arrival = time.monotonic()                          # quiet
+        return self._admit(request_id, emitted_token_ids, arrival)

@@ -1,0 +1,25 @@
+"""Seeded DET003 violation: `id()` flowing into a sort key —
+`sorted(groups, key=lambda g: id(g))` — fires EXACTLY once.
+
+The clean constructs must stay quiet: `id()` used as a dict-lookup
+KEY inside a sort key (`scores[id(r)]` — the identity token reaches
+no decision, only the looked-up score does), a plain `hash()` stored
+on an object outside any decision context, and a stable-id sort key.
+"""
+
+
+def fixture_id_sort(groups):
+    return sorted(groups, key=lambda g: id(g))              # DET003
+
+
+def fixture_score_lookup(routable, scores):
+    return min(routable, key=lambda r: (scores[id(r)], r.picks))  # quiet
+
+
+def fixture_stored_hash(self, token_ids):
+    self.prefix_hash = hash(tuple(token_ids))               # quiet
+    return self.prefix_hash
+
+
+def fixture_stable_sort(groups):
+    return sorted(groups, key=lambda g: g.request_id)       # quiet

@@ -1,0 +1,30 @@
+"""Seeded DET002 violation: a fresh `jax.random.PRNGKey` root outside
+the salt seam (not folded through `fold_in`) — fires EXACTLY once.
+
+The clean constructs must stay quiet: the seam idiom itself
+(`fold_in(fold_in(PRNGKey(base), salt), sibling)`), a `split` of a
+threaded key parameter, and tensor attribute access on a local that
+happens to be NAMED `random` (the sampler unpacks one — must not be
+mistaken for the stdlib module).
+"""
+import jax
+
+
+def fixture_fresh_root(step):
+    return jax.random.PRNGKey(step)                         # DET002
+
+
+def fixture_seam(base, salt, sibling):
+    return jax.random.fold_in(
+        jax.random.fold_in(jax.random.PRNGKey(base), salt),  # quiet
+        sibling)
+
+
+def fixture_threaded(key):
+    key_u, key_r = jax.random.split(key)                    # quiet
+    return key_u, key_r
+
+
+def fixture_local_named_random(rows):
+    greedy, random = rows
+    return random.astype("int32")                           # quiet

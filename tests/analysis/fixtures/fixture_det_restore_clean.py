@@ -1,0 +1,18 @@
+"""Clean-construct precision fixture for the FCFS-restore idiom
+(DET001/DET005 must report NOTHING here): a `reincarnate`-style
+continuation seam that walks a snapshotted LIST in arrival order and
+re-commits every group — list iteration is FCFS-ordered and the seam
+reads only journaled state, so the whole restore replays bit-equal.
+"""
+
+
+class FixtureEngine:
+
+    def reincarnate(self, snapshot):
+        restored = 0
+        for group in snapshot.waiting:                      # quiet: fcfs
+            self.scheduler.add_seq_group(group)
+            restored += 1
+        for seq_id, table in sorted(snapshot.tables.items()):  # quiet
+            self.block_tables[seq_id] = list(table)
+        return restored

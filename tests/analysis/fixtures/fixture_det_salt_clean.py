@@ -1,0 +1,33 @@
+"""Clean-construct precision fixture for the PRNG salt seam (DET002
+must report NOTHING here): the full position-salt derivation idiom
+exactly as the sampler implements it — fold_in(fold_in(PRNGKey(seed),
+output_position), sibling_index) — plus every threaded-key consumer
+shape (parameter split, tuple-unpack re-split, assigned-from-derive
+fold, stored-key attribute read).
+"""
+import jax
+
+
+def make_row_keys(bases, salt1, salt2):
+    def one(base, s1, s2):
+        return jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(base), s1), s2)
+    return jax.vmap(one)(bases, salt1, salt2)
+
+
+def rejection_sample(key, draft, target):
+    key_u, key_r = jax.random.split(key)
+    key_extra = jax.random.fold_in(key_u, 1)
+    return key_extra, key_r, draft, target
+
+
+def consume_assigned(seed, position):
+    root = jax.random.fold_in(jax.random.PRNGKey(seed), position)
+    lo, hi = jax.random.split(root)
+    return jax.random.fold_in(lo, 0), hi
+
+
+class FixtureSampler:
+
+    def stored_key_fold(self, position):
+        return jax.random.fold_in(self._row_key, position)

@@ -1,0 +1,40 @@
+"""Seeded DET001 violation: a state-committing loop iterating a SET —
+`for block in set(block_table): self.hbm_pool.free(block)` — fires
+EXACTLY once.
+
+The clean constructs must stay quiet: a `sorted(...)` iteration over
+the same set, the order-preserving `dict.fromkeys` dedup (the fix
+idiom), a dict-view iteration (insertion-ordered), a set loop whose
+body only fills a LOCAL accumulator (no commit), and a pragma'd set
+loop with a registered reason.
+"""
+
+
+class FixturePool:
+
+    def _free_block_table(self, block_table):
+        for block in set(block_table):                      # DET001
+            self.hbm_pool.free(block)
+
+    def _free_sorted(self, block_table):
+        for block in sorted(set(block_table)):              # quiet
+            self.hbm_pool.free(block)
+
+    def _free_fcfs_dedup(self, block_table):
+        for block in dict.fromkeys(block_table):            # quiet
+            self.hbm_pool.free(block)
+
+    def _reset(self):
+        for table in self.block_tables.values():            # quiet
+            self.hbm_pool.free(table)
+
+    def _collect_local(self, block_table):
+        seen = []
+        for block in set(block_table):                      # quiet
+            seen.append(block)
+        return seen
+
+    def _free_registered(self, block_table):
+        # replay-ok: teardown path, pools are rebuilt before reuse
+        for block in set(block_table):                      # quiet
+            self.hbm_pool.free(block)

@@ -2,9 +2,10 @@
 
 Three layers:
 
-1. THE GATE: every pass (all 18 families, the ROOF/FOLD perf rules,
+1. THE GATE: every pass (all 19 families, the ROOF/FOLD perf rules,
    the ASYNC/RACE concurrency rules, the LEAK/OWN page-ownership
-   rules, and the MESH placement rules included) over the real tree
+   rules, and the MESH placement / DET determinism rules included)
+   over the real tree
    (`aphrodite_tpu/`, `bench.py`, `benchmarks/`) must produce zero
    findings even with NO allowlist,
    the checked-in allowlist must hold at most 5 entries (currently
@@ -35,12 +36,12 @@ from tools.aphrocheck.core import (EVENT_LOOP, FLAGS_MODULE, REPO_ROOT,
                                    STEP_THREAD, Allowlist,
                                    collect_files)
 from tools.aphrocheck.passes import (async_pass, bound_pass,
-                                     clock_pass, dma_pass, exc_pass,
-                                     flag_pass, fold_pass, grid_pass,
-                                     leak_pass, mesh_pass, own_pass,
-                                     race_pass, recomp_pass, ref_pass,
-                                     roofline_pass, shard_pass,
-                                     sync_pass, vmem_pass)
+                                     clock_pass, det_pass, dma_pass,
+                                     exc_pass, flag_pass, fold_pass,
+                                     grid_pass, leak_pass, mesh_pass,
+                                     own_pass, race_pass, recomp_pass,
+                                     ref_pass, roofline_pass,
+                                     shard_pass, sync_pass, vmem_pass)
 from tools.aphrocheck.registry import parse_registry
 
 FIXDIR = os.path.join("tests", "analysis", "fixtures")
@@ -79,7 +80,7 @@ def test_repo_is_clean():
 
 
 def test_repo_clean_without_allowlist():
-    """The stronger form of the gate: all 18 pass families produce
+    """The stronger form of the gate: all 19 pass families produce
     ZERO findings with no allowlist at all — every real finding the
     passes surfaced was fixed in-tree (the ROOF/FOLD motivating
     findings closed in round 7; their perf-known pragmas are gone),
@@ -132,6 +133,7 @@ def test_checker_never_imports_jax():
          "import tools.aphrocheck.passes.leak_pass; "
          "import tools.aphrocheck.passes.own_pass; "
          "import tools.aphrocheck.passes.mesh_pass; "
+         "import tools.aphrocheck.passes.det_pass; "
          "assert 'jax' not in sys.modules, 'checker imports jax'; "
          "assert 'numpy' not in sys.modules, 'checker imports numpy'"],
         cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
@@ -207,6 +209,10 @@ def test_scan_covers_benches():
     (mesh_pass.run, "fixture_mesh_collective.py", "MESH002"),
     (mesh_pass.run, "fixture_mesh_ungated_launcher.py", "MESH003"),
     (mesh_pass.run, "fixture_mesh_domain.py", "MESH004"),
+    (det_pass.run, "fixture_det_unordered_commit.py", "DET001"),
+    (det_pass.run, "fixture_det_prng.py", "DET002"),
+    (det_pass.run, "fixture_det_hashseed.py", "DET003"),
+    (det_pass.run, "fixture_det_ephemera.py", "DET005"),
 ])
 def test_rule_fires_exactly_once(pass_fn, fixture, rule):
     findings = _pass_findings(pass_fn, [_fixture(fixture)])
@@ -755,7 +761,8 @@ def test_cli_rules_md_and_readme_drift():
                  "ROOF001", "ROOF002", "ROOF003", "ROOF004", "FOLD001",
                  "FOLD002",
                  "MESH001", "MESH002", "MESH003", "MESH004",
-                 "MESH005"):
+                 "MESH005",
+                 "DET001", "DET002", "DET003", "DET004", "DET005"):
         assert f"| {rule} |" in table, f"{rule} missing from rules-md"
     with open(os.path.join(REPO_ROOT, "README.md"),
               encoding="utf-8") as f:
@@ -777,6 +784,7 @@ def test_ci_workflow_runs_the_gates():
     assert "python -m tools.aphrocheck" in workflow
     assert "python -m pytest tests/" in workflow
     assert "diff /tmp/meshplan.json MESHPLAN.json" in workflow
+    assert "diff /tmp/replayplan.json REPLAYPLAN.json" in workflow
     assert "JAX_PLATFORMS=cpu" in workflow
     assert "-m 'not slow'" in workflow
 

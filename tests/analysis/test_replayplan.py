@@ -1,0 +1,299 @@
+"""aphrodet: static determinism / replay-surface pass tests.
+
+Four layers:
+
+1. Rule precision on the seeded fixtures: each DET fixture trips
+   exactly its one rule and nothing else, and the clean-construct
+   fixtures (the fold_in position-salt seam with every threaded-key
+   consumer shape, the FCFS list-restore reincarnation idiom) produce
+   ZERO findings.
+2. The REPLAYPLAN.json ledger drift gate: the checked-in baseline
+   must byte-match `--replayplan --json` (line numbers excluded by
+   schema so pure code motion cannot drift it), the ledger must
+   classify the sampler/rejection salt seam, the ordered commit
+   sites, the three continuation seams, and the reviewed replay-ok
+   pragmas.
+3. DET004 reproduces drift on a seeded tree: a stale baseline fires
+   the generic out-of-sync finding, a baseline MISSING a continuation
+   seam fires the surface-grew finding naming the seam, an in-sync
+   (or absent) baseline stays silent, and subset scans skip the gate.
+4. The replay surface holds on the real tree: zero DET findings
+   without any allowlist entry — the live findings (the set-iteration
+   free loop, the arrival-clock seam reads) were FIXED or carry a
+   reasoned `# replay-ok:`, not suppressed.
+
+Pure AST — no JAX device work; runs under JAX_PLATFORMS=cpu in tier-1
+and in CI.
+"""
+import copy
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tools.aphrocheck import build_context, run
+from tools.aphrocheck.core import REPO_ROOT
+from tools.aphrocheck.passes import det_pass
+
+FIXDIR = os.path.join("tests", "analysis", "fixtures")
+
+
+def _fixture(name: str) -> str:
+    return os.path.join(FIXDIR, name)
+
+
+def _findings(rels, root=REPO_ROOT):
+    ctx, parse_findings = build_context(root, rels)
+    assert not parse_findings, parse_findings
+    return det_pass.run(ctx)
+
+
+def _baseline():
+    with open(os.path.join(REPO_ROOT, det_pass.BASELINE_FILE),
+              encoding="utf-8") as f:
+        return json.load(f)
+
+
+# ------------------------------------------------------------------
+# 1. fixture precision
+# ------------------------------------------------------------------
+
+@pytest.mark.parametrize("fixture,rule", [
+    ("fixture_det_unordered_commit.py", "DET001"),
+    ("fixture_det_prng.py", "DET002"),
+    ("fixture_det_hashseed.py", "DET003"),
+    ("fixture_det_ephemera.py", "DET005"),
+])
+def test_rule_fires_exactly_once_and_alone(fixture, rule):
+    """Each seeded fixture trips exactly its one rule (recall AND
+    precision — the family's other rules stay quiet on it, including
+    DET004, which scans without both seam legs in view skip)."""
+    findings = _findings([_fixture(fixture)])
+    assert [f.rule for f in findings] == [rule], \
+        f"{fixture}: {[f.render() for f in findings]}"
+
+
+@pytest.mark.parametrize("fixture", [
+    "fixture_det_salt_clean.py",
+    "fixture_det_restore_clean.py",
+])
+def test_clean_constructs_stay_quiet(fixture):
+    """The real tree's idioms — fold_in(fold_in(PRNGKey(seed), pos),
+    sibling), split of a threaded key parameter, tuple-unpack
+    re-split, stored-key folds, the FCFS list-restore reincarnation —
+    produce ZERO findings."""
+    findings = _findings([_fixture(fixture)])
+    assert not findings, [f.render() for f in findings]
+
+
+def test_subset_scan_covers_det_through_run():
+    """The full run() pipeline reaches the DET family on explicit
+    paths, and the subset scan does NOT fire the drift gate (DET004
+    needs both seam legs in view)."""
+    report = run(rels=[_fixture("fixture_det_unordered_commit.py")],
+                 allowlist_path=None, rule_prefixes=["DET"])
+    assert [f.rule for f in report.findings] == ["DET001"], \
+        [f.render() for f in report.findings]
+
+
+# ------------------------------------------------------------------
+# 2. the checked-in ledger
+# ------------------------------------------------------------------
+
+def test_checked_in_ledger_in_sync():
+    """REPLAYPLAN.json must match what the tree generates —
+    regenerate with `python -m tools.aphrocheck --replayplan --json >
+    REPLAYPLAN.json` when the replay surface changes."""
+    ctx, parse_findings = build_context()
+    assert not parse_findings, parse_findings
+    assert det_pass.report_payload(ctx) == _baseline(), \
+        "REPLAYPLAN.json out of date: regenerate with `python -m " \
+        "tools.aphrocheck --replayplan --json > REPLAYPLAN.json`"
+
+
+def test_ledger_classifies_the_salt_seam():
+    """The two registered derivation sites and ONLY those: the
+    sampler's fold_in(fold_in(PRNGKey(seed), output_pos), sibling)
+    row-key builder is position-salted; rejection sampling only
+    splits the key it is handed (threaded-from-salted); nothing is
+    unsalted. Line numbers are excluded by schema so pure code motion
+    cannot drift the baseline."""
+    baseline = _baseline()
+    seam = baseline["salt_seam"]
+    assert seam["base"] == "SamplingParams.seed"
+    assert any("output position" in s for s in seam["salts"])
+    sites = seam["sites"]
+    assert sites[
+        "aphrodite_tpu/modeling/layers/sampler.py::_make_row_keys"] \
+        == "position-salted"
+    assert sites[
+        "aphrodite_tpu/modeling/layers/rejection.py::rejection_sample"] \
+        == "threaded-from-salted"
+    assert "unsalted" not in sites.values()
+
+    blob = json.dumps(baseline)
+    assert '"line"' not in blob and '"lineno"' not in blob, \
+        "ledger schema must not carry line numbers"
+
+
+def test_ledger_commit_order_sites_have_no_unordered_class():
+    """Every committed-iteration-order site on the step path is
+    FCFS / sorted / insertion-ordered — the fixed free loop dedups
+    order-preserving with dict.fromkeys, and no 'unordered' class
+    survives anywhere (that is DET001's zero-findings guarantee made
+    inspectable)."""
+    sites = _baseline()["commit_order_sites"]
+    block_mgr = ("aphrodite_tpu/processing/block_manager.py::"
+                 "BlockSpaceManager._free_block_table")
+    assert sites[block_mgr] == ["insertion-ordered"]
+    assert "aphrodite_tpu/engine/aphrodite_engine.py::" \
+        "AphroditeEngine.reincarnate" in sites
+    for qual, orders in sites.items():
+        assert "unordered" not in orders, (qual, orders)
+
+
+def test_ledger_names_the_three_continuation_seams():
+    """The replay contract's entry points are all ledgered: the
+    emitted-token journal-splice add_request seams (sync + async),
+    the reincarnation FCFS restore, and the router's journal-splice
+    continuation — each with its replay classification."""
+    seams = _baseline()["continuation_seams"]
+    assert seams["aphrodite_tpu/engine/aphrodite_engine.py::"
+                 "AphroditeEngine.add_request"] == "journaled"
+    assert seams["aphrodite_tpu/engine/aphrodite_engine.py::"
+                 "AphroditeEngine.reincarnate"] == "fcfs-restore"
+    assert seams["aphrodite_tpu/engine/async_aphrodite.py::"
+                 "AsyncAphrodite.add_request"] == "journaled"
+    assert seams["aphrodite_tpu/fleet/router.py::"
+                 "FleetRouter._issue_continuation"] == "journaled"
+
+
+def test_ledger_records_reviewed_pragmas():
+    """Every surviving `# replay-ok:` escape is ledgered with its
+    reason — the two arrival-clock stamps that order FCFS admission
+    but never reach token values."""
+    pragmas = _baseline()["replay_ok_pragmas"]
+    paths = {p["path"] for p in pragmas}
+    assert paths == {"aphrodite_tpu/engine/aphrodite_engine.py",
+                     "aphrodite_tpu/engine/async_aphrodite.py"}
+    for entry in pragmas:
+        assert "FCFS admission" in entry["reason"], entry
+
+
+def test_cli_replayplan_human_and_json():
+    """`--replayplan` renders the ledger for humans; `--replayplan
+    --json` must byte-match the checked-in baseline (the CI drift
+    gate diffs exactly this output)."""
+    human = subprocess.run(
+        [sys.executable, "-m", "tools.aphrocheck", "--replayplan"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert human.returncode == 0, human.stderr
+    assert "DET replay-surface ledger" in human.stdout
+    assert "position-salted" in human.stdout
+    assert "continuation seams:" in human.stdout
+    assert "replay-ok pragmas" in human.stdout
+
+    js = subprocess.run(
+        [sys.executable, "-m", "tools.aphrocheck", "--replayplan",
+         "--json"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert js.returncode == 0, js.stderr
+    assert json.loads(js.stdout) == _baseline()
+
+
+# ------------------------------------------------------------------
+# 3. DET004 drift on a seeded tree
+# ------------------------------------------------------------------
+
+_SEEDED_TREE = textwrap.dedent('''\
+    import jax
+
+
+    class SeededEngine:
+
+        def add_request(self, request_id, emitted_token_ids=None):
+            self.requests.append((request_id, emitted_token_ids))
+
+        def reincarnate(self, snapshot):
+            for group in snapshot.waiting:
+                self.scheduler.add_seq_group(group)
+
+
+    def make_row_keys(base, position, sibling):
+        return jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(base), position),
+            sibling)
+''')
+
+
+def _seeded_ctx(tmp_path):
+    (tmp_path / "seeded_engine.py").write_text(_SEEDED_TREE)
+    ctx, parse_findings = build_context(str(tmp_path),
+                                        ["seeded_engine.py"])
+    assert not parse_findings, parse_findings
+    return ctx
+
+
+def test_det004_quiet_in_sync_and_without_baseline(tmp_path):
+    """No baseline file (a fresh checkout mid-rebase) and an in-sync
+    baseline both stay silent — the gate only speaks on drift."""
+    ctx = _seeded_ctx(tmp_path)
+    assert not det_pass.run(ctx)
+    payload = det_pass.report_payload(ctx)
+    assert payload["salt_seam"]["sites"], "seeded tree must salt"
+    assert payload["continuation_seams"], "seeded tree must seam"
+    (tmp_path / det_pass.BASELINE_FILE).write_text(
+        json.dumps(payload, indent=2))
+    assert not det_pass.run(ctx)
+
+
+def test_det004_fires_on_stale_baseline(tmp_path):
+    """A baseline that no longer matches the tree — all seams still
+    present, but the commit-order map is stale — fires the generic
+    out-of-sync finding with the regeneration command."""
+    ctx = _seeded_ctx(tmp_path)
+    stale = copy.deepcopy(det_pass.report_payload(ctx))
+    stale["commit_order_sites"] = {}
+    (tmp_path / det_pass.BASELINE_FILE).write_text(
+        json.dumps(stale, indent=2))
+    findings = det_pass.run(ctx)
+    assert [f.rule for f in findings] == ["DET004"], \
+        [f.render() for f in findings]
+    assert "out of sync" in findings[0].message
+    assert "--replayplan" in findings[0].message
+
+
+def test_det004_names_the_seam_that_grew(tmp_path):
+    """When the tree has a continuation seam the baseline never
+    ledgered — a new replay entry point widening the bit-equal
+    contract — the finding names the seam specifically."""
+    ctx = _seeded_ctx(tmp_path)
+    payload = det_pass.report_payload(ctx)
+    qual = "seeded_engine.py::SeededEngine.reincarnate"
+    assert payload["continuation_seams"][qual] == "fcfs-restore"
+    stale = copy.deepcopy(payload)
+    del stale["continuation_seams"][qual]
+    (tmp_path / det_pass.BASELINE_FILE).write_text(
+        json.dumps(stale, indent=2))
+    findings = det_pass.run(ctx)
+    assert [f.rule for f in findings] == ["DET004"], \
+        [f.render() for f in findings]
+    assert "replay surface grew" in findings[0].message
+    assert qual in findings[0].message
+
+
+# ------------------------------------------------------------------
+# 4. the real tree is clean, with an EMPTY allowlist
+# ------------------------------------------------------------------
+
+def test_real_tree_clean_without_allowlist():
+    """Zero DET findings on the full tree with NO allowlist: the live
+    findings (the set-iterating free loop in the block manager, the
+    arrival-clock reads in the add_request seams) were fixed with
+    dict.fromkeys / a reasoned `# replay-ok:`, not suppressed."""
+    report = run(allowlist_path=None, rule_prefixes=["DET"])
+    assert not report.findings, \
+        [f.render() for f in report.findings]

@@ -185,6 +185,46 @@ def test_disagg_spec_decode_parity(split_llm, colo_llm, monkeypatch):
         f"no verify round accepted on the split mesh: {observed}"
 
 
+def test_disagg_spec_resume_kill_bit_equal(split_llm, colo_llm,
+                                           monkeypatch):
+    """A seeded speculative stream killed mid-generation and resumed
+    THROUGH the split mesh (the PR 16 x PR 18 composition at tp=8):
+    the continuation re-prefills its joint history on the prefill
+    group, hands off again, and the joint output is bit-equal to the
+    unkilled COLOCATED control — the resume seam, the verify rounds,
+    and the handoff compose without perturbing the stream."""
+    monkeypatch.setenv("APHRODITE_SPEC", "1")
+    vocab = split_llm.engine.model_config.get_vocab_size()
+    pattern = [v % (vocab - 10) + 5 for v in (11, 23, 37, 41)]
+    prompt = pattern * 5
+    sp = SamplingParams(temperature=1.0, seed=616, max_tokens=12,
+                        ignore_eos=True)
+
+    def run_engine(eng, rid, emitted=None):
+        eng.add_request(rid, None, sp, prompt_token_ids=list(prompt),
+                        emitted_token_ids=emitted)
+        finals = {}
+        while eng.has_unfinished_requests():
+            for out in eng.step():
+                if out.finished:
+                    finals[out.request_id] = out
+        return finals[rid]
+
+    control = run_engine(colo_llm.engine, "spec-kill-ctrl")
+    ids = list(control.outputs[0].token_ids)
+    assert len(ids) == 12
+
+    ce = split_llm.engine.executor.cache_engine
+    for k in (1, 5, 11):
+        flushes0 = ce.handoff_flushes
+        out = run_engine(split_llm.engine, f"spec-kill-cont-{k}",
+                         emitted=ids[:k])
+        assert list(out.outputs[0].token_ids) == ids, f"split {k}"
+        assert out.resumed_tokens == k
+        assert ce.handoff_flushes > flushes0, \
+            f"split {k}: continuation never re-handed off its KV"
+
+
 def test_disagg_zero_leak_both_pools(tiny24_dir):
     """After a full serve-and-finish cycle the ONE ownership ledger
     (shared by construction: both pools mirror the same logical page

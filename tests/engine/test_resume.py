@@ -275,6 +275,84 @@ def test_disagg_continuation_bit_equal_and_free0(disagg_engine):
     assert bm.get_num_free_gpu_blocks() == free0, "pool leak on resume"
 
 
+@pytest.fixture(scope="module")
+def colo4_engine(tiny_model_dir):
+    """Colocated tp=4 control for the disagg split — same tp degree,
+    same reduction order, so split-vs-colocated is a pure handoff
+    comparison."""
+    return _sync_engine(tiny_model_dir, tensor_parallel_size=4)
+
+
+def test_disagg_spec_stream_resumes_bit_equal_to_colocated(
+        disagg_engine, colo4_engine, monkeypatch):
+    """The PR 16 x PR 18 composition: a seeded SPECULATIVE stream
+    killed mid-generation and resumed THROUGH the disagg split mesh —
+    the joint-history re-prefill runs on the prefill group, its pages
+    hand off again, verify rounds run on the decode submesh — is
+    bit-equal to the UNKILLED COLOCATED control at every split point,
+    with the re-handoff proven to fire."""
+    monkeypatch.setenv("APHRODITE_SPEC", "1")
+    pattern = [11, 23, 37, 41] * 5
+    sp = SamplingParams(temperature=1.0, seed=616, max_tokens=12,
+                        ignore_eos=True)
+    colo4_engine.add_request("spec-colo-ctrl", None, sp,
+                             prompt_token_ids=list(pattern))
+    control = _drain(colo4_engine)["spec-colo-ctrl"]
+    ids = list(control.outputs[0].token_ids)
+    assert len(ids) == 12
+
+    eng = disagg_engine
+    ce = eng.executor.cache_engine
+    bm = eng.scheduler.block_manager
+    free0 = bm.get_num_free_gpu_blocks()
+    for k in (1, 5, 11):
+        flushes0 = ce.handoff_flushes
+        eng.add_request(f"spec-disagg-cont-{k}", None, sp,
+                        prompt_token_ids=list(pattern),
+                        emitted_token_ids=ids[:k])
+        out = _drain(eng)[f"spec-disagg-cont-{k}"]
+        assert list(out.outputs[0].token_ids) == ids, f"split {k}"
+        assert out.resumed_tokens == k
+        assert ce.handoff_flushes > flushes0, \
+            f"split {k}: resumed spec stream never re-handed off"
+    assert bm.get_num_free_gpu_blocks() == free0, \
+        "pool leak on spec resume through the split"
+
+
+def test_disagg_spec_resume_redrafts_through_split(disagg_engine,
+                                                   monkeypatch):
+    """Greedy arm of the same composition: resumed inside the cycle,
+    the drafter on the SPLIT engine drafts from the joint history and
+    lands accepted verify rounds on the decode submesh again (the
+    seeded arm above cannot pin acceptance — temperature-1 rejection
+    is draft-dependent)."""
+    monkeypatch.setenv("APHRODITE_SPEC", "1")
+    eng = disagg_engine
+    pattern = [11, 23, 37, 41] * 5
+    sp = SamplingParams(temperature=0.0, max_tokens=60,
+                        ignore_eos=True)
+    eng.add_request("redraft-split-full", None, sp,
+                    prompt_token_ids=list(pattern))
+    full = _drain(eng)["redraft-split-full"]
+    ids = list(full.outputs[0].token_ids)
+
+    accepted = []
+    orig_observe = eng.drafter.observe
+
+    def spy_observe(seq_id, proposed, acc):
+        accepted.append(acc)
+        return orig_observe(seq_id, proposed, acc)
+
+    monkeypatch.setattr(eng.drafter, "observe", spy_observe)
+    eng.add_request("redraft-split-cont", None, sp,
+                    prompt_token_ids=list(pattern),
+                    emitted_token_ids=ids[:40])
+    out = _drain(eng)["redraft-split-cont"]
+    assert list(out.outputs[0].token_ids) == ids
+    assert sum(accepted) > 0, \
+        "resumed stream never landed a verify round on the split mesh"
+
+
 def test_continuation_detok_resumes_mid_word(engine):
     """resumed_text equals the incremental-detok text of the emitted
     prefix (what the original stream delivered), even when the split

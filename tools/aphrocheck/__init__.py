@@ -68,6 +68,16 @@ Rule families (see each pass module's docstring for the contract):
                  that classify into no placement domain, and drift
                  vs the checked-in MESHPLAN.json collective
                  baseline; `--meshplan` emits the ledger
+  DET001-005     static determinism & replay surface (aphrodet):
+                 unordered-collection iteration committing state on
+                 the step path, PRNG derivation outside the
+                 SamplingParams.seed + output-position salt seam,
+                 id()/hash()/wall-clock flowing into sampling or
+                 scheduling decisions, drift vs the checked-in
+                 REPLAYPLAN.json replay-surface ledger, and
+                 continuation seams reading un-ledgered tracker
+                 ephemera; `--replayplan` emits the ledger,
+                 `# replay-ok: <reason>` escape
 
 Name resolution is interprocedural: a same-package call graph
 (core.CallGraph) lets helper parameters resolve through their call
@@ -94,7 +104,7 @@ DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(
 
 _RULE_ORDER = ("PARSE", "FLAG", "VMEM", "DMA", "GRID", "SYNC", "REF",
                "SHARD", "RECOMP", "EXC", "BP", "ASYNC", "RACE",
-               "LEAK", "OWN", "ROOF", "FOLD", "MESH")
+               "LEAK", "OWN", "ROOF", "FOLD", "MESH", "DET")
 
 
 @dataclasses.dataclass

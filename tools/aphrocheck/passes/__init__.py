@@ -64,15 +64,25 @@ Rule ID families:
                          unclassifiable placement-domain commit
                          sites, and drift vs the checked-in
                          MESHPLAN.json collective baseline
+- DET001..DET005     — static determinism & replay surface
+                         (aphrodet): unordered-collection iteration
+                         committing state on the step path, PRNG
+                         derivation outside the position-salt seam,
+                         id()/hash()/wall-clock flowing into
+                         sampling/scheduling decisions, drift vs the
+                         checked-in REPLAYPLAN.json replay-surface
+                         ledger (`--replayplan` emits it), and
+                         continuation seams reading un-ledgered
+                         tracker ephemera
 """
 
 from tools.aphrocheck.passes import (async_pass, bound_pass,
-                                     clock_pass, dma_pass, exc_pass,
-                                     flag_pass, fold_pass, grid_pass,
-                                     leak_pass, mesh_pass, own_pass,
-                                     race_pass, recomp_pass, ref_pass,
-                                     roofline_pass, shard_pass,
-                                     sync_pass, vmem_pass)
+                                     clock_pass, det_pass, dma_pass,
+                                     exc_pass, flag_pass, fold_pass,
+                                     grid_pass, leak_pass, mesh_pass,
+                                     own_pass, race_pass, recomp_pass,
+                                     ref_pass, roofline_pass,
+                                     shard_pass, sync_pass, vmem_pass)
 
 ALL_PASSES = (
     ("FLAG", flag_pass.run),
@@ -93,4 +103,5 @@ ALL_PASSES = (
     ("ROOF", roofline_pass.run),
     ("FOLD", fold_pass.run),
     ("MESH", mesh_pass.run),
+    ("DET", det_pass.run),
 )
